@@ -1,0 +1,16 @@
+"""Test harness config: force CPU JAX with 8 virtual devices.
+
+This is the framework's "fake backend" (SURVEY §4): pjit/shard_map/psum paths
+run on 8 virtual CPU devices so the multi-chip code is exercised in CI without
+TPU hardware. Must run before the first `import jax` anywhere in the test
+process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,80 @@
+"""Batch pipeline: packing, wrapping, padding, epoch shuffling, prefetch."""
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.data.batcher import PAD, BatchIterator, PackedCorpus, prefetch
+
+
+def test_pack_and_wrap():
+    sents = [np.arange(5, dtype=np.int32), np.arange(7, dtype=np.int32)]
+    pc = PackedCorpus.pack(sents, max_len=4)
+    # 5 -> rows (4, 1); 7 -> rows (4, 3)
+    assert pc.num_rows == 4
+    assert pc.num_tokens == 12
+    assert pc.row_lens.tolist() == [4, 1, 4, 3]
+
+
+def test_empty_sentences_skipped():
+    sents = [np.array([], dtype=np.int32), np.array([1, 2], dtype=np.int32)]
+    pc = PackedCorpus.pack(sents, max_len=8)
+    assert pc.num_rows == 1
+    with pytest.raises(ValueError):
+        PackedCorpus.pack([np.array([], dtype=np.int32)], max_len=8)
+
+
+def test_batches_cover_corpus_exactly():
+    rng = np.random.default_rng(0)
+    sents = [rng.integers(0, 50, size=n).astype(np.int32) for n in [3, 9, 17, 2, 31]]
+    pc = PackedCorpus.pack(sents, max_len=8)
+    it = BatchIterator(pc, batch_rows=3, max_len=8, seed=1)
+    seen = []
+    total_words = 0
+    nbatches = 0
+    for batch, words in it.epoch():
+        assert batch.shape == (3, 8)
+        assert batch.dtype == np.int32
+        valid = batch[batch != PAD]
+        assert len(valid) == words
+        seen.append(valid)
+        total_words += words
+        nbatches += 1
+    assert nbatches == it.steps_per_epoch()
+    assert total_words == pc.num_tokens == sum(len(s) for s in sents)
+    # multiset of tokens must match the corpus exactly
+    all_seen = np.sort(np.concatenate(seen))
+    all_src = np.sort(np.concatenate(sents))
+    np.testing.assert_array_equal(all_seen, all_src)
+
+
+def test_epochs_shuffle_rows():
+    sents = [np.full(4, i, dtype=np.int32) for i in range(64)]
+    pc = PackedCorpus.pack(sents, max_len=4)
+    it = BatchIterator(pc, batch_rows=8, max_len=4, seed=7)
+    e1 = np.concatenate([b.ravel() for b, _ in it.epoch()])
+    e2 = np.concatenate([b.ravel() for b, _ in it.epoch()])
+    assert not np.array_equal(e1, e2)  # order differs (Word2Vec.cpp:373)
+    np.testing.assert_array_equal(np.sort(e1), np.sort(e2))  # same content
+
+
+def test_rows_preserve_token_order_within_sentence():
+    sent = [np.arange(10, dtype=np.int32)]
+    pc = PackedCorpus.pack(sent, max_len=16)
+    it = BatchIterator(pc, batch_rows=1, max_len=16, seed=0, shuffle=False)
+    (batch, words), = list(it.epoch())
+    assert words == 10
+    np.testing.assert_array_equal(batch[0, :10], np.arange(10))
+    assert np.all(batch[0, 10:] == PAD)
+
+
+def test_prefetch_passthrough_and_errors():
+    assert list(prefetch(iter(range(10)))) == list(range(10))
+
+    def boom():
+        yield 1
+        raise RuntimeError("boom")
+
+    gen = prefetch(boom())
+    assert next(gen) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        list(gen)

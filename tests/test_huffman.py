@@ -1,0 +1,76 @@
+"""Huffman coding vs hand-computed values and structural invariants.
+
+Reference semantics: create_huffman_tree (Word2Vec.cpp:32-79): min-heap merge,
+first-popped child = code 0; points = internal-node indices root->leaf,
+internal node of merge step i has index i (after subtracting vocab_size).
+"""
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.data.huffman import build_huffman
+
+
+def decode_word(hc, w):
+    n = hc.code_len[w]
+    return list(hc.codes[w, :n]), list(hc.points[w, :n])
+
+
+def test_hand_computed_tree():
+    # counts sorted descending as vocab order: [8, 5, 2, 1]
+    # merges: (1)+(2)->3 [node 0], (3)+(5)->8 [node 1], (8)+(8)->16 [node 2=root]
+    hc = build_huffman(np.array([8, 5, 2, 1]))
+    assert hc.max_code_len == 3
+    # word 0 (count 8): popped first at root merge -> code [0], points [root=2]
+    assert decode_word(hc, 0) == ([0], [2])
+    # word 1 (count 5): path root->node1, second child both times
+    assert decode_word(hc, 1) == ([1, 1], [2, 1])
+    # word 3 (count 1): popped first at merge 0
+    assert decode_word(hc, 3) == ([1, 0, 0], [2, 1, 0])
+    assert decode_word(hc, 2) == ([1, 0, 1], [2, 1, 0])
+
+
+def test_prefix_property_and_optimality():
+    rng = np.random.default_rng(0)
+    # distinct counts: with ties, equally-optimal trees may order lengths
+    # differently (heap tie-break), so length monotonicity only holds strictly
+    counts = np.sort(rng.choice(np.arange(1, 10000), size=50, replace=False))[::-1].copy()
+    hc = build_huffman(counts)
+    codes = set()
+    for w in range(50):
+        n = hc.code_len[w]
+        code = tuple(hc.codes[w, :n])
+        codes.add(code)
+        # no code is a prefix of another
+        for other in codes:
+            if other != code:
+                m = min(len(other), len(code))
+                assert other[:m] != code[:m]
+    assert len(codes) == 50
+    # Kraft equality for a full binary tree
+    kraft = sum(2.0 ** -int(hc.code_len[w]) for w in range(50))
+    assert kraft == pytest.approx(1.0)
+    # higher count => code no longer than lower count
+    for w in range(49):
+        assert hc.code_len[w] <= hc.code_len[w + 1]
+
+
+def test_points_index_internal_matrix():
+    counts = np.array([10, 7, 5, 3, 2, 1])
+    hc = build_huffman(counts)
+    V = 6
+    # points index rows of the [V-1, d] hs output matrix
+    assert hc.num_internal == V - 1
+    for w in range(V):
+        n = hc.code_len[w]
+        pts = hc.points[w, :n]
+        assert np.all(pts >= 0) and np.all(pts < V - 1)
+        # path starts at the root = last merge step (Word2Vec.cpp:53 root first)
+        assert pts[0] == V - 2
+    # padding is zero
+    assert np.all(hc.codes[0, hc.code_len[0]:] == 0)
+
+
+def test_rejects_tiny_vocab():
+    with pytest.raises(ValueError):
+        build_huffman(np.array([3]))

@@ -1,0 +1,60 @@
+"""Alias-table negative sampler: exactness and statistical distribution.
+
+Replaces the reference's quantized 1e8-slot table (Word2Vec.cpp:81-113); the
+alias method must reproduce the count^0.75 distribution exactly in expectation.
+"""
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.data.negative import build_alias_table
+
+
+def test_alias_table_structure():
+    p = np.array([0.5, 0.25, 0.125, 0.125])
+    at = build_alias_table(p)
+    assert at.n == 4
+    assert np.all(at.accept >= 0) and np.all(at.accept <= 1)
+    assert np.all(at.alias >= 0) and np.all(at.alias < 4)
+    # implied probability of outcome i: (accept[i] + sum_j (1-accept[j])[alias[j]==i]) / n
+    implied = at.accept.astype(np.float64).copy()
+    for j in range(4):
+        implied[at.alias[j]] += 1.0 - at.accept[j]
+    np.testing.assert_allclose(implied / 4, p, atol=1e-7)
+
+
+def test_alias_table_implied_matches_unigram():
+    rng = np.random.default_rng(1)
+    counts = rng.integers(1, 500, size=200).astype(float)
+    p = counts**0.75
+    p /= p.sum()
+    at = build_alias_table(p)
+    implied = at.accept.astype(np.float64).copy()
+    for j in range(at.n):
+        implied[at.alias[j]] += 1.0 - at.accept[j]
+    np.testing.assert_allclose(implied / at.n, p, atol=1e-6)
+
+
+def test_sampling_distribution():
+    p = np.array([0.6, 0.3, 0.08, 0.02])
+    at = build_alias_table(p)
+    rng = np.random.default_rng(2)
+    draws = at.sample_np(rng, (200_000,))
+    freq = np.bincount(draws, minlength=4) / len(draws)
+    np.testing.assert_allclose(freq, p, atol=0.01)
+
+
+def test_degenerate_distribution():
+    # all mass on word 0 => every draw is 0 (used by the golden-oracle tests)
+    p = np.zeros(16)
+    p[0] = 1.0
+    at = build_alias_table(p)
+    rng = np.random.default_rng(3)
+    assert np.all(at.sample_np(rng, (1000,)) == 0)
+
+
+def test_rejects_bad_input():
+    with pytest.raises(ValueError):
+        build_alias_table(np.zeros((0,)))
+    with pytest.raises(ValueError):
+        build_alias_table(np.ones((2, 2)))

@@ -1,0 +1,240 @@
+"""Golden-oracle test: the fused device step vs a scalar NumPy replica.
+
+SURVEY §4 "Numerics": a pure-NumPy scalar implementation of the SGNS/HS update
+rules (reference: Word2Vec.cpp:239-246, 262-268, 273-353) with *batched*
+semantics (all reads from pre-update weights, duplicate updates summed) is the
+oracle; the JAX step must match it elementwise.
+
+Randomness is pinned down by construction so the oracle needs no RNG:
+  - window=1  => the window shrink draw is always 0 (w_eff = 1)
+  - subsample_threshold=0 => keep prob 1 for every word
+  - negatives drawn from a degenerate alias table with all mass on word 0
+    => every negative draw is word 0
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.huffman import build_huffman
+from word2vec_tpu.data.negative import build_alias_table
+from word2vec_tpu.ops.tables import DeviceTables
+from word2vec_tpu.ops.train_step import make_train_step
+
+V, D = 12, 8
+ALPHA = 0.02
+COUNTS = np.arange(2 * V, V, -1)  # descending
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def make_tables(cfg):
+    keep = jnp.ones(V, jnp.float32)
+    aa = ai = hc_codes = hc_points = hc_len = None
+    hc = None
+    if cfg.use_ns:
+        p = np.zeros(V)
+        p[0] = 1.0  # degenerate: all negatives are word 0
+        at = build_alias_table(p)
+        aa, ai = jnp.asarray(at.accept), jnp.asarray(at.alias)
+    if cfg.use_hs:
+        hc = build_huffman(COUNTS)
+        hc_codes = jnp.asarray(hc.codes.astype(np.int8))
+        hc_points = jnp.asarray(hc.points)
+        hc_len = jnp.asarray(hc.code_len)
+    return DeviceTables(keep, aa, ai, hc_codes, hc_points, hc_len), hc
+
+
+def make_params(cfg, rng):
+    params = {"emb_in": rng.normal(0, 0.1, (V, D))}
+    if cfg.use_ns:
+        params["emb_out_ns"] = rng.normal(0, 0.1, (V, D))
+    if cfg.use_hs:
+        params["emb_out_hs"] = rng.normal(0, 0.1, (V - 1, D))
+    return {k: v.astype(np.float32) for k, v in params.items()}
+
+
+def oracle_objectives(cfg, hc, params, h, pred, alpha, new):
+    """Accumulate ns/hs updates for one projection h; returns grad_h."""
+    grad_h = np.zeros(D, np.float64)
+    if cfg.use_ns:
+        targets = [int(pred)] + [0] * cfg.negative
+        labels = [1.0] + [0.0] * cfg.negative
+        for t_idx, lab in zip(targets, labels):
+            if lab == 0.0 and t_idx == pred:
+                continue  # negative colliding with positive is skipped
+            row = params["emb_out_ns"][t_idx].astype(np.float64)
+            g = (lab - sigmoid(row @ h)) * alpha
+            grad_h += g * row
+            new["emb_out_ns"][t_idx] += (g * h).astype(np.float32)
+    if cfg.use_hs:
+        n = int(hc.code_len[pred])
+        for k in range(n):
+            pt = int(hc.points[pred, k])
+            code = int(hc.codes[pred, k])
+            row = params["emb_out_hs"][pt].astype(np.float64)
+            g = (1.0 - code - sigmoid(row @ h)) * alpha  # Word2Vec.cpp:242
+            grad_h += g * row
+            new["emb_out_hs"][pt] += (g * h).astype(np.float32)
+    return grad_h
+
+
+def oracle_step(cfg, hc, params, tokens, alpha):
+    new = {k: v.copy() for k, v in params.items()}
+    B, L = tokens.shape
+    for b in range(B):
+        for i in range(L):
+            center = tokens[b, i]
+            if center < 0:
+                continue
+            ctx = [
+                tokens[b, j]
+                for j in (i - 1, i + 1)
+                if 0 <= j < L and tokens[b, j] >= 0
+            ]
+            if cfg.model == "sg":
+                h = params["emb_in"][center].astype(np.float64)
+                grad_h = np.zeros(D, np.float64)
+                for pred in ctx:
+                    grad_h += oracle_objectives(cfg, hc, params, h, pred, alpha, new)
+                new["emb_in"][center] += grad_h.astype(np.float32)
+            else:  # cbow: ctx rows project, center is predicted
+                n = len(ctx)
+                if n == 0:
+                    continue
+                h = np.sum(
+                    [params["emb_in"][c].astype(np.float64) for c in ctx], axis=0
+                )
+                if cfg.cbow_mean:
+                    h = h / n
+                grad_h = oracle_objectives(cfg, hc, params, h, center, alpha, new)
+                if cfg.cbow_mean:
+                    grad_h = grad_h / n  # second division, Word2Vec.cpp:313-314
+                for c in ctx:
+                    new["emb_in"][c] += grad_h.astype(np.float32)
+    return new
+
+
+CONFIGS = [
+    dict(model="sg", train_method="ns", negative=3),
+    dict(model="sg", train_method="hs", negative=0),
+    dict(model="cbow", train_method="ns", negative=2, cbow_mean=True),
+    dict(model="cbow", train_method="ns", negative=2, cbow_mean=False),
+    dict(model="cbow", train_method="hs", negative=0, cbow_mean=True),
+]
+
+
+@pytest.mark.parametrize("kw", CONFIGS, ids=lambda kw: f"{kw['model']}-{kw['train_method']}-mean{kw.get('cbow_mean')}")
+def test_step_matches_oracle(kw):
+    # scatter_mean=False: the oracle implements reference-exact sum semantics
+    cfg = Word2VecConfig(
+        window=1, subsample_threshold=0.0, word_dim=D, scatter_mean=False, **kw
+    )
+    tables, hc = make_tables(cfg)
+    rng = np.random.default_rng(42)
+    params = make_params(cfg, rng)
+
+    tokens = np.array(
+        [
+            [3, 1, 4, 1, 5, 9, 2, 6, -1],
+            # word 0 present: exercises the negative==positive collision mask
+            [0, 7, 1, 0, -1, -1, -1, -1, -1],
+        ],
+        dtype=np.int32,
+    )
+
+    step = make_train_step(cfg, tables)
+    jparams = {k: jnp.asarray(v) for k, v in params.items()}
+    new_j, metrics = jax.jit(step)(
+        jparams, jnp.asarray(tokens), jax.random.key(0), jnp.float32(ALPHA)
+    )
+
+    expected = oracle_step(cfg, hc, params, tokens, ALPHA)
+    for k in expected:
+        np.testing.assert_allclose(
+            np.asarray(new_j[k]), expected[k], atol=2e-5, err_msg=k
+        )
+    assert float(metrics["pairs"]) > 0
+    assert np.isfinite(float(metrics["loss_sum"]))
+
+
+def test_scatter_mean_matches_sum_when_no_duplicates():
+    """With every center word unique in the batch, duplicate-count
+    normalization must be a no-op on emb_in (factor 1.0 everywhere)."""
+    kw = dict(window=1, subsample_threshold=0.0, word_dim=D, model="sg",
+              train_method="ns", negative=2)
+    tables, _ = make_tables(Word2VecConfig(**kw))
+    rng = np.random.default_rng(11)
+    params_np = make_params(Word2VecConfig(**kw), rng)
+    tokens = jnp.asarray(np.array([[1, 2, 3, 4, 5, 6, 7, 8]], dtype=np.int32))
+    outs = {}
+    for sm in (False, True):
+        cfg = Word2VecConfig(scatter_mean=sm, **kw)
+        step = jax.jit(make_train_step(cfg, tables))
+        params = {k: jnp.asarray(v) for k, v in params_np.items()}
+        new, _ = step(params, tokens, jax.random.key(3), jnp.float32(ALPHA))
+        outs[sm] = new
+    np.testing.assert_allclose(
+        np.asarray(outs[False]["emb_in"]), np.asarray(outs[True]["emb_in"]),
+        atol=1e-7,
+    )
+    # negatives all hit word 0 => emb_out_ns row 0 IS normalized differently
+    assert not np.allclose(
+        np.asarray(outs[False]["emb_out_ns"][0]), np.asarray(outs[True]["emb_out_ns"][0])
+    )
+
+
+def test_scatter_mean_stable_on_degenerate_corpus():
+    """Pathological duplication (V=12, dense batch) must not diverge when
+    scatter_mean is on — the failure mode that motivated it."""
+    cfg = Word2VecConfig(
+        window=2, subsample_threshold=0.0, word_dim=D, model="sg",
+        train_method="ns", negative=5, init_alpha=0.05, scatter_mean=True,
+    )
+    tables, _ = make_tables(cfg)
+    rng = np.random.default_rng(13)
+    params = {k: jnp.asarray(v) for k, v in make_params(cfg, rng).items()}
+    tokens = jnp.asarray(rng.integers(0, V, size=(8, 32)).astype(np.int32))
+    step = jax.jit(make_train_step(cfg, tables))
+    for i in range(200):
+        params, metrics = step(params, tokens, jax.random.key(i), jnp.float32(0.05))
+    for k, v in params.items():
+        assert np.all(np.isfinite(np.asarray(v))), k
+    assert np.isfinite(float(metrics["loss_sum"]))
+
+
+def test_step_is_deterministic():
+    cfg = Word2VecConfig(
+        window=1, subsample_threshold=0.0, word_dim=D, model="sg",
+        train_method="ns", negative=3,
+    )
+    tables, _ = make_tables(cfg)
+    rng = np.random.default_rng(7)
+    params = {k: jnp.asarray(v) for k, v in make_params(cfg, rng).items()}
+    tokens = jnp.asarray(np.array([[1, 2, 3, 4, 5, 6, 7, 8]], dtype=np.int32))
+    step = jax.jit(make_train_step(cfg, tables))
+    out1, _ = step(params, tokens, jax.random.key(5), jnp.float32(ALPHA))
+    out2, _ = step(params, tokens, jax.random.key(5), jnp.float32(ALPHA))
+    for k in out1:
+        np.testing.assert_array_equal(np.asarray(out1[k]), np.asarray(out2[k]))
+
+
+def test_pad_only_batch_is_noop():
+    cfg = Word2VecConfig(
+        window=1, subsample_threshold=0.0, word_dim=D, model="sg",
+        train_method="ns", negative=2,
+    )
+    tables, _ = make_tables(cfg)
+    rng = np.random.default_rng(9)
+    params = {k: jnp.asarray(v) for k, v in make_params(cfg, rng).items()}
+    tokens = jnp.full((2, 6), -1, dtype=jnp.int32)
+    step = jax.jit(make_train_step(cfg, tables))
+    new, metrics = step(params, tokens, jax.random.key(1), jnp.float32(ALPHA))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(new[k]), np.asarray(params[k]))
+    assert float(metrics["pairs"]) == 0.0

@@ -1,0 +1,76 @@
+"""Vocab construction, subsampling formula, persistence round-trip.
+
+Covers reference semantics: build_vocab (Word2Vec.cpp:132-169),
+precalc_sampling (:115-130), save_vocab/read_vocab (:171-196).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.data.vocab import Vocab
+
+
+def make_sentences():
+    # counts: apple=6, pear=4, fig=3, kiwi=2, rare=1
+    return [
+        ["apple"] * 6 + ["pear"] * 4,
+        ["fig"] * 3 + ["kiwi"] * 2 + ["rare"],
+    ]
+
+
+def test_build_filters_and_sorts():
+    v = Vocab.build(make_sentences(), min_count=2)
+    assert v.words == ["apple", "pear", "fig", "kiwi"]  # descending count
+    assert v.counts.tolist() == [6, 4, 3, 2]
+    assert "rare" not in v
+    assert v["apple"] == 0 and v["kiwi"] == 3
+    assert v.total_words == 15
+
+
+def test_min_count_boundary():
+    # count == min_count is kept (reference: `< min_count` skip, Word2Vec.cpp:145)
+    v = Vocab.build(make_sentences(), min_count=6)
+    assert v.words == ["apple"]
+
+
+def test_encode_drops_oov():
+    v = Vocab.build(make_sentences(), min_count=2)
+    ids = v.encode(["apple", "unknown", "kiwi", "rare"])
+    assert ids.tolist() == [0, 3]  # OOV dropped silently (Word2Vec.cpp:223)
+    assert ids.dtype == np.int32
+
+
+def test_keep_probs_formula():
+    v = Vocab.build(make_sentences(), min_count=2)
+    t = 0.05
+    p = v.keep_probs(t)
+    tc = t * v.total_words
+    for i, c in enumerate(v.counts):
+        expect = min((math.sqrt(c / tc) + 1) * tc / c, 1.0)
+        assert p[i] == pytest.approx(expect, rel=1e-6)
+    # disabled subsampling => all ones (Word2Vec.cpp:127-129)
+    assert np.all(v.keep_probs(0.0) == 1.0)
+    assert np.all(v.keep_probs(-1.0) == 1.0)
+
+
+def test_unigram_probs_power():
+    v = Vocab.build(make_sentences(), min_count=2)
+    p = v.unigram_probs(0.75)
+    raw = v.counts.astype(float) ** 0.75
+    np.testing.assert_allclose(p, raw / raw.sum(), rtol=1e-12)
+    assert p.sum() == pytest.approx(1.0)
+
+
+def test_save_load_roundtrip(tmp_path):
+    v = Vocab.build(make_sentences(), min_count=2)
+    path = str(tmp_path / "vocab.txt")
+    v.save(path)
+    # format: "index count word" per line (Word2Vec.cpp:171-177)
+    lines = open(path).read().strip().split("\n")
+    assert lines[0] == "0 6 apple"
+    v2 = Vocab.load(path)
+    assert v2.words == v.words
+    assert v2.counts.tolist() == v.counts.tolist()
+    assert v2.word2id == v.word2id

@@ -1,0 +1,49 @@
+"""word2vec_tpu — a TPU-native word2vec training framework.
+
+Feature-parity re-design of lache/word2vec (C++/Eigen/OpenMP) for TPU:
+host does strings/trees/tables, the device runs one fused jit step
+(gather -> einsum -> sigmoid -> scatter-add), and multi-chip scaling uses
+jax.sharding meshes instead of OpenMP Hogwild.
+
+Quick start:
+    from word2vec_tpu import Word2VecConfig, Vocab, PackedCorpus, Trainer
+    from word2vec_tpu.data.corpus import text8_corpus
+
+    cfg = Word2VecConfig(model="sg", train_method="ns", negative=5, word_dim=100)
+    sents = list(text8_corpus("text8"))
+    vocab = Vocab.build(sents, min_count=cfg.min_count)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    state, report = Trainer(cfg, vocab, corpus).train()
+"""
+
+from .config import Word2VecConfig
+from .data.batcher import BatchIterator, PackedCorpus
+from .data.huffman import HuffmanCoding, build_huffman
+from .data.negative import AliasTable, build_alias_table
+from .data.vocab import Vocab
+from .models.params import export_matrix, init_params
+from .ops.tables import DeviceTables
+from .ops.train_step import jit_train_step, make_train_step
+from .train import Trainer, TrainReport, TrainState
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Word2VecConfig",
+    "Vocab",
+    "PackedCorpus",
+    "BatchIterator",
+    "HuffmanCoding",
+    "build_huffman",
+    "AliasTable",
+    "build_alias_table",
+    "DeviceTables",
+    "init_params",
+    "export_matrix",
+    "make_train_step",
+    "jit_train_step",
+    "Trainer",
+    "TrainState",
+    "TrainReport",
+    "__version__",
+]

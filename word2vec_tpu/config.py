@@ -1,0 +1,96 @@
+"""Configuration for the TPU-native word2vec framework.
+
+Mirrors every hyperparameter knob of the reference implementation
+(reference: Word2Vec.h:32-46 public members, defaults at Word2Vec.h:64-66 and
+main.cpp:105-121) while adding TPU-specific knobs (batch geometry, mesh shape,
+sync cadence) that have no reference counterpart because the reference is a
+single-process OpenMP program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class Word2VecConfig:
+    """All training hyperparameters.
+
+    Reference-equivalent knobs (names follow the reference CLI, main.cpp:123-151):
+      iters:       epochs over the corpus           (-iter, default 1: main.cpp:120)
+      window:      max skip length                  (-window, default 5: main.cpp:114)
+      min_count:   drop rarer words                 (-min-count, default 5: main.cpp:121)
+      word_dim:    embedding dimension              (-size, default 200: main.cpp:112)
+      negative:    negative samples per target      (-negative, default 0: main.cpp:118)
+      subsample_threshold: frequent-word downsample (-subsample, default 1e-4: main.cpp:115)
+      init_alpha / min_alpha: linear LR schedule    (-alpha: main.cpp:113,116)
+      cbow_mean:   mean vs sum context projection   (main.cpp:117, forces alpha=0.05 at :180-181)
+      train_method: "hs" | "ns"                     (-train_method, default "ns": main.cpp:110)
+      model:       "sg" | "cbow"                    (-model, default "sg": main.cpp:109)
+
+    The reference's `table_size` (1e8-slot unigram table, main.cpp:111) has no
+    TPU equivalent: negative sampling uses an exact O(V) alias table sampled on
+    device, so the table-size/accuracy trade-off disappears.
+    """
+
+    # --- reference-equivalent hyperparameters ---
+    iters: int = 1
+    window: int = 5
+    min_count: int = 5
+    word_dim: int = 200
+    # The reference's parsed default is 0 (main.cpp:118), which its own
+    # validation then rejects under the default train_method "ns"
+    # (main.cpp:164-167); the help text says 5 (main.cpp:25). Default 5 here so
+    # a bare Word2VecConfig() is valid.
+    negative: int = 5
+    subsample_threshold: float = 1e-4
+    init_alpha: float = 0.025
+    min_alpha: Optional[float] = None  # reference: init_alpha * 1e-4 (main.cpp:116)
+    cbow_mean: bool = True
+    train_method: str = "ns"  # "hs" | "ns"
+    model: str = "sg"  # "sg" | "cbow"
+    ns_power: float = 0.75  # unigram distortion (Word2Vec.cpp:85)
+
+    # --- TPU batch geometry (no reference counterpart) ---
+    batch_rows: int = 64     # sentences (rows) per device step
+    max_sentence_len: int = 192  # tokens per row; longer sentences are wrapped
+    seed: int = 0
+    dtype: str = "float32"   # accumulation/storage dtype of the embedding tables
+    compute_dtype: str = "float32"  # dot-product dtype ("bfloat16" for MXU-friendly scoring)
+
+    # Batched-update stabilizer. The reference's Hogwild updates are sequential:
+    # after each update to a row, the next sigmoid sees the moved row, so
+    # frequent rows self-correct (Word2Vec.cpp:239-246,262-268). A batched
+    # scatter instead SUMS all N duplicate-row gradients computed at the
+    # pre-update weights; for rows duplicated thousands of times per batch
+    # (frequent words as negatives) that overshoots ~N-fold and diverges.
+    # scatter_mean=True normalizes each row's summed update by its duplicate
+    # count: rows touched once per batch (the overwhelming majority at real
+    # vocab sizes) are bit-identical to sum semantics; hot rows get the
+    # sequential-like contraction. Set False for reference-exact sum semantics.
+    scatter_mean: bool = True
+
+    def __post_init__(self) -> None:
+        if self.min_alpha is None:
+            self.min_alpha = self.init_alpha * 1e-4
+        if self.model not in ("sg", "cbow"):
+            raise ValueError(f"model must be 'sg' or 'cbow', got {self.model!r}")
+        if self.train_method not in ("hs", "ns"):
+            raise ValueError(
+                f"train_method must be 'hs' or 'ns', got {self.train_method!r}"
+            )
+        if self.train_method == "ns" and self.negative <= 0:
+            raise ValueError("negative sampling requires negative > 0 (main.cpp:164-167)")
+        if self.train_method == "hs" and self.negative > 0:
+            raise ValueError("hs and negative > 0 are mutually exclusive (main.cpp:169-172)")
+        if self.window < 1:
+            raise ValueError("window must be >= 1")
+
+    @property
+    def use_hs(self) -> bool:
+        return self.train_method == "hs"
+
+    @property
+    def use_ns(self) -> bool:
+        return self.negative > 0

@@ -1,0 +1,154 @@
+"""Fixed-shape batch pipeline: encoded corpus -> [B, L] token-id matrices.
+
+TPU-first design (SURVEY §7 step 2): the host does *no* pair generation.
+Sentences are packed into fixed-shape int32 rows (pad = -1); subsampling,
+window shrink, pair enumeration and negative sampling all happen inside the
+jit-compiled device step (ops/). This keeps the host loop at O(tokens) memcpy
+— essential on a 1-core host — and makes device cost shape-static.
+
+The per-epoch sentence shuffle (reference: Word2Vec.cpp:373 std::shuffle)
+becomes a per-epoch row permutation. Sentences longer than max_len are wrapped
+into multiple rows; context windows do not cross row boundaries, which differs
+from the reference only for the ~2*window/max_len fraction of positions at
+wrap points.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+PAD = -1
+
+
+class PackedCorpus:
+    """Flat token-id array + row table; the in-memory corpus representation."""
+
+    def __init__(self, flat: np.ndarray, row_starts: np.ndarray, row_lens: np.ndarray):
+        self.flat = flat
+        self.row_starts = row_starts
+        self.row_lens = row_lens
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.row_starts)
+
+    @property
+    def num_tokens(self) -> int:
+        return int(self.row_lens.sum())
+
+    @classmethod
+    def pack(cls, sentences: Iterable[np.ndarray], max_len: int) -> "PackedCorpus":
+        """Pack encoded sentences, wrapping rows longer than max_len."""
+        chunks: List[np.ndarray] = []
+        starts: List[int] = []
+        lens: List[int] = []
+        pos = 0
+        for ids in sentences:
+            n = len(ids)
+            if n == 0:
+                continue
+            chunks.append(np.asarray(ids, dtype=np.int32))
+            for ofs in range(0, n, max_len):
+                ln = min(max_len, n - ofs)
+                starts.append(pos + ofs)
+                lens.append(ln)
+            pos += n
+        if not chunks:
+            raise ValueError("empty corpus")
+        flat = np.concatenate(chunks)
+        return cls(flat, np.asarray(starts, dtype=np.int64), np.asarray(lens, dtype=np.int32))
+
+
+class BatchIterator:
+    """Yields [B, L] int32 batches (pad = -1) in per-epoch shuffled row order.
+
+    The final partial batch of an epoch is padded with empty rows so every
+    device step has the same shape (no recompilation).
+    """
+
+    def __init__(
+        self,
+        corpus: PackedCorpus,
+        batch_rows: int,
+        max_len: int,
+        seed: int = 0,
+        shuffle: bool = True,
+    ):
+        self.corpus = corpus
+        self.B = batch_rows
+        self.L = max_len
+        self.rng = np.random.default_rng(seed)
+        self.shuffle = shuffle
+
+    def steps_per_epoch(self) -> int:
+        return -(-self.corpus.num_rows // self.B)
+
+    def epoch(self) -> Iterator[Tuple[np.ndarray, int]]:
+        """Yield (tokens [B, L], words_in_batch) for one pass over the corpus."""
+        order = np.arange(self.corpus.num_rows)
+        if self.shuffle:
+            self.rng.shuffle(order)
+        flat = self.corpus.flat
+        starts = self.corpus.row_starts
+        lens = self.corpus.row_lens
+        B, L = self.B, self.L
+        for i in range(0, len(order), B):
+            rows = order[i : i + B]
+            batch = np.full((B, L), PAD, dtype=np.int32)
+            words = 0
+            for r, ridx in enumerate(rows):
+                s, n = starts[ridx], lens[ridx]
+                batch[r, :n] = flat[s : s + n]
+                words += int(n)
+            yield batch, words
+
+
+def prefetch(iterator: Iterator, depth: int = 2) -> Iterator:
+    """Background-thread prefetch so host batch assembly overlaps device compute.
+
+    The device step releases the GIL while executing, so even on a 1-core host
+    this hides most of the batch-assembly latency. If the consumer abandons the
+    generator early (exception in the training loop, GeneratorExit), the
+    producer thread is signalled to stop rather than blocking forever on the
+    bounded queue.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    sentinel = object()
+    err: List[BaseException] = []
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer() -> None:
+        try:
+            for item in iterator:
+                if not _put(item):
+                    return
+        except BaseException as e:  # propagate into consumer
+            err.append(e)
+        finally:
+            _put(sentinel)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()

@@ -1,0 +1,64 @@
+"""Corpus readers.
+
+Reference equivalents:
+  - text8_corpus: whitespace token stream chunked into 1000-word
+    pseudo-sentences (reference: main.cpp:63-92). Here the path is a real
+    parameter — the reference hardcodes "text8" (main.cpp:68) and ignores its
+    own -train flag; that bug is not replicated.
+  - line_docs: one sentence per line (reference: Word2Vec.cpp:19-30).
+
+Readers are generators: the corpus streams through vocab counting and encoding
+without materializing a vector<vector<string>> like the reference does, which
+matters at enwik9 scale (~124M tokens).
+
+When the native C++ host library is available (word2vec_tpu.native), the
+tokenize/encode hot path is done there; these pure-Python readers are the
+always-available fallback and the reference semantics definition.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+DEFAULT_CHUNK_WORDS = 1000  # reference: main.cpp:66 max_sentence_len
+
+
+def text8_corpus(path: str, chunk_words: int = DEFAULT_CHUNK_WORDS) -> Iterator[List[str]]:
+    """Whitespace tokens chunked into fixed-size pseudo-sentences.
+
+    Reference: main.cpp:63-92 (chunk boundary at :80-85, trailing partial
+    sentence kept at :88-89).
+    """
+    sentence: List[str] = []
+    remainder = ""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                break
+            block = remainder + block
+            parts = block.split()
+            # A token can straddle the block boundary: hold back the tail
+            # unless the block ends in whitespace.
+            if parts and not block[-1].isspace():
+                remainder = parts.pop()
+            else:
+                remainder = ""
+            for tok in parts:
+                sentence.append(tok)
+                if len(sentence) == chunk_words:
+                    yield sentence
+                    sentence = []
+    if remainder:
+        sentence.append(remainder)
+    if sentence:
+        yield sentence
+
+
+def line_docs(path: str) -> Iterator[List[str]]:
+    """One whitespace-tokenized sentence per line (reference: Word2Vec.cpp:19-30)."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            toks = line.split()
+            if toks:
+                yield toks

@@ -1,0 +1,96 @@
+"""Huffman coding for hierarchical softmax, as padded dense arrays.
+
+The reference builds a pointer tree with a std heap and walks it with an
+explicit stack (reference: Word2Vec.cpp:32-79 `create_huffman_tree`). The
+TPU-native representation is three dense arrays sized for one device gather:
+
+    codes  [V, L] uint8  — binary code of each word, 0=left / 1=right
+                           (reference: Word2Vec.cpp:69-70), padded with 0
+    points [V, L] int32  — internal-node index along the root->leaf path
+                           (reference: Word2Vec.cpp:72-73), padded with 0
+    code_len [V] int32   — true path length; positions >= code_len are masked
+
+L = max code length (~log2 V for Zipfian corpora). Internal nodes are numbered
+0..V-2 in merge order, matching the reference's `index - vocab_size`
+(Word2Vec.cpp:73), so `points` rows index straight into the [V-1, d] hs output
+matrix (reference `synapses1`, Word2Vec.cpp:207).
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class HuffmanCoding:
+    codes: np.ndarray      # [V, L] uint8
+    points: np.ndarray     # [V, L] int32
+    code_len: np.ndarray   # [V] int32
+
+    @property
+    def max_code_len(self) -> int:
+        return self.codes.shape[1]
+
+    @property
+    def num_internal(self) -> int:
+        return self.codes.shape[0] - 1
+
+
+def build_huffman(counts: np.ndarray) -> HuffmanCoding:
+    """Build Huffman codes from word counts (descending-sorted vocab order).
+
+    Merge semantics match the reference (Word2Vec.cpp:39-49): repeatedly pop
+    the two lowest-count nodes; the first popped becomes the left child
+    (code bit 0), the second the right child (code bit 1); the merged node's
+    internal index is the merge step i, i.e. reference node index i+V minus V.
+    Heap ties are broken by node creation order (deterministic), where the
+    reference inherits std::make_heap's unspecified tie order — codes can
+    differ on ties but are equally optimal.
+    """
+    V = len(counts)
+    if V < 2:
+        raise ValueError("Huffman tree needs at least 2 words")
+
+    # heap entries: (count, creation_order, node_id)
+    # node ids: 0..V-1 leaves, V..2V-2 internal (merge step i -> id V+i)
+    heap = [(int(counts[i]), i, i) for i in range(V)]
+    heapq.heapify(heap)
+    left = np.empty(V - 1, dtype=np.int64)
+    right = np.empty(V - 1, dtype=np.int64)
+    for i in range(V - 1):
+        c1, _, n1 = heapq.heappop(heap)
+        c2, _, n2 = heapq.heappop(heap)
+        left[i] = n1
+        right[i] = n2
+        heapq.heappush(heap, (c1 + c2, V + i, V + i))
+
+    # Iterative root->leaf walk assigning codes/points
+    # (reference: Word2Vec.cpp:52-78; points hold internal indices from root).
+    code_len = np.zeros(V, dtype=np.int32)
+    codes_list: list = [None] * V
+    points_list: list = [None] * V
+    root = 2 * V - 2
+    stack = [(root, [], [])]
+    while stack:
+        node, code, points = stack.pop()
+        if node < V:
+            codes_list[node] = code
+            points_list[node] = points
+            code_len[node] = len(code)
+        else:
+            k = node - V
+            child_points = points + [k]
+            stack.append((int(left[k]), code + [0], child_points))
+            stack.append((int(right[k]), code + [1], child_points))
+
+    L = int(code_len.max())
+    codes = np.zeros((V, L), dtype=np.uint8)
+    pts = np.zeros((V, L), dtype=np.int32)
+    for w in range(V):
+        n = code_len[w]
+        codes[w, :n] = codes_list[w]
+        pts[w, :n] = points_list[w]
+    return HuffmanCoding(codes=codes, points=pts, code_len=code_len)

@@ -1,0 +1,136 @@
+"""Vocabulary construction and frequency-derived precomputes.
+
+Host-side, array-oriented replacement for the reference's pointer-based vocab
+(reference: Word.h:11-31 `class Word`, Word2Vec.cpp:132-169 `build_vocab`,
+Word2Vec.cpp:115-130 `precalc_sampling`). Instead of one heap object per word,
+the vocabulary is a struct-of-arrays: `counts[V]`, `words[V]`, plus derived
+float32 arrays that ship to the device once and stay in HBM.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, Iterable, Iterator, List, Sequence
+
+import numpy as np
+
+
+class Vocab:
+    """Sorted vocabulary with frequency-derived device arrays.
+
+    Words are sorted by descending count and indexed 0..V-1
+    (reference: Word2Vec.cpp:153-160; comparator at Word2Vec.cpp:3-6).
+    Ties are broken by first-seen order, which is deterministic — unlike the
+    reference, whose tie order depends on unordered_map iteration.
+    """
+
+    def __init__(self, words: Sequence[str], counts: np.ndarray):
+        if len(words) != len(counts):
+            raise ValueError("words and counts length mismatch")
+        self.words: List[str] = list(words)
+        self.counts: np.ndarray = np.asarray(counts, dtype=np.int64)
+        self.word2id: Dict[str, int] = {w: i for i, w in enumerate(self.words)}
+        self.total_words: int = int(self.counts.sum())
+
+    # ------------------------------------------------------------------ build
+    @classmethod
+    def build(cls, sentences: Iterable[Sequence[str]], min_count: int = 5) -> "Vocab":
+        """Count tokens, drop count < min_count, sort by descending count.
+
+        Reference: Word2Vec.cpp:134-160 (count loop, min_count filter at :145,
+        sort at :155).
+        """
+        counter: Counter = Counter()
+        for sentence in sentences:
+            counter.update(sentence)
+        return cls.from_counter(counter, min_count)
+
+    @classmethod
+    def from_counter(cls, counter: Dict[str, int], min_count: int = 5) -> "Vocab":
+        items = [(w, c) for w, c in counter.items() if c >= min_count]
+        # stable sort: descending count, ties by insertion order (deterministic)
+        items.sort(key=lambda wc: -wc[1])
+        words = [w for w, _ in items]
+        counts = np.array([c for _, c in items], dtype=np.int64)
+        return cls(words, counts)
+
+    # ------------------------------------------------------------- properties
+    def __len__(self) -> int:
+        return len(self.words)
+
+    def __contains__(self, word: str) -> bool:
+        return word in self.word2id
+
+    def __getitem__(self, word: str) -> int:
+        return self.word2id[word]
+
+    # ------------------------------------------------------------- precompute
+    def keep_probs(self, subsample_threshold: float) -> np.ndarray:
+        """Per-word keep probability for frequent-word subsampling.
+
+        word2vec.c formula, reference Word2Vec.cpp:115-130:
+            tc = threshold * total_words
+            p_keep = min((sqrt(count/tc) + 1) * tc / count, 1.0)
+        threshold <= 0 disables subsampling (all ones, Word2Vec.cpp:127-129).
+        """
+        if subsample_threshold <= 0:
+            return np.ones(len(self), dtype=np.float32)
+        tc = subsample_threshold * self.total_words
+        c = self.counts.astype(np.float64)
+        p = (np.sqrt(c / tc) + 1.0) * tc / c
+        return np.minimum(p, 1.0).astype(np.float32)
+
+    def unigram_probs(self, power: float = 0.75) -> np.ndarray:
+        """Normalized count^power negative-sampling distribution.
+
+        Replaces the reference's 1e8-entry quantized table
+        (Word2Vec.cpp:81-113) with the exact distribution; sampling uses an
+        alias table on device (see data/negative.py).
+        """
+        p = self.counts.astype(np.float64) ** power
+        p /= p.sum()
+        return p.astype(np.float64)
+
+    # -------------------------------------------------------------- encoding
+    def encode(self, sentence: Sequence[str]) -> np.ndarray:
+        """Token strings -> int32 ids, silently dropping OOV.
+
+        Reference: Word2Vec.cpp:212-230 `build_sample` (OOV drop at :223).
+        """
+        w2i = self.word2id
+        ids = [w2i[t] for t in sentence if t in w2i]
+        return np.asarray(ids, dtype=np.int32)
+
+    def encode_corpus(self, sentences: Iterable[Sequence[str]]) -> Iterator[np.ndarray]:
+        for sentence in sentences:
+            yield self.encode(sentence)
+
+    # ------------------------------------------------------------ persistence
+    def save(self, path: str) -> None:
+        """Write `index count word` lines (reference: Word2Vec.cpp:171-177)."""
+        with open(path, "w", encoding="utf-8") as f:
+            for i, (w, c) in enumerate(zip(self.words, self.counts)):
+                f.write(f"{i} {int(c)} {w}\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Vocab":
+        """Read the `index count word` format (reference: Word2Vec.cpp:179-196).
+
+        Unlike the reference's read_vocab (which trusts file order and is never
+        called by its own CLI), rows are placed at their recorded index.
+        """
+        idx: List[int] = []
+        cnt: List[int] = []
+        wrd: List[str] = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 3:
+                    continue
+                idx.append(int(parts[0]))
+                cnt.append(int(parts[1]))
+                wrd.append(parts[2])
+        order = np.argsort(np.asarray(idx))
+        words = [wrd[i] for i in order]
+        counts = np.asarray(cnt, dtype=np.int64)[order]
+        return cls(words, counts)

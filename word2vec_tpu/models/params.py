@@ -1,0 +1,71 @@
+"""Embedding-table parameters and their reference role mapping.
+
+The reference holds three matrices (Word2Vec.h:53): `W` (uniform-init), `C`
+(zeros, allocated iff ns) and `synapses1` (zeros, allocated iff hs)
+(init at Word2Vec.cpp:198-210). Their *roles* swap between models
+(SURVEY §2 "matrix-role swap"):
+
+  skip-gram:  input/projection = W,  ns-output = C,          hs-output = synapses1
+  cbow:       input/context   = C,  ns-output = W,          hs-output = synapses1
+
+This module names matrices by role, not letter:
+  emb_in      [V, d]   — gathered to form the projection h
+  emb_out_ns  [V, d]   — ns target rows (present iff negative > 0)
+  emb_out_hs  [V-1, d] — Huffman internal-node rows (present iff hs)
+
+Init faithfully follows the reference: the W-role matrix is
+uniform(-0.5, 0.5)/dim (Word2Vec.cpp:203-204), the others zero — with one
+deliberate divergence: for cbow+hs the reference never allocates its input
+matrix C at all (the SURVEY §2 latent bug: Word2Vec.cpp:208-209 vs :300), and
+a zero-init input with a zero-init hs output can never leave the origin; here
+cbow+hs gives emb_in the uniform init so training is live.
+
+Export selection (`export_matrix`) mirrors main.cpp:196-202: hs+cbow saves C
+(= emb_in here); everything else saves W (= emb_in for sg, emb_out_ns for
+cbow+ns).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Word2VecConfig
+
+Params = Dict[str, jnp.ndarray]
+
+
+def init_params(config: Word2VecConfig, vocab_size: int, key: jax.Array) -> Params:
+    d = config.word_dim
+    dtype = jnp.dtype(config.dtype)
+    uniform = (
+        jax.random.uniform(key, (vocab_size, d), jnp.float32, -0.5, 0.5) / d
+    ).astype(dtype)
+    zeros = jnp.zeros((vocab_size, d), dtype)
+
+    params: Params = {}
+    if config.model == "sg":
+        params["emb_in"] = uniform          # W, Word2Vec.cpp:330
+        if config.use_ns:
+            params["emb_out_ns"] = zeros    # C, Word2Vec.cpp:348
+    else:  # cbow
+        if config.use_ns:
+            params["emb_in"] = zeros        # C, Word2Vec.cpp:300 (zeros per :209)
+            params["emb_out_ns"] = uniform  # W, Word2Vec.cpp:310
+        else:
+            # cbow+hs bug fix (see module docstring): live init for the input.
+            params["emb_in"] = uniform
+    if config.use_hs:
+        params["emb_out_hs"] = jnp.zeros((vocab_size - 1, d), dtype)  # synapses1, :207
+    return params
+
+
+def export_matrix(params: Params, config: Word2VecConfig) -> jnp.ndarray:
+    """The matrix the reference CLI would save (main.cpp:196-202)."""
+    if config.model == "cbow" and config.use_hs:
+        return params["emb_in"]  # C, main.cpp:198-199
+    if config.model == "cbow" and config.use_ns:
+        return params["emb_out_ns"]  # W, main.cpp:201
+    return params["emb_in"]  # W for sg, main.cpp:201

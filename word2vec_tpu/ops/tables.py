@@ -1,0 +1,57 @@
+"""Frequency-derived constant tables, resident in device HBM.
+
+These are the device-side images of the reference's host structures:
+  keep_probs   <- Word::sample_probability   (Word.h:14, Word2Vec.cpp:115-130)
+  alias_*      <- the 1e8-slot unigram table (Word2Vec.cpp:81-113), replaced
+                  by an exact O(V) alias table sampled on device
+  hs_codes/points/len <- Word::codes/points  (Word.h:21-22, Word2Vec.cpp:52-78)
+
+Built once per vocabulary and donated to the jit step as captured constants.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Word2VecConfig
+from ..data.huffman import build_huffman
+from ..data.negative import build_alias_table
+from ..data.vocab import Vocab
+
+
+@dataclass
+class DeviceTables:
+    keep_probs: jnp.ndarray            # [V] f32
+    alias_accept: Optional[jnp.ndarray]  # [V] f32 (ns only)
+    alias_idx: Optional[jnp.ndarray]     # [V] i32 (ns only)
+    hs_codes: Optional[jnp.ndarray]      # [V, Lc] i8  (hs only)
+    hs_points: Optional[jnp.ndarray]     # [V, Lc] i32 (hs only)
+    hs_len: Optional[jnp.ndarray]        # [V] i32     (hs only)
+
+    @property
+    def vocab_size(self) -> int:
+        return self.keep_probs.shape[0]
+
+    @property
+    def max_code_len(self) -> int:
+        return 0 if self.hs_codes is None else self.hs_codes.shape[1]
+
+    @classmethod
+    def build(cls, vocab: Vocab, config: Word2VecConfig) -> "DeviceTables":
+        keep = jnp.asarray(vocab.keep_probs(config.subsample_threshold))
+        alias_accept = alias_idx = None
+        hs_codes = hs_points = hs_len = None
+        if config.use_ns:
+            at = build_alias_table(vocab.unigram_probs(config.ns_power))
+            alias_accept = jnp.asarray(at.accept)
+            alias_idx = jnp.asarray(at.alias)
+        if config.use_hs:
+            hc = build_huffman(np.asarray(vocab.counts))
+            hs_codes = jnp.asarray(hc.codes.astype(np.int8))
+            hs_points = jnp.asarray(hc.points)
+            hs_len = jnp.asarray(hc.code_len)
+        return cls(keep, alias_accept, alias_idx, hs_codes, hs_points, hs_len)

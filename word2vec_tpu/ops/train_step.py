@@ -1,0 +1,313 @@
+"""The fused device training step: the whole reference hot loop as one XLA program.
+
+Everything under the reference's OpenMP `parallel for` (Word2Vec.cpp:375-394)
+— subsample gate, window shrink, pair enumeration, negative draws /
+Huffman-path lookup, sigmoid scoring, SGD updates — is re-expressed here as a
+single jit-compiled, shape-static batched step over a [B, L] token matrix:
+
+    pairs:   roll-free shifted gather builds [B, L, 2W] (center, context) pairs
+             with a validity mask (replaces the j-loop at Word2Vec.cpp:339-341)
+    score:   one einsum [P,d]x[P,T,d] -> [P,T] + sigmoid (replaces the per-row
+             dot at Word2Vec.cpp:239-241 / :262-263)
+    update:  dense scatter-add of rank-1 grads into the [V, d] tables
+             (replaces the in-place += at Word2Vec.cpp:244-246 / :266-268)
+
+Hogwild's benign races (SURVEY §2) disappear: duplicate indices inside a batch
+sum deterministically in the scatter. The semantic delta vs the reference is
+gradient staleness *within* one batch (all gathers read pre-update weights),
+the standard minibatch trade-off (SURVEY §7 hard part (a)).
+
+RNG note: all randomness (subsample gate, window shrink, negative draws) is
+drawn on device from a threaded PRNG key — the counted-out replacement for the
+reference's three mt19937 streams (Word2Vec.h:55-59). Bitwise parity with the
+reference is impossible (it seeds from random_device); parity is statistical.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Word2VecConfig
+from ..models.params import Params
+from .tables import DeviceTables
+
+Metrics = Dict[str, jnp.ndarray]
+
+
+def _draw_negatives(
+    key: jax.Array, shape: Tuple[int, ...], accept: jnp.ndarray, alias: jnp.ndarray
+) -> jnp.ndarray:
+    """Alias-method unigram^0.75 draws (replaces table lookup, Word2Vec.cpp:255)."""
+    k_bucket, k_coin = jax.random.split(key)
+    v = accept.shape[0]
+    j = jax.random.randint(k_bucket, shape, 0, v, dtype=jnp.int32)
+    u = jax.random.uniform(k_coin, shape)
+    return jnp.where(u < accept[j], j, alias[j])
+
+
+def _dup_mean_scale(
+    num_rows: int, flat_idx: jnp.ndarray, flat_weight: jnp.ndarray
+) -> jnp.ndarray:
+    """1/duplicate-count scale per flattened index (see config.scatter_mean).
+
+    Returns a [len(flat_idx)] factor that normalizes a scatter-add so each
+    destination row receives the *mean* of its contributions. Rows contributed
+    to exactly once get factor 1.0 — identical to plain sum.
+    """
+    cnt = jnp.zeros((num_rows,), jnp.float32).at[flat_idx].add(flat_weight)
+    return (1.0 / jnp.maximum(cnt, 1.0))[flat_idx]
+
+
+def _score_and_update(
+    h: jnp.ndarray,          # [P, d] projection rows
+    out: jnp.ndarray,        # [Vout, d] target-side matrix
+    targets: jnp.ndarray,    # [P, T] int32 rows of `out`
+    labels: jnp.ndarray,     # [P, T] f32 in {0, 1}
+    tmask: jnp.ndarray,      # [P, T] f32 validity
+    alpha: jnp.ndarray,      # scalar LR
+    compute_dtype: jnp.dtype,
+    scatter_mean: bool,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One sigmoid-SGD objective: returns (grad_h, new_out, loss_sum, pair_count).
+
+    Implements f = sigmoid(out[target] . h); g = (label - f) * alpha;
+    grad_h += g * out[target]; out[target] += g * h
+    — the shared kernel of hierarchical_softmax (Word2Vec.cpp:239-246) and
+    negative_sampling (Word2Vec.cpp:262-268), batched over all P*T pairs.
+    """
+    d = h.shape[-1]
+    t = out[targets]  # [P, T, d]
+    logits = jnp.einsum(
+        "pd,ptd->pt",
+        h.astype(compute_dtype),
+        t.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    g = (labels - jax.nn.sigmoid(logits)) * tmask * alpha  # [P, T]
+    grad_h = jnp.einsum(
+        "pt,ptd->pd",
+        g.astype(compute_dtype),
+        t.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    grad_t = (g[:, :, None] * h[:, None, :]).astype(jnp.float32)  # [P, T, d]
+    flat_t = targets.reshape(-1)
+    vals = grad_t.reshape(-1, d)
+    if scatter_mean:
+        vals = vals * _dup_mean_scale(out.shape[0], flat_t, tmask.reshape(-1))[:, None]
+    new_out = out.at[flat_t].add(vals.astype(out.dtype))
+    # masked binary cross-entropy, for metrics only:
+    # -[y log s(x) + (1-y) log s(-x)], with log s(-x) = log s(x) - x
+    ls = jax.nn.log_sigmoid(logits)
+    loss = -jnp.sum(tmask * jnp.where(labels > 0.5, ls, ls - logits))
+    return grad_h, new_out, loss, jnp.sum(tmask)
+
+
+def make_train_step(
+    config: Word2VecConfig, tables: DeviceTables
+) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
+    """Build the jittable step(params, tokens[B,L], key, alpha) -> (params, metrics).
+
+    All config values are closed over as static; `tables` arrays become
+    captured device constants.
+    """
+    W = config.window
+    K = config.negative
+    use_ns, use_hs = config.use_ns, config.use_hs
+    is_cbow = config.model == "cbow"
+    cbow_mean = config.cbow_mean
+    scatter_mean = config.scatter_mean
+    cdt = jnp.dtype(config.compute_dtype)
+    # Static offset vector o in {-W..-1, 1..W} — the unrolled j-loop of
+    # Word2Vec.cpp:339 (j != i excluded by construction).
+    offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)]).astype(np.int32)
+    abs_off = np.abs(offsets)
+
+    def step(
+        params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
+    ) -> Tuple[Params, Metrics]:
+        B, L = tokens.shape
+        k_sub, k_win, k_neg = jax.random.split(key, 3)
+
+        valid = tokens >= 0
+        tok = jnp.where(valid, tokens, 0)
+
+        # Subsample gate on the center word only (Word2Vec.cpp:282,332).
+        keep = valid & (
+            jax.random.uniform(k_sub, (B, L)) < tables.keep_probs[tok]
+        )
+        # Per-position window shrink: reduced ~ U{0..W-1}, effective half-width
+        # w_eff = W - reduced in {1..W} (Word2Vec.cpp:285-287,335-337).
+        w_eff = W - jax.random.randint(k_win, (B, L), 0, W, dtype=jnp.int32)
+
+        # ctx[b, i, k] = tokens[b, i + offsets[k]] via padded gather.
+        tok_pad = jnp.pad(tokens, ((0, 0), (W, W)), constant_values=-1)
+        gidx = jnp.arange(L, dtype=jnp.int32)[:, None] + offsets[None, :] + W  # [L, 2W]
+        ctx = tok_pad[:, gidx]  # [B, L, 2W]
+        pair_mask = (
+            keep[:, :, None]
+            & (ctx >= 0)
+            & (jnp.asarray(abs_off)[None, None, :] <= w_eff[:, :, None])
+        )
+        ctx = jnp.where(pair_mask, ctx, 0)
+
+        new_params = dict(params)
+        loss_sum = jnp.float32(0.0)
+        pair_count = jnp.float32(0.0)
+
+        if not is_cbow:
+            # ---- skip-gram: input = center row of emb_in (W), predicted =
+            # each context word (Word2Vec.cpp:319-353).
+            P = B * L * 2 * W
+            centers = jnp.broadcast_to(tok[:, :, None], (B, L, 2 * W)).reshape(P)
+            pred = ctx.reshape(P)
+            mask = pair_mask.reshape(P)
+            h = params["emb_in"][centers]  # [P, d]
+            grad_h = jnp.zeros_like(h, dtype=jnp.float32)
+
+            if use_ns:
+                negs = _draw_negatives(
+                    k_neg, (P, K), tables.alias_accept, tables.alias_idx
+                )
+                targets = jnp.concatenate([pred[:, None], negs], axis=1)  # [P, 1+K]
+                labels = jnp.zeros((P, 1 + K), jnp.float32).at[:, 0].set(1.0)
+                # a drawn negative equal to the positive is skipped
+                # (word2vec.c semantics; the reference instead relabels it 1
+                # via its dedup map, Word2Vec.cpp:253-257)
+                tmask = (
+                    mask[:, None]
+                    & jnp.concatenate(
+                        [jnp.ones((P, 1), bool), negs != pred[:, None]], axis=1
+                    )
+                ).astype(jnp.float32)
+                gh, new_out, ls, pc = _score_and_update(
+                    h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
+                    scatter_mean,
+                )
+                grad_h += gh
+                new_params["emb_out_ns"] = new_out
+                loss_sum += ls
+                pair_count += pc
+
+            if use_hs:
+                targets = tables.hs_points[pred]  # [P, Lc]
+                labels = (1 - tables.hs_codes[pred]).astype(jnp.float32)  # :242
+                Lc = targets.shape[1]
+                tmask = (
+                    mask[:, None]
+                    & (jnp.arange(Lc, dtype=jnp.int32)[None, :] < tables.hs_len[pred][:, None])
+                ).astype(jnp.float32)
+                gh, new_out, ls, pc = _score_and_update(
+                    h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
+                    scatter_mean,
+                )
+                grad_h += gh
+                new_params["emb_out_hs"] = new_out
+                loss_sum += ls
+                pair_count += pc
+
+            # W.row(center) += grad accumulated over the center's window
+            # (Word2Vec.cpp:351). The per-position window sum is reference-
+            # exact (neu1_grad accumulates across the j-loop); only the
+            # scatter across positions sharing a center word is batched, with
+            # optional duplicate-count normalization (config.scatter_mean).
+            gh_pos = grad_h.reshape(B, L, 2 * W, -1).sum(axis=2)  # [B, L, d]
+            flat_c = tok.reshape(-1)
+            vals = gh_pos.reshape(B * L, -1)
+            if scatter_mean:
+                vals = vals * _dup_mean_scale(
+                    params["emb_in"].shape[0],
+                    flat_c,
+                    keep.reshape(-1).astype(jnp.float32),
+                )[:, None]
+            new_params["emb_in"] = params["emb_in"].at[flat_c].add(
+                vals.astype(params["emb_in"].dtype)
+            )
+        else:
+            # ---- CBOW: projection = (mean of) context rows of emb_in (C),
+            # predicted = center word (Word2Vec.cpp:273-317). Duplicate context
+            # words are NOT deduped (the reference dedups via set<size_t> at
+            # :293-298; duplicates here contribute multiplicity-weighted, as in
+            # word2vec.c/gensim).
+            P = B * L
+            ctx_rows = params["emb_in"][ctx]  # [B, L, 2W, d]
+            fmask = pair_mask.astype(ctx_rows.dtype)[..., None]
+            h_bl = jnp.sum(ctx_rows * fmask, axis=2)  # [B, L, d]
+            n_ctx = jnp.sum(pair_mask, axis=2).astype(jnp.float32)  # neu1_num, :288
+            center_ok = keep & (n_ctx > 0)  # skip if no context, :289
+            if cbow_mean:
+                h_bl = h_bl / jnp.maximum(n_ctx, 1.0)[:, :, None]  # :301-302
+            h = h_bl.reshape(P, -1)
+            pred = tok.reshape(P)
+            mask = center_ok.reshape(P)
+            grad_h = jnp.zeros_like(h, dtype=jnp.float32)
+
+            if use_ns:
+                negs = _draw_negatives(
+                    k_neg, (P, K), tables.alias_accept, tables.alias_idx
+                )
+                targets = jnp.concatenate([pred[:, None], negs], axis=1)
+                labels = jnp.zeros((P, 1 + K), jnp.float32).at[:, 0].set(1.0)
+                tmask = (
+                    mask[:, None]
+                    & jnp.concatenate(
+                        [jnp.ones((P, 1), bool), negs != pred[:, None]], axis=1
+                    )
+                ).astype(jnp.float32)
+                gh, new_out, ls, pc = _score_and_update(
+                    h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
+                    scatter_mean,
+                )
+                grad_h += gh
+                new_params["emb_out_ns"] = new_out
+                loss_sum += ls
+                pair_count += pc
+
+            if use_hs:
+                targets = tables.hs_points[pred]
+                labels = (1 - tables.hs_codes[pred]).astype(jnp.float32)
+                Lc = targets.shape[1]
+                tmask = (
+                    mask[:, None]
+                    & (jnp.arange(Lc, dtype=jnp.int32)[None, :] < tables.hs_len[pred][:, None])
+                ).astype(jnp.float32)
+                gh, new_out, ls, pc = _score_and_update(
+                    h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
+                    scatter_mean,
+                )
+                grad_h += gh
+                new_params["emb_out_hs"] = new_out
+                loss_sum += ls
+                pair_count += pc
+
+            # Fan the projection grad back to every contributing context row
+            # (Word2Vec.cpp:313-315), with the second /neu1_num under cbow_mean.
+            g_bl = grad_h.reshape(B, L, -1)
+            if cbow_mean:
+                g_bl = g_bl / jnp.maximum(n_ctx, 1.0)[:, :, None]
+            g_ctx = (g_bl[:, :, None, :] * fmask).reshape(B * L * 2 * W, -1)
+            flat_ctx = ctx.reshape(-1)
+            if scatter_mean:
+                g_ctx = g_ctx * _dup_mean_scale(
+                    params["emb_in"].shape[0],
+                    flat_ctx,
+                    pair_mask.reshape(-1).astype(jnp.float32),
+                )[:, None]
+            new_params["emb_in"] = params["emb_in"].at[flat_ctx].add(
+                g_ctx.astype(params["emb_in"].dtype)
+            )
+
+        metrics = {"loss_sum": loss_sum, "pairs": pair_count}
+        return new_params, metrics
+
+    return step
+
+
+def jit_train_step(config: Word2VecConfig, tables: DeviceTables):
+    """The step jitted with params-buffer donation (in-place table updates)."""
+    return jax.jit(make_train_step(config, tables), donate_argnums=0)

@@ -1,0 +1,141 @@
+"""Single-chip training driver.
+
+Replaces Word2Vec::train (Word2Vec.cpp:356-396): epochs over a shuffled
+corpus, linear alpha decay, progress metering — but the per-sentence OpenMP
+fan-out (:375) becomes the host->device boundary: the host streams [B, L]
+token batches, the device runs the fused jit step (ops/train_step.py).
+
+The alpha schedule follows Word2Vec.cpp:379-380:
+    alpha = max(min_alpha, init_alpha * (1 - words_done / (iters * total_words)))
+refreshed every step (the reference refreshes every 10 sentences; per-step is
+strictly finer-grained).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Word2VecConfig
+from .data.batcher import BatchIterator, PackedCorpus, prefetch
+from .data.vocab import Vocab
+from .models.params import Params, init_params
+from .ops.tables import DeviceTables
+from .ops.train_step import jit_train_step
+
+
+@dataclass
+class TrainState:
+    params: Params
+    step: int = 0
+    words_done: int = 0
+    epoch: int = 0
+
+
+@dataclass
+class TrainReport:
+    words_per_sec: float
+    total_words: int
+    steps: int
+    wall_time: float
+    final_loss: float
+    loss_history: List[float] = field(default_factory=list)
+
+
+class Trainer:
+    """End-to-end single-chip trainer (multi-chip lives in parallel/)."""
+
+    def __init__(
+        self,
+        config: Word2VecConfig,
+        vocab: Vocab,
+        corpus: PackedCorpus,
+        log_fn: Optional[Callable[[Dict], None]] = None,
+    ):
+        self.config = config
+        self.vocab = vocab
+        self.corpus = corpus
+        self.tables = DeviceTables.build(vocab, config)
+        self.step_fn = jit_train_step(config, self.tables)
+        self.log_fn = log_fn
+        self.total_words = corpus.num_tokens
+
+    def init_state(self, seed: Optional[int] = None) -> TrainState:
+        key = jax.random.key(self.config.seed if seed is None else seed)
+        params = init_params(self.config, len(self.vocab), key)
+        return TrainState(params=params)
+
+    def alpha_at(self, words_done: int) -> float:
+        cfg = self.config
+        frac = words_done / max(1, cfg.iters * self.total_words)
+        return max(cfg.min_alpha, cfg.init_alpha * (1.0 - frac))
+
+    def train(
+        self,
+        state: Optional[TrainState] = None,
+        log_every: int = 50,
+        checkpoint_cb: Optional[Callable[[TrainState], None]] = None,
+        checkpoint_every: int = 0,
+    ) -> tuple:
+        cfg = self.config
+        state = state or self.init_state()
+        batcher = BatchIterator(
+            self.corpus, cfg.batch_rows, cfg.max_sentence_len, seed=cfg.seed
+        )
+        base_key = jax.random.key(cfg.seed ^ 0x5EED)
+
+        t0 = time.perf_counter()
+        loss_hist: List[float] = []
+        last_metrics = None
+        for epoch in range(state.epoch, cfg.iters):
+            state.epoch = epoch
+            for tokens, words in prefetch(batcher.epoch()):
+                alpha = jnp.float32(self.alpha_at(state.words_done))
+                key = jax.random.fold_in(base_key, state.step)
+                state.params, metrics = self.step_fn(
+                    state.params, jnp.asarray(tokens), key, alpha
+                )
+                last_metrics = metrics
+                state.step += 1
+                state.words_done += words
+                if log_every and state.step % log_every == 0:
+                    m = jax.device_get(metrics)
+                    loss = float(m["loss_sum"]) / max(1.0, float(m["pairs"]))
+                    loss_hist.append(loss)
+                    if self.log_fn:
+                        dt = time.perf_counter() - t0
+                        self.log_fn(
+                            {
+                                "step": state.step,
+                                "epoch": epoch,
+                                "alpha": float(alpha),
+                                "loss": loss,
+                                "progress": state.words_done
+                                / (cfg.iters * self.total_words),
+                                "words_per_sec": state.words_done / max(dt, 1e-9),
+                            }
+                        )
+                if checkpoint_every and checkpoint_cb and state.step % checkpoint_every == 0:
+                    checkpoint_cb(state)
+
+        # ensure all device work is done before timing
+        jax.block_until_ready(state.params)
+        wall = time.perf_counter() - t0
+        final_loss = float("nan")
+        if last_metrics is not None:
+            m = jax.device_get(last_metrics)
+            final_loss = float(m["loss_sum"]) / max(1.0, float(m["pairs"]))
+        report = TrainReport(
+            words_per_sec=state.words_done / max(wall, 1e-9),
+            total_words=state.words_done,
+            steps=state.step,
+            wall_time=wall,
+            final_loss=final_loss,
+            loss_history=loss_hist,
+        )
+        return state, report

@@ -2,15 +2,25 @@
 
 This is the framework's "fake backend" (SURVEY §4): pjit/shard_map/psum paths
 run on 8 virtual CPU devices so the multi-chip code is exercised in CI without
-TPU hardware. Must run before the first `import jax` anywhere in the test
-process.
+TPU hardware.
+
+The TPU tunnel's sitecustomize registers the `axon` PJRT plugin and sets
+jax_platforms="axon,cpu" through jax.config at interpreter start, which beats
+any JAX_PLATFORMS env var. The config must therefore be overridden *after*
+importing jax but *before* the first backend initialization — which is exactly
+what this conftest does (pytest imports it before test modules).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA flags are read at backend init, which hasn't happened yet.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

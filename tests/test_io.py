@@ -1,0 +1,132 @@
+"""Persistence: text/binary round-trips incl. the reference's raw-int64 binary
+header (Word2Vec.cpp:402-425) and vocab-aligned loading (:468,:486).
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+from word2vec_tpu.io.embeddings import (
+    load_embeddings_binary,
+    load_embeddings_text,
+    load_word2vec,
+    save_embeddings_binary,
+    save_embeddings_text,
+    save_word2vec,
+)
+from word2vec_tpu.train import TrainState
+
+
+@pytest.fixture
+def vocab():
+    return Vocab.from_counter({"the": 100, "quick": 50, "fox": 25}, min_count=1)
+
+
+@pytest.fixture
+def matrix():
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(3, 5)).astype(np.float32)
+
+
+def test_text_roundtrip(tmp_path, vocab, matrix):
+    p = str(tmp_path / "vec.txt")
+    save_embeddings_text(p, vocab.words, matrix)
+    first = open(p).readline()
+    assert first == "3 5\n"  # `rows cols` header, Word2Vec.cpp:430
+    words, m = load_embeddings_text(p)
+    assert words == ["the", "quick", "fox"]
+    np.testing.assert_allclose(m, matrix, rtol=1e-6)
+
+
+def test_text_accepts_comma_separated(tmp_path):
+    # tolerated variant for files written by other tools
+    p = str(tmp_path / "v.txt")
+    with open(p, "w") as f:
+        f.write("2 3\n")
+        f.write("a 1.0,2.0,3.0\n")
+        f.write("b 4.0,5.0,6.0\n")
+    words, m = load_embeddings_text(p)
+    assert words == ["a", "b"]
+    np.testing.assert_allclose(m, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_binary_reference_layout(tmp_path, vocab, matrix):
+    p = str(tmp_path / "vec.bin")
+    save_embeddings_binary(p, vocab.words, matrix, layout="reference")
+    raw = open(p, "rb").read()
+    # header: 8-byte rows, ' ', 8-byte cols, '\n' (Word2Vec.cpp:410-415)
+    assert struct.unpack("<q", raw[:8])[0] == 3
+    assert raw[8:9] == b" "
+    assert struct.unpack("<q", raw[9:17])[0] == 5
+    assert raw[17:18] == b"\n"
+    # first record: 'the' + ' ' + 5 raw f32 + '\n' (Word2Vec.cpp:417-423)
+    assert raw[18:22] == b"the "
+    np.testing.assert_allclose(
+        np.frombuffer(raw[22:42], dtype="<f4"), matrix[0], rtol=1e-6
+    )
+    words, m = load_embeddings_binary(p, layout="reference")
+    assert words == vocab.words
+    np.testing.assert_allclose(m, matrix)
+
+
+def test_binary_google_layout(tmp_path, vocab, matrix):
+    p = str(tmp_path / "vec.gbin")
+    save_embeddings_binary(p, vocab.words, matrix, layout="google")
+    raw = open(p, "rb").read()
+    assert raw.startswith(b"3 5\n")  # ASCII header (word2vec.c format)
+    words, m = load_embeddings_binary(p, layout="google")
+    assert words == vocab.words
+    np.testing.assert_allclose(m, matrix)
+
+
+def test_load_with_vocab_alignment(tmp_path, vocab, matrix):
+    # file in shuffled order; loading with vocab must land rows on indices
+    p = str(tmp_path / "v.txt")
+    order = [2, 0, 1]
+    save_embeddings_text(p, [vocab.words[i] for i in order], matrix[order])
+    words, m = load_word2vec(p, vocab=vocab)
+    assert words == vocab.words
+    np.testing.assert_allclose(m, matrix, rtol=1e-6)
+
+
+def test_save_word2vec_dispatch(tmp_path, vocab, matrix):
+    pt = str(tmp_path / "a.txt")
+    pb = str(tmp_path / "a.bin")
+    save_word2vec(pt, vocab, matrix, binary=False)
+    save_word2vec(pb, vocab, matrix, binary=True)
+    _, mt = load_word2vec(pt)
+    _, mb = load_word2vec(pb, binary=True)
+    np.testing.assert_allclose(mt, mb)
+
+
+def test_mismatched_rows_rejected(tmp_path, vocab):
+    with pytest.raises(ValueError):
+        save_embeddings_text(str(tmp_path / "x"), vocab.words, np.zeros((2, 4)))
+
+
+def test_checkpoint_roundtrip(tmp_path, vocab):
+    import jax.numpy as jnp
+
+    cfg = Word2VecConfig(negative=5, word_dim=4)
+    params = {
+        "emb_in": jnp.arange(12, dtype=jnp.float32).reshape(3, 4),
+        "emb_out_ns": jnp.ones((3, 4), jnp.float32),
+    }
+    state = TrainState(params=params, step=17, words_done=1234, epoch=2)
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, state, cfg, vocab)
+    s2, c2, v2 = load_checkpoint(path)
+    assert s2.step == 17 and s2.words_done == 1234 and s2.epoch == 2
+    assert c2.negative == 5 and c2.word_dim == 4
+    assert v2.words == vocab.words
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(s2.params[k]), np.asarray(params[k]))
+    # overwrite with newer state must be atomic-replace, not merge
+    state.step = 18
+    save_checkpoint(path, state, cfg, vocab)
+    s3, _, _ = load_checkpoint(path)
+    assert s3.step == 18

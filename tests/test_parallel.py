@@ -1,0 +1,152 @@
+"""Multi-chip paths on 8 virtual CPU devices (SURVEY §4 "distributed-without-
+a-cluster"): tensor-parallel must match single-chip numerics exactly; data-
+parallel must equal hand-computed per-shard steps + averaging.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.models.params import init_params
+from word2vec_tpu.ops.tables import DeviceTables
+from word2vec_tpu.ops.train_step import make_train_step
+from word2vec_tpu.parallel import (
+    ShardedTrainer,
+    make_mesh,
+    make_sharded_step,
+    make_sync,
+    replicate_params,
+)
+
+V, D = 50, 16
+ALPHA = 0.02
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def setup(model="sg", train_method="ns", negative=3):
+    cfg = Word2VecConfig(
+        model=model, train_method=train_method, negative=negative,
+        word_dim=D, window=3, min_count=1, subsample_threshold=0,
+    )
+    counts = {f"w{i}": 100 - i for i in range(V)}
+    vocab = Vocab.from_counter(counts, min_count=1)
+    tables = DeviceTables.build(vocab, cfg)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, V, size=(8, 24)).astype(np.int32)
+    key = jax.random.key(42)
+    params = init_params(cfg, V, jax.random.key(7))
+    return cfg, tables, tokens, key, params
+
+
+@pytest.mark.parametrize("tm", ["ns", "hs"])
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_tensor_parallel_matches_single_chip(model, tm):
+    """tp=4: dim-sharded step must reproduce single-chip results (the psum of
+    partial dots is the same sum, just reassociated)."""
+    neg = 3 if tm == "ns" else 0
+    cfg, tables, tokens, key, params = setup(model, tm, neg)
+
+    single = jax.jit(make_train_step(cfg, tables))
+    ref_out, ref_metrics = single(params, jnp.asarray(tokens), key, jnp.float32(ALPHA))
+
+    mesh = make_mesh(dp=1, tp=4)
+    sharded = make_sharded_step(cfg, tables, mesh)
+    repl = replicate_params(params, mesh)
+    out, metrics = sharded(repl, jnp.asarray(tokens), key, jnp.float32(ALPHA))
+
+    for k in ref_out:
+        np.testing.assert_allclose(
+            np.asarray(out[k][0]), np.asarray(ref_out[k]), atol=5e-5, err_msg=k
+        )
+    assert float(metrics["pairs"]) == pytest.approx(float(ref_metrics["pairs"]))
+    np.testing.assert_allclose(
+        float(metrics["loss_sum"]), float(ref_metrics["loss_sum"]), rtol=1e-4
+    )
+
+
+def test_data_parallel_matches_manual_shards():
+    """dp=2: the sharded step must equal two independent single-chip steps on
+    the two token halves (with the per-shard folded keys), and sync must
+    average the replicas."""
+    cfg, tables, tokens, key, params = setup()
+    mesh = make_mesh(dp=2, tp=1)
+    sharded = make_sharded_step(cfg, tables, mesh)
+    sync = make_sync(mesh)
+
+    repl = replicate_params(params, mesh)
+    out, _ = sharded(repl, jnp.asarray(tokens), key, jnp.float32(ALPHA))
+
+    # manual: shard i trains tokens[i*4:(i+1)*4] with key fold_in(key, i)
+    single = jax.jit(make_train_step(cfg, tables, dp_axis=None))
+    manual = []
+    for i in range(2):
+        ki = jax.random.fold_in(key, i)
+        m, _ = single(params, jnp.asarray(tokens[i * 4 : (i + 1) * 4]), ki,
+                      jnp.float32(ALPHA))
+        manual.append(m)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(out[k][0]), np.asarray(manual[0][k]), atol=5e-5, err_msg=k
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[k][1]), np.asarray(manual[1][k]), atol=5e-5, err_msg=k
+        )
+
+    synced = sync(out)
+    for k in params:
+        avg = (np.asarray(manual[0][k]) + np.asarray(manual[1][k])) / 2
+        np.testing.assert_allclose(np.asarray(synced[k][0]), avg, atol=5e-5)
+        np.testing.assert_allclose(
+            np.asarray(synced[k][0]), np.asarray(synced[k][1]), atol=0
+        )
+
+
+def test_dp_times_tp_composite_runs():
+    cfg, tables, tokens, key, params = setup()
+    mesh = make_mesh(dp=2, tp=4)
+    sharded = make_sharded_step(cfg, tables, mesh)
+    sync = make_sync(mesh)
+    repl = replicate_params(params, mesh)
+    out, metrics = sharded(repl, jnp.asarray(tokens), key, jnp.float32(ALPHA))
+    out = sync(out)
+    for k, v in out.items():
+        assert v.shape == (2, *params[k].shape)
+        assert np.all(np.isfinite(np.asarray(v))), k
+    assert float(metrics["pairs"]) > 0
+
+
+def test_sharded_trainer_end_to_end():
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        min_count=1, subsample_threshold=0, iters=2, batch_rows=4,
+        max_sentence_len=12, init_alpha=0.05, dp_sync_every=4,
+    )
+    rng = np.random.default_rng(3)
+    sents = [[f"w{j}" for j in rng.integers(0, 20, size=10)] for _ in range(200)]
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    logs = []
+    tr = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2, log_fn=logs.append)
+    state, report = tr.train(log_every=5)
+    assert report.total_words == corpus.num_tokens * cfg.iters
+    exported = tr.export_params(state)
+    for k, v in exported.items():
+        assert np.all(np.isfinite(v)), k
+    assert exported["emb_in"].shape == (len(vocab), 16)
+    assert len(logs) > 0 and np.isfinite(logs[-1]["loss"])
+
+
+def test_word_dim_divisibility_enforced():
+    cfg = Word2VecConfig(word_dim=10, negative=2, min_count=1)
+    vocab = Vocab.from_counter({f"w{i}": 5 for i in range(10)}, min_count=1)
+    corpus = PackedCorpus.pack([np.arange(10, dtype=np.int32)], 16)
+    with pytest.raises(ValueError, match="divisible"):
+        ShardedTrainer(cfg, vocab, corpus, dp=1, tp=4)

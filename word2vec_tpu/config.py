@@ -71,6 +71,10 @@ class Word2VecConfig:
     # sequential-like contraction. Set False for reference-exact sum semantics.
     scatter_mean: bool = True
 
+    # --- multi-chip (no reference counterpart; replaces OpenMP Hogwild) ---
+    # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
+    dp_sync_every: int = 64
+
     def __post_init__(self) -> None:
         if self.min_alpha is None:
             self.min_alpha = self.init_alpha * 1e-4

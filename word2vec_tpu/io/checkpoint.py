@@ -1,0 +1,88 @@
+"""Checkpoint / resume.
+
+The reference has only dormant partial persistence (vocab + embedding files,
+never reloaded by its CLI — SURVEY §5). Here checkpointing is first-class:
+a checkpoint captures the full training state {params, step, words_done,
+epoch, config} plus the vocabulary, so an interrupted run resumes exactly on
+the alpha schedule (Word2Vec.cpp:379-380 depends only on words_done).
+
+Format: one directory per checkpoint —
+    state.npz     all embedding tables + integer counters
+    config.json   the Word2VecConfig
+    vocab.txt     `index count word` lines (reference format, Word2Vec.cpp:171)
+Writes are atomic (tmp dir + rename), so a crash mid-save never corrupts the
+latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Word2VecConfig
+from ..data.vocab import Vocab
+from ..train import TrainState
+
+
+def save_checkpoint(path: str, state: TrainState, config: Word2VecConfig,
+                    vocab: Optional[Vocab] = None) -> None:
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=parent, prefix=".ckpt_tmp_")
+    try:
+        arrays = {k: np.asarray(v) for k, v in state.params.items()}
+        np.savez(
+            os.path.join(tmp, "state.npz"),
+            __step=np.int64(state.step),
+            __words_done=np.int64(state.words_done),
+            __epoch=np.int64(state.epoch),
+            **arrays,
+        )
+        with open(os.path.join(tmp, "config.json"), "w") as f:
+            json.dump(dataclasses.asdict(config), f, indent=2)
+        if vocab is not None:
+            vocab.save(os.path.join(tmp, "vocab.txt"))
+        # Atomic overwrite: move the old checkpoint aside first so a crash at
+        # any point leaves either the old or the new checkpoint recoverable
+        # (the loader falls back to `<path>.old`).
+        backup = path + ".old"
+        if os.path.isdir(path):
+            if os.path.isdir(backup):
+                shutil.rmtree(backup)
+            os.replace(path, backup)
+        os.replace(tmp, path)
+        shutil.rmtree(backup, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_checkpoint(path: str) -> Tuple[TrainState, Word2VecConfig, Optional[Vocab]]:
+    if not os.path.exists(os.path.join(path, "state.npz")):
+        backup = path + ".old"
+        if os.path.exists(os.path.join(backup, "state.npz")):
+            path = backup  # crash landed between move-aside and replace
+    with np.load(os.path.join(path, "state.npz")) as z:
+        params = {
+            k: jnp.asarray(z[k]) for k in z.files if not k.startswith("__")
+        }
+        state = TrainState(
+            params=params,
+            step=int(z["__step"]),
+            words_done=int(z["__words_done"]),
+            epoch=int(z["__epoch"]),
+        )
+    with open(os.path.join(path, "config.json")) as f:
+        raw = json.load(f)
+    known = {f.name for f in dataclasses.fields(Word2VecConfig)}
+    config = Word2VecConfig(**{k: v for k, v in raw.items() if k in known})
+    vocab_path = os.path.join(path, "vocab.txt")
+    vocab = Vocab.load(vocab_path) if os.path.exists(vocab_path) else None
+    return state, config, vocab

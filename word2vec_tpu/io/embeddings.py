@@ -1,0 +1,151 @@
+"""Embedding persistence: reference-compatible text and binary formats.
+
+Reference: Word2Vec.cpp:398-438 `save_word2vec`, :440-495 `load_word2vec`.
+
+Text format (Word2Vec.cpp:427-437): header line `rows cols`, then one line per
+word `word v1 v2 ... vd`. The writer uses an Eigen IOFormat *named*
+CommaInitFmt, but constructed as `IOFormat(StreamPrecision, DontAlignCols)`
+(:400) — Eigen's default coefficient separator is a single space — so the
+on-disk format is space-separated and identical to word2vec.c / gensim's
+`.txt` format. (SURVEY §2 calls it comma-separated; the reference source says
+otherwise.)
+
+Binary format (Word2Vec.cpp:402-425): two raw 8-byte little-endian int64 dims
+separated by ' ' and terminated by '\n', then per word: utf-8 word bytes,
+' ', d raw float32s, '\n'. This differs from google's word2vec.bin (whose
+header is ASCII); both are supported via `layout=`.
+
+Rows are written in vocab-index order (the reference iterates `vocab` which is
+index-sorted, :417,:432).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.vocab import Vocab
+
+
+def save_embeddings_text(path: str, words: Sequence[str], matrix: np.ndarray) -> None:
+    """`rows cols` header + `word v1 ... vd` lines (Word2Vec.cpp:427-437)."""
+    m = np.asarray(matrix, dtype=np.float32)
+    if len(words) != m.shape[0]:
+        raise ValueError(f"{len(words)} words vs {m.shape[0]} rows")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(f"{m.shape[0]} {m.shape[1]}\n")
+        for w, row in zip(words, m):
+            f.write(w + " " + " ".join(repr(float(x)) for x in row) + "\n")
+
+
+def load_embeddings_text(path: str) -> Tuple[List[str], np.ndarray]:
+    """Parse the text format (loader mirror: Word2Vec.cpp:473-494)."""
+    with open(path, "r", encoding="utf-8") as f:
+        header = f.readline().split()
+        rows, cols = int(header[0]), int(header[1])
+        words: List[str] = []
+        mat = np.empty((rows, cols), dtype=np.float32)
+        for i in range(rows):
+            parts = f.readline().rstrip("\n").split(" ")
+            words.append(parts[0])
+            # tolerate the reference's trailing-space quirk by filtering empties
+            vals = [p for p in parts[1:] if p]
+            # word2vec.c-style files may also separate with commas if written
+            # by other tools; accept both
+            if len(vals) == 1 and "," in vals[0]:
+                vals = vals[0].split(",")
+            mat[i] = np.asarray(vals[:cols], dtype=np.float32)
+    return words, mat
+
+
+def save_embeddings_binary(
+    path: str, words: Sequence[str], matrix: np.ndarray, layout: str = "reference"
+) -> None:
+    """Binary save. layout='reference' (Word2Vec.cpp:402-425) or 'google'."""
+    m = np.ascontiguousarray(matrix, dtype=np.float32)
+    if len(words) != m.shape[0]:
+        raise ValueError(f"{len(words)} words vs {m.shape[0]} rows")
+    with open(path, "wb") as f:
+        if layout == "reference":
+            # raw int64 dims: out.write((char*)&r, 8); ' '; cols; '\n'
+            f.write(struct.pack("<q", m.shape[0]) + b" ")
+            f.write(struct.pack("<q", m.shape[1]) + b"\n")
+        elif layout == "google":
+            f.write(f"{m.shape[0]} {m.shape[1]}\n".encode())
+        else:
+            raise ValueError(f"unknown layout {layout!r}")
+        for w, row in zip(words, m):
+            f.write(w.encode("utf-8") + b" " + row.tobytes() + b"\n")
+
+
+def load_embeddings_binary(
+    path: str, layout: str = "reference"
+) -> Tuple[List[str], np.ndarray]:
+    """Binary load (loader mirror: Word2Vec.cpp:442-471)."""
+    with open(path, "rb") as f:
+        if layout == "reference":
+            rows = struct.unpack("<q", f.read(8))[0]
+            f.read(1)  # ' '
+            cols = struct.unpack("<q", f.read(8))[0]
+            f.read(1)  # '\n'
+        elif layout == "google":
+            header = b""
+            while not header.endswith(b"\n"):
+                header += f.read(1)
+            rows, cols = (int(x) for x in header.split())
+        else:
+            raise ValueError(f"unknown layout {layout!r}")
+        words: List[str] = []
+        mat = np.empty((rows, cols), dtype=np.float32)
+        row_bytes = cols * 4
+        for i in range(rows):
+            wb = bytearray()
+            while True:
+                c = f.read(1)
+                if not c or c == b" ":
+                    break
+                wb += c
+            words.append(wb.decode("utf-8"))
+            mat[i] = np.frombuffer(f.read(row_bytes), dtype="<f4")
+            f.read(1)  # '\n'
+    return words, mat
+
+
+def save_word2vec(
+    path: str,
+    vocab: Vocab,
+    matrix: np.ndarray,
+    binary: bool = False,
+    layout: str = "reference",
+) -> None:
+    """CLI-level save in vocab order (reference: main.cpp:196-202 + :398)."""
+    if binary:
+        save_embeddings_binary(path, vocab.words, matrix, layout=layout)
+    else:
+        save_embeddings_text(path, vocab.words, matrix)
+
+
+def load_word2vec(
+    path: str, vocab: Optional[Vocab] = None, binary: bool = False,
+    layout: str = "reference",
+) -> Tuple[List[str], np.ndarray]:
+    """Load embeddings; with a vocab, rows are re-ordered to vocab indices.
+
+    The reference loader writes rows into W at vocab_hash[text]->index
+    (Word2Vec.cpp:468,:486), i.e. it requires a prebuilt vocab; passing
+    `vocab` reproduces that alignment, without it the file order is returned.
+    """
+    words, mat = (
+        load_embeddings_binary(path, layout=layout)
+        if binary
+        else load_embeddings_text(path)
+    )
+    if vocab is None:
+        return words, mat
+    out = np.zeros((len(vocab), mat.shape[1]), dtype=np.float32)
+    for w, row in zip(words, mat):
+        if w in vocab:
+            out[vocab[w]] = row
+    return list(vocab.words), out

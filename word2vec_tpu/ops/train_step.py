@@ -72,6 +72,7 @@ def _score_and_update(
     alpha: jnp.ndarray,      # scalar LR
     compute_dtype: jnp.dtype,
     scatter_mean: bool,
+    tp_axis: str | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One sigmoid-SGD objective: returns (grad_h, new_out, loss_sum, pair_count).
 
@@ -79,6 +80,13 @@ def _score_and_update(
     grad_h += g * out[target]; out[target] += g * h
     — the shared kernel of hierarchical_softmax (Word2Vec.cpp:239-246) and
     negative_sampling (Word2Vec.cpp:262-268), batched over all P*T pairs.
+
+    Tensor parallelism: with `tp_axis` set (inside shard_map), `h` and `out`
+    hold the local d/TP slice of the embedding dim; the partial dot products
+    are psum'd over the mesh axis so the sigmoid sees full logits, after which
+    every gradient is purely local to the dim shard. The only communication
+    per objective is the [P, T] logit psum — a few hundred KB over ICI, vs the
+    [V, d] tables that never move.
     """
     d = h.shape[-1]
     t = out[targets]  # [P, T, d]
@@ -88,6 +96,8 @@ def _score_and_update(
         t.astype(compute_dtype),
         preferred_element_type=jnp.float32,
     )
+    if tp_axis is not None:
+        logits = jax.lax.psum(logits, tp_axis)
     g = (labels - jax.nn.sigmoid(logits)) * tmask * alpha  # [P, T]
     grad_h = jnp.einsum(
         "pt,ptd->pd",
@@ -109,12 +119,25 @@ def _score_and_update(
 
 
 def make_train_step(
-    config: Word2VecConfig, tables: DeviceTables
+    config: Word2VecConfig,
+    tables: DeviceTables,
+    tp_axis: str | None = None,
+    dp_axis: str | None = None,
 ) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
     """Build the jittable step(params, tokens[B,L], key, alpha) -> (params, metrics).
 
     All config values are closed over as static; `tables` arrays become
     captured device constants.
+
+    Mesh axes (both None for single chip; set by parallel/ inside shard_map):
+      tp_axis: embedding dim is sharded over this axis; logits are psum'd
+               (see _score_and_update). All index/mask computation is
+               replicated across tp shards (same key => same draws).
+      dp_axis: each shard trains an independent replica on its own data;
+               the PRNG key is folded with the shard index so negative/window
+               draws decorrelate. Replicas are periodically averaged by
+               parallel.sync_params (the TPU-native analog of Hogwild's shared
+               memory, SURVEY §5 "distributed communication backend").
     """
     W = config.window
     K = config.negative
@@ -132,6 +155,8 @@ def make_train_step(
         params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
     ) -> Tuple[Params, Metrics]:
         B, L = tokens.shape
+        if dp_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
         k_sub, k_win, k_neg = jax.random.split(key, 3)
 
         valid = tokens >= 0
@@ -187,7 +212,7 @@ def make_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc = _score_and_update(
                     h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean,
+                    scatter_mean, tp_axis,
                 )
                 grad_h += gh
                 new_params["emb_out_ns"] = new_out
@@ -204,7 +229,7 @@ def make_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc = _score_and_update(
                     h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean,
+                    scatter_mean, tp_axis,
                 )
                 grad_h += gh
                 new_params["emb_out_hs"] = new_out
@@ -261,7 +286,7 @@ def make_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc = _score_and_update(
                     h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean,
+                    scatter_mean, tp_axis,
                 )
                 grad_h += gh
                 new_params["emb_out_ns"] = new_out
@@ -278,7 +303,7 @@ def make_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc = _score_and_update(
                     h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean,
+                    scatter_mean, tp_axis,
                 )
                 grad_h += gh
                 new_params["emb_out_hs"] = new_out

@@ -1,0 +1,27 @@
+"""Multi-chip parallelism: mesh construction, sharded step, replica sync.
+
+See mesh.py for the axis design (data x model) and trainer.py for the
+sharded training loop. CI exercises these on 8 virtual CPU devices
+(tests/conftest.py); the driver's dryrun_multichip does the same via
+__graft_entry__.py.
+"""
+
+from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from .trainer import (
+    ShardedTrainer,
+    make_sharded_step,
+    make_sync,
+    replicate_params,
+    unreplicate_params,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "ShardedTrainer",
+    "make_sharded_step",
+    "make_sync",
+    "replicate_params",
+    "unreplicate_params",
+]

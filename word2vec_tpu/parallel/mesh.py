@@ -1,0 +1,50 @@
+"""Device-mesh construction for multi-chip training.
+
+The reference's entire parallelism story is shared-memory OpenMP threads
+(main.cpp:186, Word2Vec.cpp:375). The TPU-native replacement is a 2-D
+jax.sharding.Mesh:
+
+  axis "data"  — data parallelism: each shard holds an independent replica of
+                 the embedding tables and trains on its own corpus shard;
+                 replicas are periodically psum-averaged over ICI (the analog
+                 of Hogwild's shared memory, and of the parameter-averaging
+                 the reference never had; BASELINE.json north star).
+  axis "model" — tensor parallelism: the embedding *dimension* is sharded;
+                 each chip holds [V, d/TP] of every table and only [P, T]
+                 logit partial-sums cross the interconnect (see
+                 ops/train_step._score_and_update).
+
+Both axes compose; (dp, tp) = (N, 1) is pure data parallel, (1, N) pure
+tensor parallel. word2vec has no layer pipeline and no attention sequence
+axis, so PP/SP/CP do not apply (SURVEY §5 "long-context": device cost is made
+sequence-length-independent by fixed-shape batching instead).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    dp: int, tp: int, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """A (dp, tp) mesh over the first dp*tp available devices.
+
+    On real hardware, `jax.devices()` order follows the torus topology, so
+    adjacent mesh coordinates map to ICI neighbors; the `model` axis is the
+    fastest-varying (innermost) so the per-step logit psum rides the
+    tightest ICI ring.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * tp
+    if need > len(devices):
+        raise ValueError(f"mesh ({dp}x{tp}) needs {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(dp, tp)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))

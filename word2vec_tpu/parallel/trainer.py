@@ -1,0 +1,187 @@
+"""Multi-chip training: shard_map step + periodic replica averaging.
+
+Layout (see parallel/mesh.py for the axes):
+  params   — every table carries a leading replica axis: [DP, V, d], sharded
+             PartitionSpec("data", None, "model"). Each data shard trains its
+             own replica slice [1, V, d/TP]; each model shard holds a dim
+             slice. HBM per chip: V * d / TP floats per table.
+  tokens   — global [DP*B, L], PartitionSpec("data", None): each data shard
+             consumes its own corpus slice.
+  step     — ops/train_step with tp_axis/dp_axis bound; inside one step the
+             only cross-chip traffic is the [P, T] logit psum on the model
+             axis (tensor parallelism).
+  sync     — every dp_sync_every steps, replicas are pmean-averaged over the
+             data axis (ICI all-reduce). This replaces the reference's shared-
+             memory Hogwild (Word2Vec.cpp:375-394) and is the BASELINE.json
+             north-star design ("periodically psum the embedding matrices
+             over ICI").
+
+ShardedTrainer subclasses train.Trainer: the epoch loop, alpha schedule,
+metering and checkpoint hooks are inherited; only param layout, batch
+placement, and the sync hooks differ.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Word2VecConfig
+from ..data.batcher import BatchIterator, PackedCorpus
+from ..data.vocab import Vocab
+from ..models.params import Params, init_params
+from ..ops.tables import DeviceTables
+from ..ops.train_step import make_train_step
+from ..train import Trainer, TrainState
+from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+PARAM_SPEC = P(DATA_AXIS, None, MODEL_AXIS)
+TOKEN_SPEC = P(DATA_AXIS, None)
+
+
+def replicate_params(params: Params, mesh: Mesh) -> Params:
+    """[V, d] -> [DP, V, d] identical replicas, sharded over the mesh.
+
+    The replicated view is built host-side with np.broadcast_to (zero-copy);
+    device_put then places only each shard's slice, so no single device ever
+    materializes the full [DP, V, d] array.
+    """
+    dp = mesh.shape[DATA_AXIS]
+    sharding = NamedSharding(mesh, PARAM_SPEC)
+    return {
+        k: jax.device_put(np.broadcast_to(np.asarray(v), (dp, *v.shape)), sharding)
+        for k, v in params.items()
+    }
+
+
+def unreplicate_params(params: Params) -> Params:
+    """[DP, V, d] -> [V, d]; call after a sync so replicas are equal."""
+    return {k: v[0] for k, v in params.items()}
+
+
+def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
+    """Jitted global-array step over the mesh (donates params)."""
+    dp = mesh.shape[DATA_AXIS]
+    tp = mesh.shape[MODEL_AXIS]
+    inner = make_train_step(
+        config,
+        tables,
+        tp_axis=MODEL_AXIS if tp > 1 else None,
+        dp_axis=DATA_AXIS if dp > 1 else None,
+    )
+
+    def local_step(params, tokens, key, alpha):
+        # local views: params [1, V, d/TP], tokens [B, L]
+        p = {k: v[0] for k, v in params.items()}
+        new_p, metrics = inner(p, tokens, key, alpha)
+        # loss/pairs are computed from full (psum'd) logits, so every model
+        # shard already holds the same value; psum/tp collapses the model axis
+        # (and proves replication to the vma checker), psum over data sums the
+        # genuinely distinct per-shard contributions.
+        metrics = {
+            k: jax.lax.psum(jax.lax.psum(v, MODEL_AXIS) / tp, DATA_AXIS)
+            for k, v in metrics.items()
+        }
+        return {k: v[None] for k, v in new_p.items()}, metrics
+
+    def stepfn(params, tokens, key, alpha):
+        specs = {k: PARAM_SPEC for k in params}
+        return jax.shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(specs, TOKEN_SPEC, P(), P()),
+            out_specs=(specs, P()),
+        )(params, tokens, key, alpha)
+
+    return jax.jit(stepfn, donate_argnums=0)
+
+
+def make_sync(mesh: Mesh):
+    """Jitted pmean of all replicas over the data axis (ICI all-reduce)."""
+
+    def syncfn(params):
+        specs = {k: PARAM_SPEC for k in params}
+
+        def local(p):
+            return {k: jax.lax.pmean(v, DATA_AXIS) for k, v in p.items()}
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(specs,), out_specs=specs
+        )(params)
+
+    return jax.jit(syncfn, donate_argnums=0)
+
+
+class ShardedTrainer(Trainer):
+    """Data+tensor-parallel trainer. dp*tp must not exceed len(jax.devices())."""
+
+    def __init__(
+        self,
+        config: Word2VecConfig,
+        vocab: Vocab,
+        corpus: PackedCorpus,
+        dp: int = 1,
+        tp: int = 1,
+        mesh: Optional[Mesh] = None,
+        log_fn=None,
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh(dp, tp)
+        self.dp = self.mesh.shape[DATA_AXIS]
+        self.tp = self.mesh.shape[MODEL_AXIS]
+        # validate against the *resolved* mesh, not the constructor args
+        if config.word_dim % self.tp != 0:
+            raise ValueError(
+                f"word_dim {config.word_dim} not divisible by tp={self.tp}"
+            )
+        self.token_sharding = NamedSharding(self.mesh, TOKEN_SPEC)
+        super().__init__(config, vocab, corpus, log_fn=log_fn)
+
+    # ---------------------------------------------------------------- hooks
+    def _build_step(self) -> None:
+        self.step_fn = make_sharded_step(self.config, self.tables, self.mesh)
+        self.sync_fn = make_sync(self.mesh)
+
+    def _init_params(self, key: jax.Array) -> Params:
+        return replicate_params(
+            init_params(self.config, len(self.vocab), key), self.mesh
+        )
+
+    def _batches(self, batcher: BatchIterator) -> Iterator[Tuple[jnp.ndarray, int]]:
+        """Group dp consecutive [B, L] batches into one sharded [DP*B, L]."""
+        buf, words = [], 0
+        for tokens, w in batcher.epoch():
+            buf.append(tokens)
+            words += w
+            if len(buf) == self.dp:
+                yield jax.device_put(
+                    np.concatenate(buf, axis=0), self.token_sharding
+                ), words
+                buf, words = [], 0
+        if buf:
+            # pad the trailing global batch with empty rows
+            pad = [np.full_like(buf[0], -1)] * (self.dp - len(buf))
+            yield jax.device_put(
+                np.concatenate(buf + pad, axis=0), self.token_sharding
+            ), words
+
+    def _post_step(self, state: TrainState) -> None:
+        cfg = self.config
+        if self.dp > 1 and cfg.dp_sync_every and state.step % cfg.dp_sync_every == 0:
+            state.params = self.sync_fn(state.params)
+
+    def _finalize(self, state: TrainState) -> None:
+        if self.dp > 1:
+            state.params = self.sync_fn(state.params)
+
+    # ----------------------------------------------------------------- api
+    def export_params(self, state: TrainState) -> Params:
+        """Synced, de-replicated [V, d] tables on host."""
+        params = state.params
+        if self.dp > 1:
+            params = self.sync_fn(params)
+            state.params = params
+        return {k: np.asarray(v[0]) for k, v in params.items()}

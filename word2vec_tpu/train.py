@@ -1,4 +1,4 @@
-"""Single-chip training driver.
+"""Training driver.
 
 Replaces Word2Vec::train (Word2Vec.cpp:356-396): epochs over a shuffled
 corpus, linear alpha decay, progress metering — but the per-sentence OpenMP
@@ -9,13 +9,17 @@ The alpha schedule follows Word2Vec.cpp:379-380:
     alpha = max(min_alpha, init_alpha * (1 - words_done / (iters * total_words)))
 refreshed every step (the reference refreshes every 10 sentences; per-step is
 strictly finer-grained).
+
+`Trainer` is the single-chip driver; `parallel.ShardedTrainer` subclasses it,
+overriding only the batch-placement / step / sync hooks, so the epoch loop,
+alpha schedule, metering and checkpointing live in exactly one place.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,7 +52,7 @@ class TrainReport:
 
 
 class Trainer:
-    """End-to-end single-chip trainer (multi-chip lives in parallel/)."""
+    """End-to-end single-chip trainer (multi-chip: parallel.ShardedTrainer)."""
 
     def __init__(
         self,
@@ -61,14 +65,32 @@ class Trainer:
         self.vocab = vocab
         self.corpus = corpus
         self.tables = DeviceTables.build(vocab, config)
-        self.step_fn = jit_train_step(config, self.tables)
         self.log_fn = log_fn
         self.total_words = corpus.num_tokens
+        self._build_step()
 
+    # ---------------------------------------------------------------- hooks
+    def _build_step(self) -> None:
+        self.step_fn = jit_train_step(self.config, self.tables)
+
+    def _init_params(self, key: jax.Array) -> Params:
+        return init_params(self.config, len(self.vocab), key)
+
+    def _batches(self, batcher: BatchIterator) -> Iterator[Tuple[jnp.ndarray, int]]:
+        """Yield (device-ready tokens, words) for one epoch."""
+        for tokens, words in batcher.epoch():
+            yield jnp.asarray(tokens), words
+
+    def _post_step(self, state: TrainState) -> None:
+        """Called after every optimizer step (sharded: periodic sync)."""
+
+    def _finalize(self, state: TrainState) -> None:
+        """Called once after the last epoch (sharded: final sync)."""
+
+    # ----------------------------------------------------------------- api
     def init_state(self, seed: Optional[int] = None) -> TrainState:
         key = jax.random.key(self.config.seed if seed is None else seed)
-        params = init_params(self.config, len(self.vocab), key)
-        return TrainState(params=params)
+        return TrainState(params=self._init_params(key))
 
     def alpha_at(self, words_done: int) -> float:
         cfg = self.config
@@ -81,7 +103,7 @@ class Trainer:
         log_every: int = 50,
         checkpoint_cb: Optional[Callable[[TrainState], None]] = None,
         checkpoint_every: int = 0,
-    ) -> tuple:
+    ) -> Tuple[TrainState, TrainReport]:
         cfg = self.config
         state = state or self.init_state()
         batcher = BatchIterator(
@@ -94,15 +116,14 @@ class Trainer:
         last_metrics = None
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
-            for tokens, words in prefetch(batcher.epoch()):
+            for tokens, words in prefetch(self._batches(batcher)):
                 alpha = jnp.float32(self.alpha_at(state.words_done))
                 key = jax.random.fold_in(base_key, state.step)
-                state.params, metrics = self.step_fn(
-                    state.params, jnp.asarray(tokens), key, alpha
-                )
+                state.params, metrics = self.step_fn(state.params, tokens, key, alpha)
                 last_metrics = metrics
                 state.step += 1
                 state.words_done += words
+                self._post_step(state)
                 if log_every and state.step % log_every == 0:
                     m = jax.device_get(metrics)
                     loss = float(m["loss_sum"]) / max(1.0, float(m["pairs"]))
@@ -123,6 +144,7 @@ class Trainer:
                 if checkpoint_every and checkpoint_cb and state.step % checkpoint_every == 0:
                     checkpoint_cb(state)
 
+        self._finalize(state)
         # ensure all device work is done before timing
         jax.block_until_ready(state.params)
         wall = time.perf_counter() - t0

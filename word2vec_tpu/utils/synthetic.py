@@ -1,0 +1,38 @@
+"""Synthetic Zipfian corpora for benchmarks and dry runs.
+
+No-network environments have no text8; a Zipf(1.0) token stream over a
+text8-sized vocabulary reproduces the performance-relevant corpus properties
+(vocab size, frequency skew, subsampling hit rate, negative-table shape) so
+throughput numbers transfer. Not meant for accuracy evaluation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.vocab import Vocab
+
+
+def zipf_vocab(vocab_size: int = 71000, total_words: int = 17_000_000) -> Vocab:
+    """A vocab whose counts follow Zipf's law, like text8's (~71k words kept
+    at min_count=5 out of ~17M tokens)."""
+    ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+    weights = 1.0 / ranks
+    counts = np.maximum(
+        (weights / weights.sum() * total_words).astype(np.int64), 5
+    )
+    words = [f"w{i}" for i in range(vocab_size)]
+    return Vocab(words, counts)
+
+
+def zipf_corpus_ids(
+    vocab: Vocab, num_tokens: int, seed: int = 0, sentence_len: int = 1000
+) -> list:
+    """Token-id sentences drawn from the vocab's empirical distribution,
+    chunked like the reference's text8 reader (main.cpp:66)."""
+    rng = np.random.default_rng(seed)
+    p = vocab.counts / vocab.counts.sum()
+    flat = rng.choice(len(vocab), size=num_tokens, p=p).astype(np.int32)
+    return [
+        flat[i : i + sentence_len] for i in range(0, num_tokens, sentence_len)
+    ]

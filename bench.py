@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Benchmark: SGNS training words/sec on the flagship config (BASELINE.json:
+skip-gram, negative=5, dim=300, window=5, text8-scale corpus).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Corpus: ./text8 if present, else a synthetic Zipf stream with text8's vocab
+size and skew (utils/synthetic.py) — the perf-relevant properties match, so
+words/sec transfers.
+
+Baseline: benchmarks/reference_baseline.json holds the measured words/sec of
+the compiled C++ reference on this machine (see benchmarks/reference_harness/
+for how it is produced). vs_baseline = ours / reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=2_000_000)
+    ap.add_argument("--dim", type=int, default=300)
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--negative", type=int, default=5)
+    ap.add_argument("--batch-rows", type=int, default=32)
+    ap.add_argument("--max-len", type=int, default=192)
+    ap.add_argument("--warmup-steps", type=int, default=3)
+    ap.add_argument("--measure-steps", type=int, default=0,
+                    help="0 = one full epoch")
+    ap.add_argument("--text8", default="text8")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.data.batcher import BatchIterator, PackedCorpus, prefetch
+    from word2vec_tpu.data.vocab import Vocab
+    from word2vec_tpu.models.params import init_params
+    from word2vec_tpu.ops.tables import DeviceTables
+    from word2vec_tpu.ops.train_step import jit_train_step
+    from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+    cfg = Word2VecConfig(
+        model="sg",
+        train_method="ns",
+        negative=args.negative,
+        word_dim=args.dim,
+        window=args.window,
+        subsample_threshold=1e-4,
+        batch_rows=args.batch_rows,
+        max_sentence_len=args.max_len,
+    )
+
+    if os.path.exists(args.text8):
+        from word2vec_tpu.data.corpus import text8_corpus
+
+        sents = list(text8_corpus(args.text8))
+        vocab = Vocab.build(sents, min_count=cfg.min_count)
+        corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+        corpus_name = "text8"
+    else:
+        vocab = zipf_vocab(71000, 17_000_000)
+        ids = zipf_corpus_ids(vocab, args.tokens, seed=0)
+        corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+        corpus_name = f"zipf-synthetic-{args.tokens // 1_000_000}M"
+
+    tables = DeviceTables.build(vocab, cfg)
+    step = jit_train_step(cfg, tables)
+    params = init_params(cfg, len(vocab), jax.random.key(0))
+    batcher = BatchIterator(corpus, cfg.batch_rows, cfg.max_sentence_len, seed=1)
+    alpha = jnp.float32(cfg.init_alpha)
+    base_key = jax.random.key(7)
+
+    # warmup / compile
+    it = batcher.epoch()
+    for _ in range(args.warmup_steps):
+        tokens, _ = next(it)
+        params, m = step(params, jnp.asarray(tokens), base_key, alpha)
+    jax.block_until_ready(params)
+
+    # timed steady-state
+    words = 0
+    steps = 0
+    t0 = time.perf_counter()
+    for tokens, w in prefetch(it):
+        key = jax.random.fold_in(base_key, steps)
+        params, m = step(params, jnp.asarray(tokens), key, alpha)
+        words += w
+        steps += 1
+        if args.measure_steps and steps >= args.measure_steps:
+            break
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+    wps = words / dt
+
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks",
+        "reference_baseline.json",
+    )
+    vs = None
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            ref = json.load(f)
+        if ref.get("words_per_sec"):
+            vs = wps / float(ref["words_per_sec"])
+
+    dev = jax.devices()[0]
+    print(
+        json.dumps(
+            {
+                "metric": f"sgns-dim{args.dim}-w{args.window}-k{args.negative} "
+                f"words/sec ({corpus_name}, {dev.platform})",
+                "value": round(wps, 1),
+                "unit": "words/sec",
+                "vs_baseline": round(vs, 2) if vs is not None else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""CLI: reference-compatible flags end-to-end (SURVEY §5 config/flag system)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.cli import main
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.io.embeddings import load_word2vec
+
+
+@pytest.fixture
+def corpus_file(tmp_path):
+    rng = np.random.default_rng(0)
+    toks = []
+    for _ in range(400):
+        w1 = rng.choice(["a", "b"])
+        w2 = rng.choice(["c", "d"])
+        toks += ["x", w1, "y", "p", w2, "q"]
+    p = tmp_path / "corpus.txt"
+    p.write_text(" ".join(toks))
+    return str(p)
+
+
+def run(args):
+    return main(args)
+
+
+def test_no_args_prints_help(capsys):
+    assert run([]) == 0
+    out = capsys.readouterr().out
+    assert "-train" in out and "-output" in out
+
+
+def test_validation_errors_mirror_reference(tmp_path, capsys):
+    # ns with negative<=0 rejected (main.cpp:164-167)
+    assert run(["-train", "x", "-train_method", "ns", "-negative", "0"]) == 1
+    assert "negative" in capsys.readouterr().err
+    # hs with negative>0 rejected (main.cpp:169-172)
+    assert run(["-train", "x", "-train_method", "hs", "-negative", "5"]) == 1
+    # missing -train
+    assert run(["-negative", "5"]) == 1
+
+
+def test_end_to_end_train_save(tmp_path, corpus_file):
+    out = str(tmp_path / "vec.txt")
+    vocab_out = str(tmp_path / "vocab.txt")
+    rc = run([
+        "-train", corpus_file, "-output", out, "-size", "16", "-window", "2",
+        "-negative", "3", "-model", "sg", "-train_method", "ns", "-iter", "2",
+        "-min-count", "1", "-subsample", "0", "-save-vocab", vocab_out,
+        "--backend", "cpu", "--batch-rows", "8", "--max-sentence-len", "32",
+        "--quiet",
+    ])
+    assert rc == 0
+    words, M = load_word2vec(out)
+    assert M.shape[1] == 16
+    assert set("abxypcdq") == set("".join(w for w in words if len(w) == 1))
+    assert np.all(np.isfinite(M))
+    vocab = Vocab.load(vocab_out)
+    assert vocab.words == words
+
+
+def test_binary_output_and_read_vocab(tmp_path, corpus_file):
+    vocab_out = str(tmp_path / "vocab.txt")
+    out1 = str(tmp_path / "v1.bin")
+    rc = run([
+        "-train", corpus_file, "-output", out1, "-size", "8", "-negative", "2",
+        "-min-count", "1", "-iter", "1", "-binary", "1",
+        "-save-vocab", vocab_out, "--backend", "cpu", "--batch-rows", "4",
+        "--max-sentence-len", "32", "--quiet",
+    ])
+    assert rc == 0
+    words, M = load_word2vec(out1, binary=True)
+    assert M.shape[1] == 8
+    # -read-vocab path (Word2Vec.cpp:179-196, never wired in the reference CLI)
+    out2 = str(tmp_path / "v2.txt")
+    rc = run([
+        "-train", corpus_file, "-output", out2, "-size", "8", "-negative", "2",
+        "-min-count", "1", "-iter", "1", "-read-vocab", vocab_out,
+        "--backend", "cpu", "--batch-rows", "4", "--max-sentence-len", "32",
+        "--quiet",
+    ])
+    assert rc == 0
+    words2, _ = load_word2vec(out2)
+    assert words2 == words
+
+
+def test_checkpoint_and_resume(tmp_path, corpus_file):
+    ck = str(tmp_path / "ck")
+    out = str(tmp_path / "v.txt")
+    common = [
+        "-train", corpus_file, "-size", "8", "-negative", "2", "-min-count", "1",
+        "--backend", "cpu", "--batch-rows", "4", "--max-sentence-len", "32",
+        "--quiet",
+    ]
+    rc = run(common + ["-output", out, "-iter", "1", "--checkpoint-dir", ck])
+    assert rc == 0
+    assert os.path.exists(os.path.join(ck, "state.npz"))
+    # resume continues without error and rewrites output
+    rc = run(common + ["-output", out, "-iter", "2", "--resume", ck])
+    assert rc == 0
+
+
+def test_sharded_checkpoint_resumes_on_different_mesh(tmp_path, corpus_file):
+    """A --dp 2 --tp 2 run's checkpoint must hold unreplicated [V, d] tables
+    loadable by a single-chip resume (and vice versa)."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    ck = str(tmp_path / "ck")
+    common = [
+        "-train", corpus_file, "-size", "8", "-negative", "2", "-min-count", "1",
+        "--backend", "cpu", "--batch-rows", "4", "--max-sentence-len", "32",
+        "--quiet",
+    ]
+    rc = run(common + ["-output", "", "-iter", "1", "--dp", "2", "--tp", "2",
+                       "--checkpoint-dir", ck])
+    assert rc == 0
+    import numpy as np2
+    with np2.load(os.path.join(ck, "state.npz")) as z:
+        assert z["emb_in"].ndim == 2  # unreplicated
+    # resume single-chip from the sharded checkpoint
+    rc = run(common + ["-output", str(tmp_path / "v.txt"), "--resume", ck])
+    assert rc == 0
+    # and resume sharded from the same checkpoint
+    rc = run(common + ["-output", "", "--dp", "2", "--resume", ck])
+    assert rc == 0
+
+
+def test_eval_flags(tmp_path, corpus_file, capsys):
+    ws = tmp_path / "ws.csv"
+    ws.write_text("w1,w2,s\na,b,9\nx,q,2\n")
+    qa = tmp_path / "q.txt"
+    qa.write_text(": sec\nx a y b\n")
+    rc = run([
+        "-train", corpus_file, "-output", "", "-size", "8", "-negative", "2",
+        "-min-count", "1", "-iter", "1", "--backend", "cpu",
+        "--batch-rows", "4", "--max-sentence-len", "32",
+        "--eval-ws353", str(ws), "--eval-analogy", str(qa), "--quiet",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "WS-353 spearman:" in out
+    assert "analogy accuracy:" in out

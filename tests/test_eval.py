@@ -1,0 +1,125 @@
+"""Eval harness: Spearman machinery, WS-353-format loading, analogy protocol."""
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.eval.analogy import evaluate_analogies, load_questions
+from word2vec_tpu.eval.neighbors import analogy_query, nearest_neighbors
+from word2vec_tpu.eval.similarity import (
+    _rankdata,
+    evaluate_pairs,
+    evaluate_ws353,
+    load_word_pairs,
+    pearson,
+    spearman,
+)
+
+
+def test_rankdata_with_ties():
+    x = np.array([10.0, 20.0, 20.0, 30.0])
+    np.testing.assert_allclose(_rankdata(x), [1.0, 2.5, 2.5, 4.0])
+
+
+def test_spearman_perfect_and_inverse():
+    a = np.array([1.0, 2.0, 3.0, 4.0])
+    assert spearman(a, a * 10 + 3) == pytest.approx(1.0)
+    assert spearman(a, -a) == pytest.approx(-1.0)
+    # monotone nonlinear -> still 1.0 (rank-based), pearson < 1
+    b = np.exp(a)
+    assert spearman(a, b) == pytest.approx(1.0)
+    assert pearson(a, b) < 1.0
+
+
+def test_load_word_pairs_formats(tmp_path):
+    p = tmp_path / "ws.csv"
+    p.write_text("Word 1,Word 2,Human (mean)\nlove,sex,6.77\ntiger,cat,7.35\n")
+    pairs = load_word_pairs(str(p))
+    assert pairs == [("love", "sex", 6.77), ("tiger", "cat", 7.35)]
+    p2 = tmp_path / "ws.tsv"
+    p2.write_text("dog\tcat\t8.0\n")
+    assert load_word_pairs(str(p2)) == [("dog", "cat", 8.0)]
+    p3 = tmp_path / "ws.txt"
+    p3.write_text("dog cat 8.0\n")
+    assert load_word_pairs(str(p3)) == [("dog", "cat", 8.0)]
+
+
+def test_evaluate_pairs_oov_and_correlation(tmp_path):
+    vocab = Vocab.from_counter({"a": 10, "b": 9, "c": 8, "d": 7}, min_count=1)
+    # construct embeddings with known cosine ordering:
+    # cos(a,b)=1 > cos(a,c)=0.707... > cos(a,d)=0
+    W = np.array([[1, 0], [2, 0], [1, 1], [0, 1]], dtype=np.float32)
+    pairs = [("a", "b", 10.0), ("a", "c", 5.0), ("a", "d", 1.0),
+             ("a", "zzz", 9.9)]  # last is OOV
+    r = evaluate_pairs(W, vocab, pairs)
+    assert r.pairs_used == 3 and r.pairs_total == 4
+    assert r.spearman == pytest.approx(1.0)
+
+
+def test_ws353_end_to_end(tmp_path):
+    vocab = Vocab.from_counter({"x": 5, "y": 4, "z": 3}, min_count=1)
+    W = np.array([[1, 0], [0.9, 0.1], [0, 1]], dtype=np.float32)
+    f = tmp_path / "ws353.csv"
+    f.write_text("w1,w2,score\nx,y,9\nx,z,1\n")
+    r = evaluate_ws353(W, vocab, str(f))
+    assert r.spearman == pytest.approx(1.0)
+
+
+def test_load_questions_sections(tmp_path):
+    f = tmp_path / "q.txt"
+    f.write_text(
+        ": capital-common-countries\n"
+        "Athens Greece Baghdad Iraq\n"
+        ": family\n"
+        "boy girl man woman\n"
+        "king queen man woman\n"
+    )
+    sections = load_questions(str(f))
+    assert [s[0] for s in sections] == ["capital-common-countries", "family"]
+    assert sections[1][1][0] == ("boy", "girl", "man", "woman")
+
+
+def test_analogy_exact_structure(tmp_path):
+    # vectors engineered so king - man + woman == queen exactly
+    words = ["man", "woman", "king", "queen", "filler"]
+    vocab = Vocab.from_counter({w: 10 - i for i, w in enumerate(words)}, min_count=1)
+    W = np.array(
+        [
+            [1.0, 0.0, 0.0],   # man
+            [0.0, 1.0, 0.0],   # woman
+            [1.0, 0.0, 1.0],   # king
+            [0.0, 1.0, 1.0],   # queen = king - man + woman
+            [0.3, 0.3, -1.0],  # filler
+        ],
+        dtype=np.float32,
+    )
+    f = tmp_path / "q.txt"
+    f.write_text(": family\nman woman king queen\nzzz woman king queen\n")
+    r = evaluate_analogies(W, vocab, str(f))
+    assert r.total == 1 and r.correct == 1 and r.skipped_oov == 1
+    assert r.accuracy == 1.0
+    assert r.by_section["family"] == (1, 1)
+
+
+def test_restrict_vocab_skips_rare(tmp_path):
+    words = ["a", "b", "c", "rare"]
+    vocab = Vocab.from_counter({w: 10 - i for i, w in enumerate(words)}, min_count=1)
+    W = np.eye(4, dtype=np.float32)
+    f = tmp_path / "q.txt"
+    f.write_text("a b c rare\n")
+    r = evaluate_analogies(W, vocab, str(f), restrict_vocab=3)
+    assert r.total == 0 and r.skipped_oov == 1
+
+
+def test_neighbors_and_analogy_query():
+    words = ["man", "woman", "king", "queen"]
+    vocab = Vocab.from_counter({w: 10 - i for i, w in enumerate(words)}, min_count=1)
+    W = np.array(
+        [[1, 0, 0], [0, 1, 0], [1, 0, 1], [0, 1, 1]], dtype=np.float32
+    )
+    nn = nearest_neighbors(W, vocab, "king", k=2)
+    assert nn[0][0] in ("man", "queen")
+    res = analogy_query(W, vocab, "man", "woman", "king", k=1)
+    assert res[0][0] == "queen"
+    with pytest.raises(KeyError):
+        nearest_neighbors(W, vocab, "zzz")

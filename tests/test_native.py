@@ -1,0 +1,109 @@
+"""Native C++ host data layer vs the pure-Python reference implementations.
+
+The native path (host_data.cpp via ctypes) must be byte-identical to the
+Python fallbacks for counting, encoding (both corpus formats) and batch fill.
+"""
+
+import numpy as np
+import pytest
+
+from word2vec_tpu import native
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.data.corpus import load_corpus, text8_corpus
+from word2vec_tpu.data.vocab import Vocab
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog\n"
+    "the quick fox runs\n"
+    "\n"
+    "dog and fox and the\n"
+)
+
+
+@pytest.fixture
+def corpus_file(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text(CORPUS)
+    return str(p)
+
+
+def test_native_builds():
+    assert native.available(), "g++ toolchain present; native build must work"
+
+
+def test_count_matches_python(corpus_file):
+    counts_n, total_n = native.count_file(corpus_file)
+    counts_p, total_p = native._count_file_py(corpus_file)
+    assert counts_n == counts_p
+    assert total_n == total_p == 18
+    assert counts_n["the"] == 4 and counts_n["fox"] == 3
+
+
+def test_encode_stream_matches_python(corpus_file):
+    vocab = Vocab.from_counter(native.count_file(corpus_file)[0], min_count=2)
+    ids_n = native.encode_file(corpus_file, vocab, native.MODE_STREAM)
+    ids_p = native._encode_file_py(corpus_file, vocab, native.MODE_STREAM)
+    np.testing.assert_array_equal(ids_n, ids_p)
+    # OOV ("quick" kept at min_count 2; "jumps" etc dropped)
+    assert set(np.unique(ids_n)).issubset(set(range(len(vocab))))
+
+
+def test_encode_lines_matches_python(corpus_file):
+    vocab = Vocab.from_counter(native.count_file(corpus_file)[0], min_count=1)
+    ids_n = native.encode_file(corpus_file, vocab, native.MODE_LINES)
+    ids_p = native._encode_file_py(corpus_file, vocab, native.MODE_LINES)
+    np.testing.assert_array_equal(ids_n, ids_p)
+    # 4 non-empty lines -> 3 separators (blank line collapses)
+    assert int((ids_n == -1).sum()) == 2
+    # decode round-trip: sentences match line_docs through the vocab
+    spans = np.split(ids_n, np.flatnonzero(ids_n == -1))
+    spans = [s[s != -1] for s in spans]
+    from word2vec_tpu.data.corpus import line_docs
+
+    expected = [vocab.encode(s) for s in line_docs(corpus_file)]
+    assert len(spans) == len(expected)
+    for got, exp in zip(spans, expected):
+        np.testing.assert_array_equal(got, exp)
+
+
+def test_load_corpus_equals_reader_pipeline(corpus_file):
+    vocab, flat = load_corpus(corpus_file, fmt="text8", min_count=1)
+    sents = list(text8_corpus(corpus_file))
+    vocab2 = Vocab.build(sents, min_count=1)
+    assert vocab.words == vocab2.words
+    manual = np.concatenate([vocab2.encode(s) for s in sents])
+    np.testing.assert_array_equal(flat, manual)
+
+
+def test_from_flat_stream_and_lines():
+    flat = np.arange(10, dtype=np.int32)
+    pc = PackedCorpus.from_flat(flat, max_len=4)
+    assert pc.row_lens.tolist() == [4, 4, 2]
+    assert pc.num_tokens == 10
+    flat2 = np.array([1, 2, 3, -1, 4, 5, 6, 7, 8, -1, 9], dtype=np.int32)
+    pc2 = PackedCorpus.from_flat(flat2, max_len=3)
+    assert pc2.row_lens.tolist() == [3, 3, 2, 1]
+    assert pc2.num_tokens == 9
+    # rows never contain separators
+    for s, n in zip(pc2.row_starts, pc2.row_lens):
+        assert np.all(pc2.flat[s : s + n] != -1)
+
+
+def test_fill_batch_matches_python():
+    rng = np.random.default_rng(0)
+    flat = rng.integers(0, 100, 200).astype(np.int32)
+    pc = PackedCorpus.from_flat(flat, max_len=16)
+    order = np.arange(pc.num_rows, dtype=np.int64)
+    rng.shuffle(order)
+    for pos in [0, 8, pc.num_rows - 2]:
+        out_n = np.empty((4, 16), dtype=np.int32)
+        out_p = np.empty((4, 16), dtype=np.int32)
+        w_n = native.fill_batch(pc.flat, pc.row_starts, pc.row_lens, order, pos, out_n)
+        w_p = native._fill_batch_py(pc.flat, pc.row_starts, pc.row_lens, order, pos, out_p)
+        assert w_n == w_p
+        np.testing.assert_array_equal(out_n, out_p)
+
+
+def test_count_file_missing_path_raises():
+    with pytest.raises(OSError):
+        native.count_file("/nonexistent/file/xyz")

@@ -1,0 +1,263 @@
+"""word2vec.c-compatible command line, plus TPU-native extensions.
+
+Flag names and defaults mirror the reference CLI (main.cpp:94-205) so a
+reference user can switch by changing only the binary name:
+
+    word2vec-tpu -train text8 -output vec.txt -size 200 -window 5 \
+        -negative 5 -model sg -train_method ns -iter 3 -binary 0
+
+Reference divergences (deliberate, each a reference bug or gap):
+  - `-train <file>` is honored. The reference parses it but hardcodes
+    ./text8 (main.cpp:125-126 vs :188; SURVEY §2 dead code).
+  - `-binary` works. The reference's parse line is commented out
+    (main.cpp:131).
+  - `-alpha` is honored for skip-gram. The reference unconditionally
+    overwrites init_alpha with 0.05 because its cbow_mean flag is hardcoded
+    true (main.cpp:117,180-181) — even for -model sg. Here the 0.05
+    cbow-mean default applies only when model=cbow and -alpha was not given
+    (word2vec.c behavior).
+  - `-threads` is accepted for compatibility and ignored: parallelism is
+    --dp/--tp over the device mesh, not host threads.
+
+TPU extensions: --backend {tpu,cpu}, --dp/--tp mesh shape, --corpus-format,
+--checkpoint-dir/--checkpoint-every, --eval-ws353/--eval-analogy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="word2vec-tpu",
+        description="TPU-native word2vec (reference-compatible flags)",
+        allow_abbrev=False,
+    )
+    # reference flags (main.cpp:123-151); single-dash long names as upstream
+    p.add_argument("-train", dest="train", metavar="FILE", help="training corpus")
+    p.add_argument("-output", dest="output", metavar="FILE",
+                   default="text8-sgns.txt", help="output vectors (main.cpp:106)")
+    p.add_argument("-size", dest="size", type=int, default=200,
+                   help="embedding dim (default 200, main.cpp:112)")
+    p.add_argument("-window", dest="window", type=int, default=5)
+    p.add_argument("-subsample", dest="subsample", type=float, default=1e-4)
+    p.add_argument("-train_method", dest="train_method", default="ns",
+                   choices=["ns", "hs"])
+    p.add_argument("-negative", dest="negative", type=int, default=0,
+                   help="negative samples (reference default 0, main.cpp:118)")
+    p.add_argument("-threads", dest="threads", type=int, default=1,
+                   help="accepted for compatibility; ignored (use --dp/--tp)")
+    p.add_argument("-iter", dest="iter", type=int, default=1)
+    p.add_argument("-min-count", dest="min_count", type=int, default=5)
+    p.add_argument("-alpha", dest="alpha", type=float, default=None)
+    p.add_argument("-model", dest="model", default="sg", choices=["sg", "cbow"])
+    p.add_argument("-save-vocab", dest="save_vocab", metavar="FILE")
+    p.add_argument("-read-vocab", dest="read_vocab", metavar="FILE")
+    p.add_argument("-binary", dest="binary", type=int, default=0)
+    p.add_argument("-cbow-mean", dest="cbow_mean", type=int, default=1,
+                   help="cbow projection: 1=mean (reference default), 0=sum")
+    # TPU-native extensions
+    p.add_argument("--backend", choices=["tpu", "cpu"], default="tpu",
+                   help="device backend (BASELINE.json north star)")
+    p.add_argument("--dp", type=int, default=1, help="data-parallel mesh axis")
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
+    p.add_argument("--dp-sync-every", type=int, default=64)
+    p.add_argument("--batch-rows", type=int, default=32)
+    p.add_argument("--max-sentence-len", type=int, default=192)
+    p.add_argument("--corpus-format", choices=["text8", "lines"], default="text8",
+                   help="text8: 1000-word chunks (main.cpp:63-92); "
+                        "lines: one sentence per line (Word2Vec.cpp:19-30)")
+    p.add_argument("--binary-layout", choices=["reference", "google"],
+                   default="reference")
+    p.add_argument("--checkpoint-dir", metavar="DIR")
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="STEPS")
+    p.add_argument("--resume", metavar="DIR", help="resume from checkpoint dir")
+    p.add_argument("--eval-ws353", metavar="FILE",
+                   help="WordSim-353 csv/tsv for post-train eval")
+    p.add_argument("--eval-analogy", metavar="FILE",
+                   help="google questions-words.txt for post-train eval")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=100)
+    p.add_argument("--quiet", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    parser = build_parser()
+    if not argv:
+        parser.print_help()  # reference: help on no args (main.cpp:99-103)
+        return 0
+    args = parser.parse_args(argv)
+
+    if args.backend == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+    import jax
+
+    from .config import Word2VecConfig
+    from .data.batcher import PackedCorpus
+    from .data.vocab import Vocab
+    from .io.checkpoint import load_checkpoint, save_checkpoint
+    from .io.embeddings import save_word2vec
+    from .models.params import export_matrix
+    from .train import Trainer
+    from .utils.logging import progress_logger
+
+    # validation mirrors main.cpp:164-181 (raised by Word2VecConfig)
+    alpha = args.alpha
+    if alpha is None:
+        # word2vec.c-style default: 0.05 for cbow(+mean), 0.025 for sg
+        alpha = 0.05 if (args.model == "cbow" and args.cbow_mean) else 0.025
+    try:
+        cfg = Word2VecConfig(
+            iters=args.iter,
+            window=args.window,
+            min_count=args.min_count,
+            word_dim=args.size,
+            negative=args.negative,
+            subsample_threshold=args.subsample,
+            init_alpha=alpha,
+            cbow_mean=bool(args.cbow_mean),
+            train_method=args.train_method,
+            model=args.model,
+            batch_rows=args.batch_rows,
+            max_sentence_len=args.max_sentence_len,
+            seed=args.seed,
+            dp_sync_every=args.dp_sync_every,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    if not args.train:
+        print("error: -train <file> is required", file=sys.stderr)
+        return 1
+
+    from . import native
+    from .data.corpus import load_corpus
+    from .train import TrainState
+
+    # Resume: the checkpoint's config and vocab are authoritative — resuming
+    # against a rebuilt vocab would silently re-attribute embedding rows.
+    state = None
+    ck_vocab = None
+    if args.resume:
+        state, ck_cfg, ck_vocab = load_checkpoint(args.resume)
+        import dataclasses as _dc
+
+        diffs = {
+            f.name: (getattr(cfg, f.name), getattr(ck_cfg, f.name))
+            for f in _dc.fields(cfg)
+            if getattr(cfg, f.name) != getattr(ck_cfg, f.name)
+        }
+        if diffs and not args.quiet:
+            print(f"resume: using checkpoint config; ignoring differing flags "
+                  f"{sorted(diffs)}", file=sys.stderr)
+        cfg = ck_cfg
+        if not args.quiet:
+            print(f"resumed from {args.resume} at step {state.step}")
+
+    t0 = time.perf_counter()
+    mode = native.MODE_STREAM if args.corpus_format == "text8" else native.MODE_LINES
+    if ck_vocab is not None:
+        vocab = ck_vocab
+        flat = native.encode_file(args.train, vocab, mode)
+    elif args.read_vocab:
+        vocab = Vocab.load(args.read_vocab)  # Word2Vec.cpp:179-196
+        flat = native.encode_file(args.train, vocab, mode)
+    else:
+        vocab, flat = load_corpus(
+            args.train, fmt=args.corpus_format, min_count=cfg.min_count
+        )
+    if not args.quiet:
+        impl = "native" if native.available() else "python"
+        print(f"vocab: {len(vocab)} words, {vocab.total_words} total "
+              f"({time.perf_counter() - t0:.1f}s, {impl} data layer)")
+    corpus = PackedCorpus.from_flat(flat, cfg.max_sentence_len)
+    if args.save_vocab:
+        vocab.save(args.save_vocab)  # Word2Vec.cpp:171-177
+
+    log_fn = None if args.quiet else progress_logger()
+    if args.dp * args.tp > 1:
+        from .parallel import ShardedTrainer
+
+        trainer = ShardedTrainer(
+            cfg, vocab, corpus, dp=args.dp, tp=args.tp, log_fn=log_fn
+        )
+    else:
+        trainer = Trainer(cfg, vocab, corpus, log_fn=log_fn)
+
+    if state is not None and hasattr(trainer, "import_params"):
+        # checkpoints always hold unreplicated [V, d] tables; re-shard them
+        trainer.import_params(state.params, state)
+
+    def unreplicated(s: TrainState) -> TrainState:
+        if hasattr(trainer, "export_params"):
+            return TrainState(
+                params=trainer.export_params(s),
+                step=s.step, words_done=s.words_done, epoch=s.epoch,
+            )
+        return s
+
+    ckpt_cb = None
+    if args.checkpoint_dir and args.checkpoint_every:
+        def ckpt_cb(s):
+            save_checkpoint(args.checkpoint_dir, unreplicated(s), cfg, vocab)
+
+    state, report = trainer.train(
+        state=state,
+        log_every=args.log_every,
+        checkpoint_cb=ckpt_cb,
+        checkpoint_every=args.checkpoint_every,
+    )
+    if not args.quiet:
+        print(f"\ntrained {report.total_words} words in {report.wall_time:.1f}s "
+              f"({report.words_per_sec:,.0f} words/sec), final loss "
+              f"{report.final_loss:.4f}")
+
+    if args.checkpoint_dir:
+        save_checkpoint(args.checkpoint_dir, unreplicated(state), cfg, vocab)
+
+    # matrix choice per main.cpp:196-202
+    if hasattr(trainer, "export_params"):
+        params = trainer.export_params(state)
+    else:
+        params = {k: v for k, v in state.params.items()}
+    matrix = export_matrix(params, cfg)
+    if args.output:
+        save_word2vec(
+            args.output, vocab, matrix,
+            binary=bool(args.binary), layout=args.binary_layout,
+        )
+        if not args.quiet:
+            print(f"saved {'binary' if args.binary else 'text'} vectors to "
+                  f"{args.output}")
+
+    if args.eval_ws353 or args.eval_analogy:
+        from .eval.similarity import evaluate_ws353
+        from .eval.analogy import evaluate_analogies
+
+        import numpy as np
+
+        W = np.asarray(matrix)
+        if args.eval_ws353:
+            r = evaluate_ws353(W, vocab, args.eval_ws353)
+            print(f"WS-353 spearman: {r.spearman:.4f} ({r.pairs_used}/{r.pairs_total} pairs)")
+        if args.eval_analogy:
+            r = evaluate_analogies(W, vocab, args.eval_analogy)
+            print(f"analogy accuracy: {r.accuracy:.4f} ({r.correct}/{r.total})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,6 +41,39 @@ class PackedCorpus:
         return int(self.row_lens.sum())
 
     @classmethod
+    def from_flat(cls, flat: np.ndarray, max_len: int) -> "PackedCorpus":
+        """Pack a flat id stream (from native.encode_file).
+
+        Runs of ids between -1 separators are sentences (MODE_LINES); a stream
+        with no separators (MODE_STREAM / text8) is one giant sentence whose
+        rows are cut every max_len tokens — the same boundaries the reference's
+        1000-word chunking would produce after re-wrapping.
+        """
+        flat = np.asarray(flat, dtype=np.int32)
+        if len(flat) == 0:
+            raise ValueError("empty corpus")
+        if not (flat == PAD).any():
+            n = len(flat)
+            starts = np.arange(0, n, max_len, dtype=np.int64)
+            lens = np.minimum(n - starts, max_len).astype(np.int32)
+            return cls(flat, starts, lens)
+        # split at separators, then wrap each sentence
+        sep = np.flatnonzero(flat == PAD)
+        bounds = np.concatenate([[-1], sep, [len(flat)]])
+        starts: List[int] = []
+        lens: List[int] = []
+        for s, e in zip(bounds[:-1] + 1, bounds[1:]):
+            n = e - s
+            for ofs in range(0, n, max_len):
+                starts.append(s + ofs)
+                lens.append(min(max_len, n - ofs))
+        return cls(
+            flat,
+            np.asarray(starts, dtype=np.int64),
+            np.asarray(lens, dtype=np.int32),
+        )
+
+    @classmethod
     def pack(cls, sentences: Iterable[np.ndarray], max_len: int) -> "PackedCorpus":
         """Pack encoded sentences, wrapping rows longer than max_len."""
         chunks: List[np.ndarray] = []
@@ -88,8 +121,14 @@ class BatchIterator:
         return -(-self.corpus.num_rows // self.B)
 
     def epoch(self) -> Iterator[Tuple[np.ndarray, int]]:
-        """Yield (tokens [B, L], words_in_batch) for one pass over the corpus."""
-        order = np.arange(self.corpus.num_rows)
+        """Yield (tokens [B, L], words_in_batch) for one pass over the corpus.
+
+        Batch assembly goes through the native fill (native.fill_batch) when
+        the C++ layer is available; the Python fallback is identical.
+        """
+        from .. import native
+
+        order = np.arange(self.corpus.num_rows, dtype=np.int64)
         if self.shuffle:
             self.rng.shuffle(order)
         flat = self.corpus.flat
@@ -97,13 +136,8 @@ class BatchIterator:
         lens = self.corpus.row_lens
         B, L = self.B, self.L
         for i in range(0, len(order), B):
-            rows = order[i : i + B]
-            batch = np.full((B, L), PAD, dtype=np.int32)
-            words = 0
-            for r, ridx in enumerate(rows):
-                s, n = starts[ridx], lens[ridx]
-                batch[r, :n] = flat[s : s + n]
-                words += int(n)
+            batch = np.empty((B, L), dtype=np.int32)
+            words = native.fill_batch(flat, starts, lens, order, i, batch)
             yield batch, words
 
 

@@ -62,3 +62,24 @@ def line_docs(path: str) -> Iterator[List[str]]:
             toks = line.split()
             if toks:
                 yield toks
+
+
+def load_corpus(path: str, fmt: str = "text8", min_count: int = 5):
+    """One-shot corpus load: (Vocab, flat int32 id stream).
+
+    Uses the native C++ layer (word2vec_tpu.native) for the two host-side
+    O(corpus) passes — word counting and id encoding — falling back to Python
+    transparently. `fmt` selects the reference reader semantics: "text8" is a
+    whitespace stream (main.cpp:63-92), "lines" treats each line as a sentence
+    (Word2Vec.cpp:19-30; sentence breaks become -1 separators in the stream).
+
+    Pack the result with PackedCorpus.from_flat(flat, max_sentence_len).
+    """
+    from .. import native
+    from .vocab import Vocab
+
+    mode = native.MODE_STREAM if fmt == "text8" else native.MODE_LINES
+    counts, total = native.count_file(path)
+    vocab = Vocab.from_counter(counts, min_count=min_count)
+    flat = native.encode_file(path, vocab, mode, max_tokens=total)
+    return vocab, flat

@@ -20,7 +20,7 @@ class Vocab:
 
     Words are sorted by descending count and indexed 0..V-1
     (reference: Word2Vec.cpp:153-160; comparator at Word2Vec.cpp:3-6).
-    Ties are broken by first-seen order, which is deterministic — unlike the
+    Ties are broken lexicographically, which is deterministic — unlike the
     reference, whose tie order depends on unordered_map iteration.
     """
 
@@ -48,8 +48,10 @@ class Vocab:
     @classmethod
     def from_counter(cls, counter: Dict[str, int], min_count: int = 5) -> "Vocab":
         items = [(w, c) for w, c in counter.items() if c >= min_count]
-        # stable sort: descending count, ties by insertion order (deterministic)
-        items.sort(key=lambda wc: -wc[1])
+        # descending count, ties lexicographic: deterministic regardless of
+        # counter iteration order (dict vs the native C++ hash table), where
+        # the reference inherits unordered_map's arbitrary tie order
+        items.sort(key=lambda wc: (-wc[1], wc[0]))
         words = [w for w, _ in items]
         counts = np.array([c for _, c in items], dtype=np.int64)
         return cls(words, counts)

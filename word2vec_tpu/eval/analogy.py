@@ -1,0 +1,102 @@
+"""Google analogy evaluation (questions-words.txt format).
+
+The other half of the BASELINE.json parity gate (the reference ships nothing
+comparable, SURVEY §3.5). Protocol matches the original compute-accuracy tool:
+3CosAdd over unit-normalized vectors, question words excluded from candidates,
+questions with any OOV word skipped.
+
+File format: `: section-name` headers, then `a b c d` lines meaning
+a:b :: c:d  (predict d from b - a + c).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..data.vocab import Vocab
+
+
+@dataclass
+class AnalogyResult:
+    accuracy: float
+    correct: int
+    total: int
+    skipped_oov: int
+    by_section: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+
+
+def load_questions(path: str) -> List[Tuple[str, List[Tuple[str, str, str, str]]]]:
+    sections: List[Tuple[str, List]] = []
+    current: List = []
+    name = "(default)"
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            if parts[0] == ":":
+                if current:
+                    sections.append((name, current))
+                name = " ".join(parts[1:]) or "(unnamed)"
+                current = []
+            elif len(parts) == 4:
+                current.append(tuple(p.lower() for p in parts))
+    if current:
+        sections.append((name, current))
+    return sections
+
+
+def evaluate_analogies(
+    W: np.ndarray,
+    vocab: Vocab,
+    path: str,
+    batch_size: int = 512,
+    restrict_vocab: int = 30000,
+) -> AnalogyResult:
+    """3CosAdd with the compute-accuracy conventions.
+
+    restrict_vocab: candidate answers come from the most frequent N words
+    (the original tool's `threshold`, default 30000), which also decides OOV
+    skips — matching how published text8 numbers are produced.
+    """
+    sections = load_questions(path)
+    V = min(len(vocab), restrict_vocab) if restrict_vocab else len(vocab)
+    Wn = W[:V] / np.maximum(np.linalg.norm(W[:V], axis=1, keepdims=True), 1e-12)
+
+    correct = total = skipped = 0
+    by_section: Dict[str, Tuple[int, int]] = {}
+    for name, questions in sections:
+        ids = []
+        for a, b, c, d in questions:
+            if all(w in vocab and vocab[w] < V for w in (a, b, c, d)):
+                ids.append((vocab[a], vocab[b], vocab[c], vocab[d]))
+            else:
+                skipped += 1
+        sec_correct = 0
+        for i in range(0, len(ids), batch_size):
+            chunk = np.asarray(ids[i : i + batch_size])
+            if len(chunk) == 0:
+                continue
+            a, b, c, d = chunk.T
+            query = Wn[b] - Wn[a] + Wn[c]
+            query /= np.maximum(np.linalg.norm(query, axis=1, keepdims=True), 1e-12)
+            sims = query @ Wn.T  # [chunk, V]
+            rows = np.arange(len(chunk))
+            sims[rows, a] = -np.inf  # exclude question words
+            sims[rows, b] = -np.inf
+            sims[rows, c] = -np.inf
+            pred = sims.argmax(axis=1)
+            sec_correct += int((pred == d).sum())
+        by_section[name] = (sec_correct, len(ids))
+        correct += sec_correct
+        total += len(ids)
+    return AnalogyResult(
+        accuracy=correct / total if total else 0.0,
+        correct=correct,
+        total=total,
+        skipped_oov=skipped,
+        by_section=by_section,
+    )

@@ -1,0 +1,44 @@
+"""Nearest-neighbor queries — the `distance` tool the reference lacks
+(SURVEY §3.5: "no nearest-neighbor query ... equivalents from the original
+google toolkit").
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ..data.vocab import Vocab
+
+
+def nearest_neighbors(
+    W: np.ndarray, vocab: Vocab, word: str, k: int = 10
+) -> List[Tuple[str, float]]:
+    """Top-k cosine neighbors of `word`, excluding itself."""
+    if word not in vocab:
+        raise KeyError(f"{word!r} not in vocabulary")
+    Wn = W / np.maximum(np.linalg.norm(W, axis=1, keepdims=True), 1e-12)
+    sims = Wn @ Wn[vocab[word]]
+    sims[vocab[word]] = -np.inf
+    top = np.argpartition(-sims, min(k, len(sims) - 1))[:k]
+    top = top[np.argsort(-sims[top])]
+    return [(vocab.words[i], float(sims[i])) for i in top]
+
+
+def analogy_query(
+    W: np.ndarray, vocab: Vocab, a: str, b: str, c: str, k: int = 5
+) -> List[Tuple[str, float]]:
+    """a:b :: c:? via 3CosAdd (word-analogy tool equivalent)."""
+    for w in (a, b, c):
+        if w not in vocab:
+            raise KeyError(f"{w!r} not in vocabulary")
+    Wn = W / np.maximum(np.linalg.norm(W, axis=1, keepdims=True), 1e-12)
+    q = Wn[vocab[b]] - Wn[vocab[a]] + Wn[vocab[c]]
+    q /= max(np.linalg.norm(q), 1e-12)
+    sims = Wn @ q
+    for w in (a, b, c):
+        sims[vocab[w]] = -np.inf
+    top = np.argpartition(-sims, min(k, len(sims) - 1))[:k]
+    top = top[np.argsort(-sims[top])]
+    return [(vocab.words[i], float(sims[i])) for i in top]

@@ -1,0 +1,106 @@
+"""Word-similarity evaluation (WordSim-353 and compatible datasets).
+
+The reference has no eval tooling at all (SURVEY §3.5); WS-353 Spearman is
+half of the BASELINE.json parity gate, so it is a first-class component here.
+
+Dataset format: one pair per line, `word1 word2 score`, separated by commas,
+tabs or spaces; an optional header line is skipped. Pairs with OOV words are
+dropped (standard protocol) and reported.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from ..data.vocab import Vocab
+
+
+@dataclass
+class SimilarityResult:
+    spearman: float
+    pearson: float
+    pairs_used: int
+    pairs_total: int
+
+
+def _rankdata(x: np.ndarray) -> np.ndarray:
+    """Average ranks with tie handling (scipy-free)."""
+    order = np.argsort(x, kind="stable")
+    ranks = np.empty(len(x), dtype=np.float64)
+    sx = x[order]
+    i = 0
+    while i < len(sx):
+        j = i
+        while j + 1 < len(sx) and sx[j + 1] == sx[i]:
+            j += 1
+        ranks[order[i : j + 1]] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    return ranks
+
+
+def spearman(a: np.ndarray, b: np.ndarray) -> float:
+    ra, rb = _rankdata(a), _rankdata(b)
+    return pearson(ra, rb)
+
+
+def pearson(a: np.ndarray, b: np.ndarray) -> float:
+    a = a - a.mean()
+    b = b - b.mean()
+    denom = np.sqrt((a * a).sum() * (b * b).sum())
+    return float((a * b).sum() / denom) if denom > 0 else 0.0
+
+
+def load_word_pairs(path: str) -> List[Tuple[str, str, float]]:
+    pairs: List[Tuple[str, str, float]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for ln, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            for sep in (",", "\t", None):
+                parts = line.split(sep)
+                if len(parts) >= 3:
+                    break
+            try:
+                score = float(parts[2])
+            except ValueError:
+                if ln == 0:
+                    continue  # header
+                raise
+            pairs.append((parts[0].lower(), parts[1].lower(), score))
+    return pairs
+
+
+def cosine_rows(W: np.ndarray, i: np.ndarray, j: np.ndarray) -> np.ndarray:
+    a, b = W[i], W[j]
+    na = np.linalg.norm(a, axis=-1)
+    nb = np.linalg.norm(b, axis=-1)
+    return (a * b).sum(-1) / np.maximum(na * nb, 1e-12)
+
+
+def evaluate_pairs(
+    W: np.ndarray, vocab: Vocab, pairs: List[Tuple[str, str, float]]
+) -> SimilarityResult:
+    idx_a, idx_b, gold = [], [], []
+    for w1, w2, score in pairs:
+        if w1 in vocab and w2 in vocab:
+            idx_a.append(vocab[w1])
+            idx_b.append(vocab[w2])
+            gold.append(score)
+    if not gold:
+        return SimilarityResult(0.0, 0.0, 0, len(pairs))
+    sims = cosine_rows(W, np.asarray(idx_a), np.asarray(idx_b))
+    gold_arr = np.asarray(gold)
+    return SimilarityResult(
+        spearman=spearman(sims, gold_arr),
+        pearson=pearson(sims, gold_arr),
+        pairs_used=len(gold),
+        pairs_total=len(pairs),
+    )
+
+
+def evaluate_ws353(W: np.ndarray, vocab: Vocab, path: str) -> SimilarityResult:
+    return evaluate_pairs(W, vocab, load_word_pairs(path))

@@ -1,0 +1,239 @@
+"""ctypes bindings for the native host data layer (host_data.cpp).
+
+The shared library is compiled on first use with g++ (cached next to the
+source); every entry point has a pure-Python fallback, so the framework works
+identically without a toolchain — just slower on the host-side corpus pass.
+
+Public API:
+    available() -> bool
+    count_file(path) -> (counts dict, total_words)   [vocab counting]
+    encode_file(path, vocab, mode) -> np.ndarray[int32]
+    fill_batch(flat, starts, lens, order, pos, out) -> words  [batch assembly]
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "host_data.cpp")
+_LIB_PATH = os.path.join(_HERE, "libw2vhost.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+MODE_STREAM = 0  # text8-style whitespace stream
+MODE_LINES = 1   # newline = sentence boundary (-1 separators)
+
+
+def _build() -> Optional[str]:
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        _SRC, "-o", _LIB_PATH,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return _LIB_PATH
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, OSError):
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        path = _LIB_PATH
+        if not os.path.exists(path) or os.path.getmtime(path) < os.path.getmtime(_SRC):
+            path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.w2v_count_file.restype = ctypes.c_void_p
+        lib.w2v_count_file.argtypes = [ctypes.c_char_p]
+        lib.w2v_counter_size.restype = ctypes.c_longlong
+        lib.w2v_counter_size.argtypes = [ctypes.c_void_p]
+        lib.w2v_counter_total.restype = ctypes.c_longlong
+        lib.w2v_counter_total.argtypes = [ctypes.c_void_p]
+        lib.w2v_counter_entry.restype = ctypes.c_longlong
+        lib.w2v_counter_entry.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_char_p, ctypes.c_longlong,
+        ]
+        lib.w2v_counter_free.restype = None
+        lib.w2v_counter_free.argtypes = [ctypes.c_void_p]
+        lib.w2v_vocab_create.restype = ctypes.c_void_p
+        lib.w2v_vocab_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_longlong,
+        ]
+        lib.w2v_vocab_free.restype = None
+        lib.w2v_vocab_free.argtypes = [ctypes.c_void_p]
+        lib.w2v_encode_file.restype = ctypes.c_longlong
+        lib.w2v_encode_file.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_longlong,
+        ]
+        lib.w2v_fill_batch.restype = ctypes.c_longlong
+        lib.w2v_fill_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_int32),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------------------------ counting
+def count_file(path: str) -> Tuple[Dict[str, int], int]:
+    """Word counts + total tokens. Native if possible, else pure Python."""
+    lib = _load()
+    if lib is None:
+        return _count_file_py(path)
+    h = lib.w2v_count_file(path.encode())
+    if not h:
+        raise OSError(f"cannot read {path}")
+    try:
+        n = lib.w2v_counter_size(h)
+        total = lib.w2v_counter_total(h)
+        buf = ctypes.create_string_buffer(1 << 16)
+        counts: Dict[str, int] = {}
+        for i in range(n):
+            c = lib.w2v_counter_entry(h, i, buf, len(buf))
+            if c < 0:
+                raise RuntimeError("counter entry overflow")
+            w = buf.value.decode("utf-8", errors="replace")
+            # distinct invalid-byte tokens can decode to the same U+FFFD
+            # string: merge counts rather than overwrite (matches the Python
+            # fallback, which decodes before counting). Note such tokens still
+            # fail to match raw corpus bytes in encode_file and are dropped as
+            # OOV there — a documented native/Python divergence for non-UTF8
+            # corpora (text8/enwik9 are ASCII).
+            counts[w] = counts.get(w, 0) + c
+        return counts, int(total)
+    finally:
+        lib.w2v_counter_free(h)
+
+
+def _count_file_py(path: str) -> Tuple[Dict[str, int], int]:
+    from collections import Counter
+
+    counter: Counter = Counter()
+    total = 0
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            toks = line.split()
+            counter.update(toks)
+            total += len(toks)
+    return dict(counter), total
+
+
+# ------------------------------------------------------------------- encode
+def encode_file(
+    path: str, vocab, mode: int = MODE_STREAM, max_tokens: Optional[int] = None
+) -> np.ndarray:
+    """Corpus -> flat int32 id stream (OOV dropped, Word2Vec.cpp:223; mode
+    LINES inserts -1 at sentence boundaries). `vocab` is a data.vocab.Vocab.
+
+    max_tokens: total corpus token count if known (from count_file) — sizes
+    the output buffer tightly (ids + separators <= 2*tokens). Without it the
+    bound falls back to the file byte count.
+    """
+    lib = _load()
+    if lib is None:
+        return _encode_file_py(path, vocab, mode)
+    if max_tokens is not None:
+        cap = 2 * max_tokens + 2 if mode == MODE_LINES else max_tokens + 2
+    else:
+        # ids + separators <= whitespace tokens + sentences <= bytes + 2
+        cap = os.path.getsize(path) + 2
+    out = np.empty(cap, dtype=np.int32)
+    words = [w.encode() for w in vocab.words]
+    arr = (ctypes.c_char_p * len(words))(*words)
+    vh = lib.w2v_vocab_create(arr, len(words))
+    if not vh:
+        raise MemoryError("vocab handle")
+    try:
+        n = lib.w2v_encode_file(
+            path.encode(), vh, mode,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap,
+        )
+        if n < 0:
+            raise OSError(f"cannot read {path}")
+        return out[:n].copy()
+    finally:
+        lib.w2v_vocab_free(vh)
+
+
+def _encode_file_py(path: str, vocab, mode: int) -> np.ndarray:
+    w2i = vocab.word2id
+    ids: list = []
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            toks = [w2i[t] for t in line.split() if t in w2i]
+            if mode == MODE_LINES:
+                if toks and ids:
+                    ids.append(-1)
+                ids.extend(toks)
+            else:
+                ids.extend(toks)
+    return np.asarray(ids, dtype=np.int32)
+
+
+# --------------------------------------------------------------- batch fill
+def fill_batch(
+    flat: np.ndarray,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    order: np.ndarray,
+    pos: int,
+    out: np.ndarray,
+) -> int:
+    """Fill out[B, L] (pad -1) from packed-corpus rows order[pos:pos+B];
+    returns real-token count. Native if possible."""
+    lib = _load()
+    if lib is None:
+        return _fill_batch_py(flat, starts, lens, order, pos, out)
+    B, L = out.shape
+    return int(
+        lib.w2v_fill_batch(
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(order), pos, B, L,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+    )
+
+
+def _fill_batch_py(flat, starts, lens, order, pos, out) -> int:
+    B, L = out.shape
+    out[:] = -1
+    words = 0
+    for r in range(B):
+        oi = pos + r
+        if oi >= len(order):
+            continue
+        row = int(order[oi])
+        s, n = int(starts[row]), min(int(lens[row]), L)
+        out[r, :n] = flat[s : s + n]
+        words += n
+    return words

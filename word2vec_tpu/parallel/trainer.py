@@ -138,6 +138,7 @@ class ShardedTrainer(Trainer):
                 f"word_dim {config.word_dim} not divisible by tp={self.tp}"
             )
         self.token_sharding = NamedSharding(self.mesh, TOKEN_SPEC)
+        self._last_sync_step: Optional[int] = None
         super().__init__(config, vocab, corpus, log_fn=log_fn)
 
     # ---------------------------------------------------------------- hooks
@@ -172,16 +173,25 @@ class ShardedTrainer(Trainer):
         cfg = self.config
         if self.dp > 1 and cfg.dp_sync_every and state.step % cfg.dp_sync_every == 0:
             state.params = self.sync_fn(state.params)
+            self._last_sync_step = state.step
 
     def _finalize(self, state: TrainState) -> None:
-        if self.dp > 1:
+        if self.dp > 1 and self._last_sync_step != state.step:
             state.params = self.sync_fn(state.params)
+            self._last_sync_step = state.step
 
     # ----------------------------------------------------------------- api
     def export_params(self, state: TrainState) -> Params:
         """Synced, de-replicated [V, d] tables on host."""
-        params = state.params
-        if self.dp > 1:
-            params = self.sync_fn(params)
-            state.params = params
-        return {k: np.asarray(v[0]) for k, v in params.items()}
+        if self.dp > 1 and self._last_sync_step != state.step:
+            state.params = self.sync_fn(state.params)
+            self._last_sync_step = state.step
+        return {k: np.asarray(v[0]) for k, v in state.params.items()}
+
+    def import_params(self, params: Params, state: TrainState) -> None:
+        """Load unreplicated [V, d] tables (e.g. from a checkpoint) into the
+        sharded layout."""
+        state.params = replicate_params(
+            {k: np.asarray(v) for k, v in params.items()}, self.mesh
+        )
+        self._last_sync_step = state.step

@@ -114,6 +114,8 @@ class Trainer:
         t0 = time.perf_counter()
         loss_hist: List[float] = []
         last_metrics = None
+        # state.epoch = next epoch to run; a mid-epoch checkpoint resumes from
+        # the start of its epoch (batch position within an epoch is not saved)
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
             for tokens, words in prefetch(self._batches(batcher)):
@@ -143,6 +145,7 @@ class Trainer:
                         )
                 if checkpoint_every and checkpoint_cb and state.step % checkpoint_every == 0:
                     checkpoint_cb(state)
+            state.epoch = epoch + 1  # epoch completed
 
         self._finalize(state)
         # ensure all device work is done before timing

@@ -1,0 +1,47 @@
+"""Structured training logs.
+
+The reference's observability is a single in-place printf of alpha + percent
+every 100 sentences (Word2Vec.cpp:382-385). Here every log record is a dict
+(step, epoch, alpha, loss, progress, words_per_sec) routed through a callback;
+`progress_logger` renders the reference-style single-line console view with
+the north-star words/sec added, and `jsonl_logger` writes machine-readable
+JSONL for dashboards.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Callable, Dict, IO, Optional
+
+
+def progress_logger(stream: IO = sys.stderr) -> Callable[[Dict], None]:
+    """Reference-style one-line progress (Word2Vec.cpp:384) + words/sec."""
+
+    def log(m: Dict) -> None:
+        stream.write(
+            f"\ralpha: {m['alpha']:.6f}  progress: {100 * m.get('progress', 0):6.2f}%  "
+            f"loss: {m['loss']:.4f}  {m['words_per_sec']:,.0f} words/sec "
+        )
+        stream.flush()
+
+    return log
+
+
+def jsonl_logger(path: str) -> Callable[[Dict], None]:
+    f = open(path, "a", buffering=1)
+
+    def log(m: Dict) -> None:
+        f.write(json.dumps(m) + "\n")
+
+    return log
+
+
+def tee(*loggers: Optional[Callable[[Dict], None]]) -> Callable[[Dict], None]:
+    active = [l for l in loggers if l is not None]
+
+    def log(m: Dict) -> None:
+        for l in active:
+            l(m)
+
+    return log

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Measure the C++ reference's training words/sec -> benchmarks/reference_baseline.json.
+
+BASELINE.md: "the baseline must be measured, not looked up" — the reference
+publishes no numbers. This harness:
+
+1. compiles /root/reference/{main,Word2Vec}.cpp against the eigen-lite shim
+   (this machine has no Eigen; see eigen_lite/Eigen/Dense) with the
+   reference's own flags (-Ofast -march=native -funroll-loops -fopenmp,
+   main.cpp:2),
+2. synthesizes the same Zipf corpus bench.py uses (same vocab size/skew) as a
+   ./text8 file (the reference hardcodes that path, main.cpp:68),
+3. runs the flagship config (sg + ns, negative=5, dim=300, window=5) at
+   -iter 1 and -iter 3 and derives pure training throughput from the wall
+   difference (subtracting corpus read + vocab build, which both runs share),
+4. writes {words_per_sec, ...} consumed by bench.py's vs_baseline.
+
+The reference binary and corpus live in a temp dir; nothing from
+/root/reference is copied into the repo.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+REFERENCE = "/root/reference"
+
+
+def build(tmp: str) -> str:
+    exe = os.path.join(tmp, "word2vec_ref")
+    cmd = [
+        "g++",
+        os.path.join(REFERENCE, "main.cpp"),
+        os.path.join(REFERENCE, "Word2Vec.cpp"),
+        "-o", exe,
+        "-I", os.path.join(HERE, "eigen_lite"),
+        "-std=c++11", "-Ofast", "-march=native", "-funroll-loops", "-fopenmp",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return exe
+
+
+def write_corpus(tmp: str, num_tokens: int) -> int:
+    sys.path.insert(0, REPO)
+    from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+    vocab = zipf_vocab(71000, 17_000_000)
+    ids = zipf_corpus_ids(vocab, num_tokens, seed=0)
+    with open(os.path.join(tmp, "text8"), "w") as f:
+        for sent in ids:
+            f.write(" ".join(f"w{i}" for i in sent))
+            f.write(" ")
+    return num_tokens
+
+
+def run_ref(exe: str, tmp: str, iters: int, threads: int, dim: int) -> float:
+    t0 = time.perf_counter()
+    subprocess.run(
+        [
+            exe, "-train", "text8", "-output", "", "-model", "sg",
+            "-train_method", "ns", "-negative", "5", "-size", str(dim),
+            "-window", "5", "-subsample", "1e-4", "-iter", str(iters),
+            "-threads", str(threads), "-min-count", "5",
+        ],
+        cwd=tmp, check=True, capture_output=True,
+    )
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=2_000_000)
+    ap.add_argument("--dim", type=int, default=300)
+    ap.add_argument("--threads", type=int, default=os.cpu_count() or 1)
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        exe = build(tmp)
+        tokens = write_corpus(tmp, args.tokens)
+        t1 = run_ref(exe, tmp, 1, args.threads, args.dim)
+        t3 = run_ref(exe, tmp, 3, args.threads, args.dim)
+        train_time_2_iters = t3 - t1
+        wps = 2 * tokens / train_time_2_iters
+
+    out = {
+        "words_per_sec": round(wps, 1),
+        "config": f"sg+ns k=5 dim={args.dim} w=5, subsample 1e-4, "
+        f"threads={args.threads}",
+        "corpus": f"zipf-synthetic-{args.tokens} tokens (V=71k text8-like)",
+        "method": "(t_iter3 - t_iter1) / 2 epochs; eigen-lite shim; "
+        "-Ofast -march=native -funroll-loops -fopenmp",
+        "host_cpus": os.cpu_count(),
+        "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+    path = os.path.join(REPO, "benchmarks", "reference_baseline.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

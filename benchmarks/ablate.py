@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Ablation harness for the device training step.
+
+Times (a) the full jitted step for each kernel ("pair" from ops/train_step.py,
+"band" from ops/band_step.py) and (b) the band kernel's constituent pieces
+(gathers, band matmuls, negative matmuls, sorted scatters) in isolation, on
+whatever device JAX resolves (TPU in anger, CPU with --cpu).
+
+This is the perf tool behind the kernel choice documented in
+word2vec_tpu/config.py (kernel="auto"); run it after touching ops/ to see
+where the step time goes.
+
+Usage:
+  python benchmarks/ablate.py [--dim 300] [--rows 64] [--len 192]
+                              [--negative 5] [--shared-negatives 64]
+                              [--steps 30] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def timeit(name, fn, *args, steps=30):
+    import jax
+
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / steps
+    print(f"  {name:<38s} {dt * 1e3:8.3f} ms")
+    return dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dim", type=int, default=300)
+    ap.add_argument("--rows", type=int, default=64)
+    ap.add_argument("--len", dest="length", type=int, default=192)
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--negative", type=int, default=5)
+    ap.add_argument("--shared-negatives", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=71000)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.models.params import init_params
+    from word2vec_tpu.ops.tables import DeviceTables
+    from word2vec_tpu.ops.train_step import jit_train_step
+    from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev.device_kind})")
+    B, L, D, KP = args.rows, args.length, args.dim, args.shared_negatives
+    words_per_step = B * L
+
+    # ---- full-step comparison on a realistic Zipf batch
+    vocab = zipf_vocab(args.vocab, 17_000_000)
+    ids = zipf_corpus_ids(vocab, B * L * 4, seed=0)
+    tokens = np.full((B, L), -1, np.int32)
+    flat = np.concatenate(ids)[: B * L]
+    tokens.reshape(-1)[: flat.size] = flat
+    tokens_d = jnp.asarray(tokens)
+    key = jax.random.key(0)
+
+    for kern in ("band", "pair"):
+        cfg = Word2VecConfig(
+            model="sg", train_method="ns", negative=args.negative,
+            word_dim=D, window=args.window, subsample_threshold=1e-4,
+            batch_rows=B, max_sentence_len=L, kernel=kern,
+            shared_negatives=KP,
+        )
+        tables = DeviceTables.build(vocab, cfg)
+        step = jit_train_step(cfg, tables)
+        params = init_params(cfg, len(vocab), jax.random.key(1))
+        alpha = jnp.float32(cfg.init_alpha)
+
+        def run(p, t, k):
+            new_p, _ = step(p, t, k, alpha)
+            return new_p
+
+        dt = timeit(f"full step [{kern}]", run, params, tokens_d, key,
+                    steps=args.steps)
+        print(f"    -> {words_per_step / dt:,.0f} words/sec")
+
+    # ---- band-kernel piece timings (same shapes as the step above)
+    print("band pieces:")
+    rng = np.random.default_rng(0)
+    emb = jnp.asarray(rng.standard_normal((args.vocab, D)).astype(np.float32))
+    tok = jnp.asarray(rng.integers(0, args.vocab, (B, L)).astype(np.int32))
+    negs = jnp.asarray(rng.integers(0, args.vocab, (B, KP)).astype(np.int32))
+    band = jnp.asarray((rng.random((B, L, L)) < 0.05).astype(np.float32))
+    gl = jnp.asarray(rng.standard_normal((B, L, L)).astype(np.float32))
+    gn = jnp.asarray(rng.standard_normal((B, L, KP)).astype(np.float32))
+    bf = jnp.bfloat16
+
+    timeit("gather ein/eout [B,L,d]x2",
+           jax.jit(lambda e, t: (e[t], e[t])), emb, tok, steps=args.steps)
+    timeit("pos logits bij (bf16)",
+           jax.jit(lambda e, t: jnp.einsum(
+               "bid,bjd->bij", e[t].astype(bf), e[t].astype(bf),
+               preferred_element_type=jnp.float32)),
+           emb, tok, steps=args.steps)
+    timeit("pos grads bjd+bid (bf16)",
+           jax.jit(lambda g, e, t: (
+               jnp.einsum("bij,bjd->bid", g.astype(bf), e[t].astype(bf),
+                          preferred_element_type=jnp.float32),
+               jnp.einsum("bij,bid->bjd", g.astype(bf), e[t].astype(bf),
+                          preferred_element_type=jnp.float32))),
+           gl, emb, tok, steps=args.steps)
+    timeit("neg logits bin (bf16)",
+           jax.jit(lambda e, t, n: jnp.einsum(
+               "bid,bnd->bin", e[t].astype(bf), e[n].astype(bf),
+               preferred_element_type=jnp.float32)),
+           emb, tok, negs, steps=args.steps)
+    timeit("neg grads bnd (bf16)",
+           jax.jit(lambda g, e, t: jnp.einsum(
+               "bin,bid->bnd", g.astype(bf), e[t].astype(bf),
+               preferred_element_type=jnp.float32)),
+           gn, emb, tok, steps=args.steps)
+
+    def sorted_scatter(e, t, v):
+        f = t.reshape(-1)
+        order = jnp.argsort(f)
+        return e.at[f[order]].add(
+            v.reshape(-1, D)[order], indices_are_sorted=True
+        )
+
+    vals = jnp.asarray(rng.standard_normal((B, L, D)).astype(np.float32))
+    timeit("sorted scatter-add [B*L rows]",
+           jax.jit(sorted_scatter), emb, tok, vals, steps=args.steps)
+    timeit("unsorted scatter-add [B*L rows]",
+           jax.jit(lambda e, t, v: e.at[t.reshape(-1)].add(v.reshape(-1, D))),
+           emb, tok, vals, steps=args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,266 @@
+"""Golden-oracle test for the band kernel (ops/band_step.py).
+
+Same pinned-randomness trick as test_train_step_golden.py (window=1 => no
+shrink; subsample_threshold=0 => keep all; degenerate alias table => every
+negative draw is word 0), plus a NumPy oracle that encodes the band kernel's
+OWN documented semantics: shared per-row negatives with k_i/KP expectation
+weights and the center/context collision mask. With all draws equal to word 0
+the KP shared draws collapse to a single weighted update, so the oracle needs
+no RNG at all.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.negative import build_alias_table
+from word2vec_tpu.ops.band_step import make_band_train_step
+from word2vec_tpu.ops.tables import DeviceTables
+from word2vec_tpu.ops.train_step import make_train_step
+
+V, D = 12, 8
+ALPHA = 0.02
+KP = 4  # shared draws per row; all land on word 0 via the degenerate table
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def make_tables():
+    keep = jnp.ones(V, jnp.float32)
+    p = np.zeros(V)
+    p[0] = 1.0
+    at = build_alias_table(p)
+    return DeviceTables(
+        keep, jnp.asarray(at.accept), jnp.asarray(at.alias), None, None, None
+    )
+
+
+def make_params(cfg, rng):
+    params = {
+        "emb_in": rng.normal(0, 0.1, (V, D)),
+        "emb_out_ns": rng.normal(0, 0.1, (V, D)),
+    }
+    return {k: v.astype(np.float32) for k, v in params.items()}
+
+
+def band_oracle(cfg, params, tokens, alpha, scatter_mean=False):
+    """Band-kernel semantics, scalar NumPy, all reads pre-update.
+
+    With scatter_mean, gradients are accumulated per destination row along
+    with per-pair contribution weights (joint across positive targets and
+    shared negative draws on emb_out) and normalized at the end — mirroring
+    the kernel's batched normalization.
+    """
+    K = cfg.negative
+    B, L = tokens.shape
+    d_in = np.zeros((V, D), np.float64)
+    w_in = np.zeros(V, np.float64)
+    d_out = np.zeros((V, D), np.float64)
+    w_out = np.zeros(V, np.float64)
+    neg_row = params["emb_out_ns"][0].astype(np.float64)  # every draw is word 0
+    for b in range(B):
+        for i in range(L):
+            center = tokens[b, i]
+            if center < 0:
+                continue
+            ctx = [
+                tokens[b, j]
+                for j in (i - 1, i + 1)
+                if 0 <= j < L and tokens[b, j] >= 0
+            ]
+            n_ctx = len(ctx)
+            if n_ctx == 0:
+                continue
+            if cfg.model == "sg":
+                h = params["emb_in"][center].astype(np.float64)
+                k_i = n_ctx * K
+            else:
+                h = np.sum(
+                    [params["emb_in"][c].astype(np.float64) for c in ctx], axis=0
+                )
+                if cfg.cbow_mean:
+                    h = h / n_ctx
+                k_i = K
+            grad_h = np.zeros(D, np.float64)
+            # positives
+            preds = ctx if cfg.model == "sg" else [center]
+            for pred in preds:
+                row = params["emb_out_ns"][pred].astype(np.float64)
+                g = (1.0 - sigmoid(row @ h)) * alpha
+                grad_h += g * row
+                d_out[pred] += g * h
+                w_out[pred] += 1.0
+            # shared negatives: KP draws of word 0, weight k_i/KP each,
+            # masked if word 0 is the center or in the active context set
+            if center != 0 and 0 not in ctx:
+                w = k_i  # KP * (k_i / KP)
+                g = (0.0 - sigmoid(neg_row @ h)) * w * alpha
+                grad_h += g * neg_row
+                d_out[0] += g * h
+                w_out[0] += k_i  # expected per-pair draw count
+            if cfg.model == "sg":
+                d_in[center] += grad_h
+                w_in[center] += 1.0
+            else:
+                if cfg.cbow_mean:
+                    grad_h = grad_h / n_ctx
+                for c in ctx:
+                    d_in[c] += grad_h
+                    w_in[c] += 1.0
+    if scatter_mean:
+        d_in /= np.maximum(w_in, 1.0)[:, None]
+        d_out /= np.maximum(w_out, 1.0)[:, None]
+    new = {k: v.copy() for k, v in params.items()}
+    new["emb_in"] += d_in.astype(np.float32)
+    new["emb_out_ns"] += d_out.astype(np.float32)
+    return new
+
+
+CONFIGS = [
+    dict(model="sg", negative=3),
+    dict(model="cbow", negative=2, cbow_mean=True),
+    dict(model="cbow", negative=2, cbow_mean=False),
+]
+
+
+@pytest.mark.parametrize(
+    "kw", CONFIGS, ids=lambda kw: f"{kw['model']}-mean{kw.get('cbow_mean')}"
+)
+def test_band_step_matches_oracle(kw):
+    cfg = Word2VecConfig(
+        window=1, subsample_threshold=0.0, word_dim=D, scatter_mean=False,
+        kernel="band", compute_dtype="float32", shared_negatives=KP,
+        train_method="ns", **kw
+    )
+    tables = make_tables()
+    rng = np.random.default_rng(42)
+    params = make_params(cfg, rng)
+    tokens = np.array(
+        [
+            [3, 1, 4, 1, 5, 9, 2, 6, -1],
+            # word 0 present: exercises the collision mask
+            [0, 7, 1, 0, -1, -1, -1, -1, -1],
+        ],
+        dtype=np.int32,
+    )
+
+    step = make_band_train_step(cfg, tables)
+    jparams = {k: jnp.asarray(v) for k, v in params.items()}
+    new_j, metrics = jax.jit(step)(
+        jparams, jnp.asarray(tokens), jax.random.key(0), jnp.float32(ALPHA)
+    )
+
+    expected = band_oracle(cfg, params, tokens, ALPHA)
+    for k in expected:
+        np.testing.assert_allclose(
+            np.asarray(new_j[k]), expected[k], atol=2e-5, err_msg=k
+        )
+    assert float(metrics["pairs"]) > 0
+    assert np.isfinite(float(metrics["loss_sum"]))
+
+
+@pytest.mark.parametrize(
+    "kw", CONFIGS, ids=lambda kw: f"{kw['model']}-mean{kw.get('cbow_mean')}"
+)
+def test_band_step_matches_oracle_scatter_mean(kw):
+    """scatter_mean=True (the default): per-pair contribution counts with a
+    JOINT normalization over positive targets and negative draws on emb_out.
+    Word 0 appears both as corpus token and as every negative draw, so its
+    row exercises the joint count."""
+    cfg = Word2VecConfig(
+        window=1, subsample_threshold=0.0, word_dim=D, scatter_mean=True,
+        kernel="band", compute_dtype="float32", shared_negatives=KP,
+        train_method="ns", **kw
+    )
+    tables = make_tables()
+    rng = np.random.default_rng(21)
+    params = make_params(cfg, rng)
+    tokens = np.array(
+        [
+            [3, 1, 4, 1, 5, 9, 2, 6, -1],
+            [0, 7, 1, 0, 5, 3, -1, -1, -1],
+        ],
+        dtype=np.int32,
+    )
+
+    step = make_band_train_step(cfg, tables)
+    jparams = {k: jnp.asarray(v) for k, v in params.items()}
+    new_j, _ = jax.jit(step)(
+        jparams, jnp.asarray(tokens), jax.random.key(3), jnp.float32(ALPHA)
+    )
+
+    expected = band_oracle(cfg, params, tokens, ALPHA, scatter_mean=True)
+    for k in expected:
+        np.testing.assert_allclose(
+            np.asarray(new_j[k]), expected[k], atol=2e-5, err_msg=k
+        )
+
+
+def test_auto_kernel_resolves_to_band_for_ns():
+    cfg = Word2VecConfig(model="sg", train_method="ns", negative=5)
+    assert cfg.resolved_kernel == "band"
+    cfg_hs = Word2VecConfig(model="sg", train_method="hs", negative=0)
+    assert cfg_hs.resolved_kernel == "pair"
+
+
+def test_band_pad_only_batch_is_noop():
+    cfg = Word2VecConfig(
+        window=1, subsample_threshold=0.0, word_dim=D, model="sg",
+        train_method="ns", negative=2, kernel="band",
+        compute_dtype="float32", shared_negatives=KP,
+    )
+    tables = make_tables()
+    rng = np.random.default_rng(9)
+    params = {k: jnp.asarray(v) for k, v in make_params(cfg, rng).items()}
+    tokens = jnp.full((2, 6), -1, dtype=jnp.int32)
+    step = jax.jit(make_band_train_step(cfg, tables))
+    new, metrics = step(params, tokens, jax.random.key(1), jnp.float32(ALPHA))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(new[k]), np.asarray(params[k]))
+    assert float(metrics["pairs"]) == 0.0
+
+
+@pytest.mark.parametrize("window", [1, 3])
+@pytest.mark.parametrize("scatter_mean", [False, True])
+def test_band_vs_pair_agree_without_collisions(window, scatter_mean):
+    """With the degenerate table every draw is word 0 in both kernels, and a
+    batch containing no word 0 never triggers either collision mask — so the
+    band kernel's k_i-weighted shared draws must equal the pair kernel's
+    per-pair draws EXACTLY (all reads are pre-update in both).
+
+    window=3 exercises the band mask's window-shrink path: both kernels draw
+    w_eff from the same key split with the same (B, L) shape, so their
+    shrunk windows are identical and agreement stays exact. scatter_mean=True
+    additionally pins the two kernels' duplicate-normalization counting to
+    each other."""
+    kw = dict(
+        window=window, subsample_threshold=0.0, word_dim=D, model="sg",
+        train_method="ns", negative=2, scatter_mean=scatter_mean,
+        compute_dtype="float32",
+    )
+    tables = make_tables()
+    rng = np.random.default_rng(5)
+    params_np = make_params(Word2VecConfig(kernel="pair", **kw), rng)
+    tokens = jnp.asarray(
+        np.array(
+            [[3, 1, 4, 1, 5, 9, 2, 6, -1], [2, 7, 1, 8, 2, -1, -1, -1, -1]],
+            dtype=np.int32,
+        )
+    )
+    outs = {}
+    for kernel in ("pair", "band"):
+        cfg = Word2VecConfig(kernel=kernel, shared_negatives=KP, **kw)
+        step = jax.jit(make_train_step(cfg, tables))
+        params = {k: jnp.asarray(v) for k, v in params_np.items()}
+        new, _ = step(params, tokens, jax.random.key(2), jnp.float32(ALPHA))
+        outs[kernel] = new
+    for k in outs["pair"]:
+        np.testing.assert_allclose(
+            np.asarray(outs["pair"][k]), np.asarray(outs["band"][k]),
+            atol=2e-5, err_msg=k,
+        )

@@ -131,9 +131,12 @@ CONFIGS = [
 
 @pytest.mark.parametrize("kw", CONFIGS, ids=lambda kw: f"{kw['model']}-{kw['train_method']}-mean{kw.get('cbow_mean')}")
 def test_step_matches_oracle(kw):
-    # scatter_mean=False: the oracle implements reference-exact sum semantics
+    # scatter_mean=False: the oracle implements reference-exact sum semantics.
+    # kernel="pair" + f32 compute: this oracle encodes per-pair negative
+    # draws; the band kernel has its own oracle in test_band_step_golden.py.
     cfg = Word2VecConfig(
-        window=1, subsample_threshold=0.0, word_dim=D, scatter_mean=False, **kw
+        window=1, subsample_threshold=0.0, word_dim=D, scatter_mean=False,
+        kernel="pair", compute_dtype="float32", **kw
     )
     tables, hc = make_tables(cfg)
     rng = np.random.default_rng(42)
@@ -167,7 +170,8 @@ def test_scatter_mean_matches_sum_when_no_duplicates():
     """With every center word unique in the batch, duplicate-count
     normalization must be a no-op on emb_in (factor 1.0 everywhere)."""
     kw = dict(window=1, subsample_threshold=0.0, word_dim=D, model="sg",
-              train_method="ns", negative=2)
+              train_method="ns", negative=2, kernel="pair",
+              compute_dtype="float32")
     tables, _ = make_tables(Word2VecConfig(**kw))
     rng = np.random.default_rng(11)
     params_np = make_params(Word2VecConfig(**kw), rng)
@@ -195,6 +199,7 @@ def test_scatter_mean_stable_on_degenerate_corpus():
     cfg = Word2VecConfig(
         window=2, subsample_threshold=0.0, word_dim=D, model="sg",
         train_method="ns", negative=5, init_alpha=0.05, scatter_mean=True,
+        kernel="pair", compute_dtype="float32",
     )
     tables, _ = make_tables(cfg)
     rng = np.random.default_rng(13)
@@ -211,7 +216,7 @@ def test_scatter_mean_stable_on_degenerate_corpus():
 def test_step_is_deterministic():
     cfg = Word2VecConfig(
         window=1, subsample_threshold=0.0, word_dim=D, model="sg",
-        train_method="ns", negative=3,
+        train_method="ns", negative=3, kernel="pair", compute_dtype="float32",
     )
     tables, _ = make_tables(cfg)
     rng = np.random.default_rng(7)
@@ -227,7 +232,7 @@ def test_step_is_deterministic():
 def test_pad_only_batch_is_noop():
     cfg = Word2VecConfig(
         window=1, subsample_threshold=0.0, word_dim=D, model="sg",
-        train_method="ns", negative=2,
+        train_method="ns", negative=2, kernel="pair", compute_dtype="float32",
     )
     tables, _ = make_tables(cfg)
     rng = np.random.default_rng(9)

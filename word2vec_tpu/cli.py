@@ -67,6 +67,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
     p.add_argument("--dp-sync-every", type=int, default=64)
     p.add_argument("--batch-rows", type=int, default=32)
+    p.add_argument("--kernel", choices=["auto", "band", "pair"], default="auto",
+                   help="device kernel: band = MXU fast path (ns only), "
+                        "pair = reference-faithful per-pair enumeration")
+    p.add_argument("--compute-dtype", choices=["bfloat16", "float32"],
+                   default="bfloat16",
+                   help="dot-product dtype; float32 for reference-exact scores")
+    p.add_argument("--shared-negatives", type=int, default=64,
+                   help="shared negative draws per batch row (band kernel)")
     p.add_argument("--max-sentence-len", type=int, default=192)
     p.add_argument("--corpus-format", choices=["text8", "lines"], default="text8",
                    help="text8: 1000-word chunks (main.cpp:63-92); "
@@ -134,6 +142,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             max_sentence_len=args.max_sentence_len,
             seed=args.seed,
             dp_sync_every=args.dp_sync_every,
+            kernel=args.kernel,
+            compute_dtype=args.compute_dtype,
+            shared_negatives=args.shared_negatives,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

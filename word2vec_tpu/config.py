@@ -53,11 +53,23 @@ class Word2VecConfig:
     ns_power: float = 0.75  # unigram distortion (Word2Vec.cpp:85)
 
     # --- TPU batch geometry (no reference counterpart) ---
-    batch_rows: int = 64     # sentences (rows) per device step
+    batch_rows: int = 256    # sentences (rows) per device step
     max_sentence_len: int = 192  # tokens per row; longer sentences are wrapped
     seed: int = 0
     dtype: str = "float32"   # accumulation/storage dtype of the embedding tables
-    compute_dtype: str = "float32"  # dot-product dtype ("bfloat16" for MXU-friendly scoring)
+    compute_dtype: str = "bfloat16"  # dot-product dtype (MXU-native; "float32" for exactness)
+
+    # Which device kernel realizes the objective (ops/):
+    #   "band" — banded-matmul formulation with shared negatives
+    #            (ops/band_step.py; the fast path, ns only)
+    #   "pair" — explicit per-pair enumeration, reference-faithful semantics
+    #            incl. per-pair negative draws (ops/train_step.py)
+    #   "auto" — band when it applies (ns without hs), else pair
+    kernel: str = "auto"
+    # Shared negative draws per batch row for the band kernel; each center
+    # weights them by (its reference draw count) / shared_negatives, so the
+    # expected update matches per-pair sampling (see ops/band_step.py).
+    shared_negatives: int = 64
 
     # Batched-update stabilizer. The reference's Hogwild updates are sequential:
     # after each update to a row, the next sigmoid sees the moved row, so
@@ -90,6 +102,19 @@ class Word2VecConfig:
             raise ValueError("hs and negative > 0 are mutually exclusive (main.cpp:169-172)")
         if self.window < 1:
             raise ValueError("window must be >= 1")
+        if self.kernel not in ("auto", "band", "pair"):
+            raise ValueError(f"kernel must be auto|band|pair, got {self.kernel!r}")
+        if self.kernel == "band" and (self.use_hs or not self.use_ns):
+            raise ValueError("kernel='band' requires negative sampling (no hs)")
+        if self.shared_negatives < 1:
+            raise ValueError("shared_negatives must be >= 1")
+
+    @property
+    def resolved_kernel(self) -> str:
+        """The kernel 'auto' resolves to for this config."""
+        if self.kernel != "auto":
+            return self.kernel
+        return "band" if (self.use_ns and not self.use_hs) else "pair"
 
     @property
     def use_hs(self) -> bool:

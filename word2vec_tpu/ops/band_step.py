@@ -1,0 +1,294 @@
+"""Banded-matmul training step: the MXU-shaped fast path for negative sampling.
+
+The pair kernel (ops/train_step.py) enumerates (center, context) pairs
+explicitly and scatters per-pair gradients — faithful, but its cost on TPU is
+dominated by materializing [P, T, d] tensors and a P*(1+K)-row scatter-add.
+This module re-expresses the same objective in the shapes the hardware wants
+(measured on v5e: ~25-60x the pair kernel at dim=300):
+
+  positives  — every (center, context) pair inside a [B, L] batch row is
+               scored by ONE batched matmul  logits[b,i,j] = in_i . out_j,
+               masked to the window band |i-j| <= w_eff(b,i), j != i
+               (the j-loop of Word2Vec.cpp:339-341 becomes a band mask).
+               Both gradient sides are again band matmuls, so the update
+               touches only B*L aggregated rows per table instead of
+               B*L*2W per-pair rows.
+  negatives  — drawn SHARED per batch row ([B, KP] ids from the alias table)
+               instead of per pair, turning the negative score/update into
+               dense [L, d] x [d, KP] matmuls with no scatter at all for the
+               score side and a KP-row scatter for the update. Each center i
+               weights every shared draw by k_i / KP, where k_i is the number
+               of draws the reference would have made for it (SG: n_ctx(i)*K
+               per Word2Vec.cpp:339-349; CBOW: K per Word2Vec.cpp:304-311),
+               so the expected update equals the reference's per-pair
+               sampling; only the variance/correlation structure differs
+               (draws are shared across the centers of a row). This is the
+               standard batched-SGNS trade (e.g. candidate sampling) and is
+               validated by the eval-parity gate, not bitwise.
+  scatter    — token-id scatters are pre-sorted (argsort once, reused for
+               both tables) so XLA takes the sorted-indices fast path.
+
+Semantics deltas vs the reference, all documented and eval-gated:
+  * shared negatives (above);
+  * a drawn negative colliding with the row's *center or active context set*
+    is masked out for that center, approximating word2vec.c's per-pair
+    "target == positive -> skip" (the reference instead relabels it to 1 via
+    its dedup map, Word2Vec.cpp:253-257);
+  * within-batch gradient staleness, as in the pair kernel (SURVEY §7(a));
+  * scatter_mean normalizes by per-pair contribution counts like the pair
+    kernel, but the within-row aggregation (one gradient per token position)
+    is already summed before the scatter, and the emb_out count is joint
+    across positive targets and shared negative draws (each draw counting
+    its expected per-pair multiplicity k_i/KP summed over centers).
+
+Hierarchical softmax has no dense reformulation (per-word Huffman paths), so
+config.kernel="auto" routes hs to the pair kernel.
+
+Mesh axes mirror the pair kernel: with tp_axis the embedding dim is sharded
+and every logit matmul is psum'd over the axis before the sigmoid; all
+gradients are then local to the dim shard. With dp_axis the PRNG key is
+folded with the shard index.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Word2VecConfig
+from ..models.params import Params
+from .tables import DeviceTables
+from .train_step import _draw_negatives, _dup_mean_scale
+
+Metrics = Dict[str, jnp.ndarray]
+
+
+def make_band_train_step(
+    config: Word2VecConfig,
+    tables: DeviceTables,
+    tp_axis: str | None = None,
+    dp_axis: str | None = None,
+) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
+    """step(params, tokens[B,L], key, alpha) -> (params, metrics).
+
+    Same contract as train_step.make_train_step; negative sampling only.
+    """
+    if not config.use_ns or config.use_hs:
+        raise ValueError("band kernel supports negative sampling only (use pair for hs)")
+    W = config.window
+    K = config.negative
+    KP = config.shared_negatives
+    is_cbow = config.model == "cbow"
+    cbow_mean = config.cbow_mean
+    scatter_mean = config.scatter_mean
+    cdt = jnp.dtype(config.compute_dtype)
+
+    def psum(x):
+        return jax.lax.psum(x, tp_axis) if tp_axis is not None else x
+
+    def step(
+        params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
+    ) -> Tuple[Params, Metrics]:
+        B, L = tokens.shape
+        if dp_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+        k_sub, k_win, k_neg = jax.random.split(key, 3)
+
+        valid = tokens >= 0
+        tok = jnp.where(valid, tokens, 0)
+
+        # Center-word subsample gate (Word2Vec.cpp:282,332) and per-center
+        # window shrink w_eff in {1..W} (Word2Vec.cpp:285-287,335-337).
+        keep = valid & (jax.random.uniform(k_sub, (B, L)) < tables.keep_probs[tok])
+        w_eff = W - jax.random.randint(k_win, (B, L), 0, W, dtype=jnp.int32)
+
+        # Band mask over the [L, L] pair plane: rows = centers, cols = contexts.
+        i_idx = jnp.arange(L, dtype=jnp.int32)
+        dist = jnp.abs(i_idx[:, None] - i_idx[None, :])  # [L, L]
+        band = (
+            keep[:, :, None]                      # center gate
+            & valid[:, None, :]                   # context validity
+            & (dist[None] <= w_eff[:, :, None])   # shrunk window
+            & (dist[None] > 0)                    # j != i
+        )
+        band_f = band.astype(jnp.float32)  # [B, L, L]
+        n_ctx = band_f.sum(axis=2)         # [B, L] active contexts per center
+
+        emb_in = params["emb_in"]
+        emb_out = params["emb_out_ns"]
+        ein = emb_in[tok]   # [B, L, d]
+        eout = emb_out[tok]  # [B, L, d]
+
+        # Shared negatives per row + collision mask vs the row's centers and
+        # active contexts (see module docstring).
+        negs = _draw_negatives(
+            k_neg, (B, KP), tables.alias_accept, tables.alias_idx
+        )  # [B, KP]
+        en = emb_out[negs]  # [B, KP, d]
+        center_hit = tok[:, :, None] == negs[:, None, :]  # [B, L, KP]
+        # context collision: neg n hits center i if any active context j of i
+        # carries the same token id
+        # 0/1 operands with row sums <= 2W, exactly representable in bf16, so
+        # computing the mask matmul in cdt is bit-identical under "> 0"
+        ctx_hit = jnp.einsum(
+            "bij,bjn->bin",
+            band_f.astype(cdt),
+            center_hit.astype(cdt),
+            preferred_element_type=jnp.float32,
+        ) > 0.0
+        neg_ok = ~(center_hit | ctx_hit)  # [B, L, KP]
+
+        if not is_cbow:
+            h = ein                       # projection = center row (W), :330
+            k_i = n_ctx * K               # reference draws per center
+        else:
+            # projection = (mean of) context rows of emb_in (C), :300-302
+            h = jnp.einsum(
+                "bij,bjd->bid",
+                band_f.astype(cdt),
+                ein.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            if cbow_mean:
+                h = h / jnp.maximum(n_ctx, 1.0)[:, :, None]
+            k_i = jnp.where(n_ctx > 0, float(K), 0.0)  # ns once per center, :304
+
+        # ---- negative side: dense matmuls against the shared draws
+        nlog = psum(
+            jnp.einsum(
+                "bid,bnd->bin",
+                h.astype(cdt),
+                en.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+        )  # [B, L, KP]
+        w_neg = (k_i / KP)[:, :, None] * neg_ok  # [B, L, KP]
+        gn = (0.0 - jax.nn.sigmoid(nlog)) * w_neg * alpha
+        d_h = jnp.einsum(
+            "bin,bnd->bid",
+            gn.astype(cdt),
+            en.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )  # [B, L, d]
+        d_neg = jnp.einsum(
+            "bin,bid->bnd",
+            gn.astype(cdt),
+            h.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )  # [B, KP, d]
+
+        # ---- positive side
+        if not is_cbow:
+            # logits over the whole band in one batched matmul
+            plog = psum(
+                jnp.einsum(
+                    "bid,bjd->bij",
+                    ein.astype(cdt),
+                    eout.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+            )  # [B, L, L]
+            gp = (1.0 - jax.nn.sigmoid(plog)) * band_f * alpha  # label 1
+            d_h = d_h + jnp.einsum(
+                "bij,bjd->bid",
+                gp.astype(cdt),
+                eout.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            d_out_pos = jnp.einsum(
+                "bij,bid->bjd",
+                gp.astype(cdt),
+                ein.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )  # [B, L, d] — per context position
+            d_in_pos = d_h  # accumulated on the center row (W.row += grad, :351)
+            pos_loss = -jnp.sum(band_f * jax.nn.log_sigmoid(plog))
+            pos_pairs = jnp.sum(band_f)
+            # scatter_mean contribution weights, matching the pair kernel's
+            # counting: a center with no active context gets no updates at all
+            # in the reference (no ns calls run), so it contributes 0; a
+            # context position contributes one unit per center predicting it
+            in_weight = (keep & (n_ctx > 0)).astype(jnp.float32)
+            out_weight = band_f.sum(axis=1)  # [B, L] centers per context pos
+        else:
+            # positive target = the center word on the output matrix, :304-311
+            plog = psum(
+                jnp.einsum(
+                    "bid,bid->bi",
+                    h.astype(cdt),
+                    eout.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+            )  # [B, L]
+            active = (keep & (n_ctx > 0)).astype(jnp.float32)
+            gp = (1.0 - jax.nn.sigmoid(plog)) * active * alpha
+            d_h = d_h + gp[:, :, None] * eout
+            d_out_pos = gp[:, :, None] * h  # [B, L, d] on the center position
+            # fan d_h back to contributing context rows (Word2Vec.cpp:313-315)
+            if cbow_mean:
+                d_h = d_h / jnp.maximum(n_ctx, 1.0)[:, :, None]
+            d_in_pos = jnp.einsum(
+                "bij,bid->bjd",
+                band_f.astype(cdt),
+                d_h.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )  # [B, L, d] — per context position
+            pos_loss = -jnp.sum(active * jax.nn.log_sigmoid(plog))
+            pos_pairs = jnp.sum(active)
+            # scatter_mean weights (pair-kernel counting): each context row of
+            # emb_in contributes one unit per center it serves; each center
+            # contributes one unit on emb_out
+            in_weight = band_f.sum(axis=1)  # [B, L] centers per context pos
+            out_weight = active
+
+        # ---- scatters: one shared sort of the row token ids
+        flat = tok.reshape(-1)
+        order = jnp.argsort(flat)
+        sorted_idx = flat[order]
+        d_in_flat = d_in_pos.reshape(-1, d_in_pos.shape[-1])[order]
+        d_out_flat = d_out_pos.reshape(-1, d_out_pos.shape[-1])[order]
+        flat_negs = negs.reshape(-1)
+        d_neg_flat = d_neg.reshape(-1, d_neg.shape[-1])
+        if scatter_mean:
+            # emb_in: per-contribution counts, as in the pair kernel
+            d_in_flat = d_in_flat * _dup_mean_scale(
+                emb_in.shape[0], sorted_idx,
+                in_weight.reshape(-1)[order],
+            )[:, None]
+            # emb_out: ONE joint count over positive positions and shared
+            # negative draws, so a word serving both roles is normalized by
+            # its total contribution count (a drawn negative counts its
+            # expected per-pair draws, w_neg summed over centers)
+            cnt = (
+                jnp.zeros((emb_out.shape[0],), jnp.float32)
+                .at[flat].add(out_weight.reshape(-1))
+                .at[flat_negs].add(w_neg.sum(axis=1).reshape(-1))
+            )
+            inv = 1.0 / jnp.maximum(cnt, 1.0)
+            d_out_flat = d_out_flat * inv[sorted_idx][:, None]
+            d_neg_flat = d_neg_flat * inv[flat_negs][:, None]
+        new_in = emb_in.at[sorted_idx].add(
+            d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
+        )
+        new_out = emb_out.at[sorted_idx].add(
+            d_out_flat.astype(emb_out.dtype), indices_are_sorted=True
+        )
+        # negative-row scatter (KP rows per batch row; duplicates sum)
+        new_out = new_out.at[flat_negs].add(d_neg_flat.astype(emb_out.dtype))
+
+        new_params = dict(params)
+        new_params["emb_in"] = new_in
+        new_params["emb_out_ns"] = new_out
+
+        # masked BCE for metrics, matching the pair kernel's convention:
+        # negatives contribute with their expectation weights
+        neg_loss = -jnp.sum(w_neg * (jax.nn.log_sigmoid(nlog) - nlog))
+        metrics = {
+            "loss_sum": pos_loss + neg_loss,
+            "pairs": pos_pairs + jnp.sum(w_neg),
+        }
+        return new_params, metrics
+
+    return step

@@ -124,6 +124,24 @@ def make_train_step(
     tp_axis: str | None = None,
     dp_axis: str | None = None,
 ) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
+    """Build the jittable step, dispatching on config.kernel.
+
+    "band" (the fast path, ns only) lives in ops/band_step.py; "pair" is the
+    reference-faithful enumeration below. "auto" picks band when it applies.
+    """
+    if config.resolved_kernel == "band":
+        from .band_step import make_band_train_step
+
+        return make_band_train_step(config, tables, tp_axis, dp_axis)
+    return make_pair_train_step(config, tables, tp_axis, dp_axis)
+
+
+def make_pair_train_step(
+    config: Word2VecConfig,
+    tables: DeviceTables,
+    tp_axis: str | None = None,
+    dp_axis: str | None = None,
+) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
     """Build the jittable step(params, tokens[B,L], key, alpha) -> (params, metrics).
 
     All config values are closed over as static; `tables` arrays become
@@ -245,10 +263,13 @@ def make_train_step(
             flat_c = tok.reshape(-1)
             vals = gh_pos.reshape(B * L, -1)
             if scatter_mean:
+                # a kept center with zero active contexts runs no kernels in
+                # the reference (the j-loop is empty), so it must not count
+                # toward the duplicate normalization either
                 vals = vals * _dup_mean_scale(
                     params["emb_in"].shape[0],
                     flat_c,
-                    keep.reshape(-1).astype(jnp.float32),
+                    pair_mask.any(axis=2).reshape(-1).astype(jnp.float32),
                 )[:, None]
             new_params["emb_in"] = params["emb_in"].at[flat_c].add(
                 vals.astype(params["emb_in"].dtype)

@@ -29,7 +29,7 @@ def main() -> None:
     ap.add_argument("--dim", type=int, default=300)
     ap.add_argument("--window", type=int, default=5)
     ap.add_argument("--negative", type=int, default=5)
-    ap.add_argument("--batch-rows", type=int, default=32)
+    ap.add_argument("--batch-rows", type=int, default=256)
     ap.add_argument("--max-len", type=int, default=192)
     ap.add_argument("--warmup-steps", type=int, default=3)
     ap.add_argument("--measure-steps", type=int, default=0,

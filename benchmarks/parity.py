@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Accuracy-parity harness: this framework vs the compiled C++ reference.
+
+BASELINE.md's accuracy gate is "WS-353 / Google-analogy scores within ±1% of
+the CPU reference". With no network there is no text8 and no WS-353 file, so
+parity is measured the way SURVEY §7(e) prescribes — statistically, on a
+corpus with PLANTED structure:
+
+1. generate a topic corpus (utils/synthetic.topic_corpus): same-topic words
+   co-occur in spans, cross-topic words only via shared function words;
+2. train the reference binary (built by reference_harness/measure_baseline.py
+   machinery against the eigen-lite shim) and this framework's CLI on the
+   SAME token stream with the SAME hyperparameters;
+3. score both with the SAME eval: Spearman of embedding cosines against the
+   planted same/cross-topic golds (WS-353 protocol), plus top-10 neighbor
+   topic purity;
+4. report both scores and their deltas as one JSON line.
+
+Parity holds when the deltas are within noise across seeds (the reference's
+random_device seeding, Word2Vec.cpp:16, makes bitwise comparison impossible
+— SURVEY §7(e)).
+
+Usage: python benchmarks/parity.py [--tokens 200000] [--dim 64] [--iters 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(HERE, "reference_harness"))
+
+
+def neighbor_purity(
+    words, W, topic_of, k: int = 10, sample: int = 100, seed: int = 0
+) -> float:
+    """Mean fraction of a content word's top-k cosine neighbors (among other
+    content words) sharing its topic."""
+    idx = {w: i for i, w in enumerate(words)}
+    content = [w for w in words if w in topic_of]
+    rng = np.random.default_rng(seed)
+    probe = rng.choice(content, size=min(sample, len(content)), replace=False)
+    C = W[[idx[w] for w in content]]
+    C = C / np.maximum(np.linalg.norm(C, axis=1, keepdims=True), 1e-12)
+    pos = {w: i for i, w in enumerate(content)}
+    purities = []
+    for w in probe:
+        sims = C @ C[pos[w]]
+        sims[pos[w]] = -np.inf
+        top = np.argpartition(-sims, k)[:k]
+        same = sum(topic_of[content[int(t)]] == topic_of[w] for t in top)
+        purities.append(same / k)
+    return float(np.mean(purities))
+
+
+def eval_vectors(path: str, pairs, topic_of) -> dict:
+    from word2vec_tpu.eval.similarity import cosine_rows, spearman
+    from word2vec_tpu.io.embeddings import load_embeddings_text
+
+    words, W = load_embeddings_text(path)
+    idx = {w: i for i, w in enumerate(words)}
+    ii, jj, gold = [], [], []
+    for a, b, s in pairs:
+        if a in idx and b in idx:
+            ii.append(idx[a])
+            jj.append(idx[b])
+            gold.append(s)
+    cos = cosine_rows(W, np.asarray(ii), np.asarray(jj))
+    return {
+        "spearman": round(spearman(cos, np.asarray(gold, np.float64)), 4),
+        "pairs_used": len(ii),
+        "pairs_total": len(pairs),
+        "neighbor_purity@10": round(neighbor_purity(words, W, topic_of), 4),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=200_000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--negative", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--min-count", type=int, default=5)
+    ap.add_argument("--subsample", type=float, default=1e-4)
+    ap.add_argument("--model", choices=["sg", "cbow"], default="sg")
+    ap.add_argument("--train-method", choices=["ns", "hs"], default="ns")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--skip-reference", action="store_true",
+                    help="evaluate only this framework (no g++/reference)")
+    args = ap.parse_args()
+
+    from measure_baseline import build  # reference_harness
+
+    from word2vec_tpu.utils.synthetic import topic_corpus, topic_similarity_pairs
+
+    tokens, topic_of = topic_corpus(n_tokens=args.tokens, seed=args.seed)
+    pairs = topic_similarity_pairs(topic_of, seed=args.seed + 1)
+
+    if args.train_method == "hs":
+        args.negative = 0
+    result = {
+        "config": f"{args.model}+{args.train_method} k={args.negative} "
+        f"dim={args.dim} w={args.window} iter={args.iters} "
+        f"subsample={args.subsample}",
+        "corpus": f"topic-synthetic-{args.tokens} tokens",
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(os.path.join(tmp, "text8"), "w") as f:
+            f.write(" ".join(tokens))
+
+        common = [
+            "-train", "text8", "-model", args.model,
+            "-train_method", args.train_method,
+            "-negative", str(args.negative), "-size", str(args.dim),
+            "-window", str(args.window), "-subsample", str(args.subsample),
+            "-iter", str(args.iters), "-min-count", str(args.min_count),
+        ]
+
+        if not args.skip_reference:
+            exe = build(tmp)
+            subprocess.run(
+                [exe, *common, "-output", "vec_ref.txt", "-threads", "1"],
+                cwd=tmp, check=True, capture_output=True,
+            )
+            result["reference"] = eval_vectors(
+                os.path.join(tmp, "vec_ref.txt"), pairs, topic_of
+            )
+
+        subprocess.run(
+            [
+                sys.executable, "-m", "word2vec_tpu.cli", *common,
+                "-output", "vec_ours.txt", "--backend", "cpu", "--quiet",
+            ],
+            cwd=tmp, check=True, capture_output=True,
+            env={**os.environ, "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+        )
+        result["ours"] = eval_vectors(
+            os.path.join(tmp, "vec_ours.txt"), pairs, topic_of
+        )
+
+    if "reference" in result:
+        result["delta_spearman"] = round(
+            result["ours"]["spearman"] - result["reference"]["spearman"], 4
+        )
+        result["delta_purity"] = round(
+            result["ours"]["neighbor_purity@10"]
+            - result["reference"]["neighbor_purity@10"], 4
+        )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -168,7 +168,8 @@ def test_band_step_matches_oracle(kw):
     "kw", CONFIGS, ids=lambda kw: f"{kw['model']}-mean{kw.get('cbow_mean')}"
 )
 def test_band_step_matches_oracle_scatter_mean(kw):
-    """scatter_mean=True (the default): per-pair contribution counts with a
+    """scatter_mean=True (the hot-row stabilizer option; default is sum):
+    per-pair contribution counts with a
     JOINT normalization over positive targets and negative draws on emb_out.
     Word 0 appears both as corpus token and as every negative draw, so its
     row exercises the joint count."""

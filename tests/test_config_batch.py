@@ -1,0 +1,59 @@
+"""Batch-geometry guards: auto_batch_rows and the Trainer warning."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.train import Trainer
+
+
+def test_auto_batch_rows_targets_100_steps():
+    # text8 scale: capped at 256
+    assert Word2VecConfig.auto_batch_rows(17_000_000, 192) == 256
+    # parity-corpus scale: ~100 steps/epoch
+    b = Word2VecConfig.auto_batch_rows(120_000, 192)
+    assert 120_000 // (b * 192) >= 100
+    # tiny corpus: floors at 1 (never 0), no floor-of-4 overshoot
+    assert Word2VecConfig.auto_batch_rows(20_000, 192) == 1
+    assert Word2VecConfig.auto_batch_rows(0, 192) == 1
+
+
+def test_auto_batch_rows_divides_by_dp():
+    single = Word2VecConfig.auto_batch_rows(2_000_000, 192, dp=1)
+    sharded = Word2VecConfig.auto_batch_rows(2_000_000, 192, dp=8)
+    assert sharded == max(1, single // 8)
+
+
+def _tiny_setup(batch_rows):
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=2, word_dim=8, window=1,
+        min_count=1, subsample_threshold=0, batch_rows=batch_rows,
+        max_sentence_len=16,
+    )
+    sents = [["a", "b", "c", "d"]] * 200
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    return cfg, vocab, corpus
+
+
+def test_trainer_warns_on_oversized_batch():
+    cfg, vocab, corpus = _tiny_setup(batch_rows=256)
+    with pytest.warns(UserWarning, match="steps/epoch"):
+        Trainer(cfg, vocab, corpus)
+
+
+def test_trainer_silent_on_safe_batch():
+    cfg, vocab, corpus = _tiny_setup(batch_rows=1)  # 800 tokens / 16 = 50...
+    # 200*4=800 tokens, 16 tokens/step -> 50 steps: still under 70, widen corpus
+    sents = [["a", "b", "c", "d"]] * 500
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        Trainer(cfg, vocab, corpus)

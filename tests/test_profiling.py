@@ -1,0 +1,44 @@
+"""Tracing/profiling subsystem (utils/profiling.py, SURVEY §5 row 1)."""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu.utils.profiling import StepTimer, annotate, trace
+
+
+def test_trace_writes_profile(tmp_path):
+    logdir = str(tmp_path / "prof")
+    with trace(logdir):
+        with annotate("test-region"):
+            x = jnp.ones((32, 32)) @ jnp.ones((32, 32))
+            jax.block_until_ready(x)
+    # the profiler lays out plugins/profile/<run>/ with at least one artifact
+    found = [
+        os.path.join(r, f) for r, _, fs in os.walk(logdir) for f in fs
+    ]
+    assert found, f"no profiler artifacts under {logdir}"
+
+
+def test_step_timer_skips_warmup_and_reports():
+    timer = StepTimer(warmup=1)
+    f = jax.jit(lambda x: x * 2.0)
+    x = jnp.ones((8,))
+    for _ in range(5):
+        x = f(x)
+        timer.lap(x)
+    stats = timer.stats()
+    # 5 laps recorded after the first lap() primes the clock: 4 intervals,
+    # minus 1 warmup = 3
+    assert stats["laps"] == 3
+    assert stats["mean_ms"] > 0
+    assert stats["p50_ms"] <= stats["max_ms"]
+    assert np.isfinite(stats["p90_ms"])
+
+
+def test_empty_timer_stats():
+    assert StepTimer().stats() == {"laps": 0}

@@ -66,7 +66,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dp", type=int, default=1, help="data-parallel mesh axis")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
     p.add_argument("--dp-sync-every", type=int, default=64)
-    p.add_argument("--batch-rows", type=int, default=32)
+    p.add_argument("--batch-rows", type=int, default=0,
+                   help="sentence rows per device step; 0 = auto-size so an "
+                        "epoch has enough optimizer steps to learn (see "
+                        "config.scatter_mean notes)")
+    p.add_argument("--scatter-mean", type=int, default=0, choices=[0, 1],
+                   help="normalize duplicate-row updates by count (hot-row "
+                        "stabilizer; 0 = reference-faithful sum)")
     p.add_argument("--kernel", choices=["auto", "band", "pair"], default="auto",
                    help="device kernel: band = MXU fast path (ns only), "
                         "pair = reference-faithful per-pair enumeration")
@@ -90,6 +96,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="google questions-words.txt for post-train eval")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=100)
+    p.add_argument("--profile", metavar="DIR",
+                   help="capture a jax.profiler trace of training into DIR "
+                        "(view with tensorboard/xprof)")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans (SURVEY §5: the batched-update "
+                        "analog of a race detector/sanitizer)")
     p.add_argument("--quiet", action="store_true")
     return p
 
@@ -138,13 +150,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             cbow_mean=bool(args.cbow_mean),
             train_method=args.train_method,
             model=args.model,
-            batch_rows=args.batch_rows,
+            batch_rows=args.batch_rows or 32,  # placeholder; auto-sized below
             max_sentence_len=args.max_sentence_len,
             seed=args.seed,
             dp_sync_every=args.dp_sync_every,
             kernel=args.kernel,
             compute_dtype=args.compute_dtype,
             shared_negatives=args.shared_negatives,
+            scatter_mean=bool(args.scatter_mean),
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -198,6 +211,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.save_vocab:
         vocab.save(args.save_vocab)  # Word2Vec.cpp:171-177
 
+    if args.batch_rows == 0 and not args.resume:
+        import dataclasses as _dc
+
+        auto = Word2VecConfig.auto_batch_rows(
+            corpus.num_tokens, cfg.max_sentence_len, dp=args.dp
+        )
+        cfg = _dc.replace(cfg, batch_rows=auto)
+        if not args.quiet:
+            steps = max(
+                1, corpus.num_tokens // (auto * cfg.max_sentence_len * args.dp)
+            )
+            print(f"batch-rows auto: {auto} (~{steps} steps/epoch)")
+
     log_fn = None if args.quiet else progress_logger()
     if args.dp * args.tp > 1:
         from .parallel import ShardedTrainer
@@ -225,12 +251,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         def ckpt_cb(s):
             save_checkpoint(args.checkpoint_dir, unreplicated(s), cfg, vocab)
 
-    state, report = trainer.train(
-        state=state,
-        log_every=args.log_every,
-        checkpoint_cb=ckpt_cb,
-        checkpoint_every=args.checkpoint_every,
-    )
+    if args.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+
+    import contextlib
+
+    from .utils.profiling import trace
+
+    profile_ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
+    with profile_ctx:
+        state, report = trainer.train(
+            state=state,
+            log_every=args.log_every,
+            checkpoint_cb=ckpt_cb,
+            checkpoint_every=args.checkpoint_every,
+        )
     if not args.quiet:
         print(f"\ntrained {report.total_words} words in {report.wall_time:.1f}s "
               f"({report.words_per_sec:,.0f} words/sec), final loss "

@@ -76,12 +76,17 @@ class Word2VecConfig:
     # frequent rows self-correct (Word2Vec.cpp:239-246,262-268). A batched
     # scatter instead SUMS all N duplicate-row gradients computed at the
     # pre-update weights; for rows duplicated thousands of times per batch
-    # (frequent words as negatives) that overshoots ~N-fold and diverges.
-    # scatter_mean=True normalizes each row's summed update by its duplicate
-    # count: rows touched once per batch (the overwhelming majority at real
-    # vocab sizes) are bit-identical to sum semantics; hot rows get the
-    # sequential-like contraction. Set False for reference-exact sum semantics.
-    scatter_mean: bool = True
+    # (tiny vocabularies, or frequent words as negatives) that overshoots
+    # ~N-fold. scatter_mean=True normalizes each row's summed update by its
+    # duplicate count — but that also divides the effective learning rate of
+    # every duplicated row, and measured on the planted-structure parity
+    # corpus (benchmarks/parity.py) it prevents learning outright, while sum
+    # semantics exactly matches the reference's eval scores. Default is
+    # therefore False (reference-faithful sum); the real stability lever is
+    # batch size — keep tokens-per-batch well under corpus_tokens/70 (the CLI
+    # auto-sizes batch_rows this way). Set True only for degenerate
+    # hot-row workloads.
+    scatter_mean: bool = False
 
     # --- multi-chip (no reference counterpart; replaces OpenMP Hogwild) ---
     # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
@@ -115,6 +120,24 @@ class Word2VecConfig:
         if self.kernel != "auto":
             return self.kernel
         return "band" if (self.use_ns and not self.use_hs) else "pair"
+
+    @staticmethod
+    def auto_batch_rows(
+        corpus_tokens: int,
+        max_sentence_len: int = 192,
+        dp: int = 1,
+        cap: int = 256,
+    ) -> int:
+        """Batch rows giving ~100 optimizer steps per epoch.
+
+        Batched-sum updates (scatter_mean notes above) need enough steps per
+        epoch to converge — measured threshold ~70 on the parity corpus
+        (benchmarks/parity.py). `dp` is the data-parallel width: replicas
+        consume dp batches per global step, so the per-replica batch shrinks
+        accordingly. Capped at `cap` rows for device efficiency on corpora
+        big enough not to care.
+        """
+        return max(1, min(cap, corpus_tokens // (100 * max_sentence_len * dp)))
 
     @property
     def use_hs(self) -> bool:

@@ -67,7 +67,30 @@ class Trainer:
         self.tables = DeviceTables.build(vocab, config)
         self.log_fn = log_fn
         self.total_words = corpus.num_tokens
+        self._warn_batch_geometry()
         self._build_step()
+
+    def _warn_batch_geometry(self) -> None:
+        """Batched-sum updates need enough steps/epoch to converge (measured
+        threshold ~70, benchmarks/parity.py; see config.scatter_mean notes).
+        The CLI auto-sizes batch_rows; library users constructing Trainer
+        directly get this guard instead."""
+        import warnings
+
+        cfg = self.config
+        tokens_per_step = cfg.batch_rows * cfg.max_sentence_len
+        steps_per_epoch = max(1, self.total_words // max(1, tokens_per_step))
+        if self.total_words and steps_per_epoch < 70:
+            suggested = cfg.auto_batch_rows(self.total_words, cfg.max_sentence_len)
+            warnings.warn(
+                f"batch geometry ({cfg.batch_rows} rows x "
+                f"{cfg.max_sentence_len}) gives only ~{steps_per_epoch} "
+                f"optimizer steps/epoch on this {self.total_words}-token "
+                f"corpus — batched updates may not converge (threshold ~70; "
+                f"benchmarks/parity.py). Suggested batch_rows: "
+                f"Word2VecConfig.auto_batch_rows(...) = {suggested}.",
+                stacklevel=3,
+            )
 
     # ---------------------------------------------------------------- hooks
     def _build_step(self) -> None:

@@ -1,0 +1,85 @@
+"""Tracing / profiling subsystem.
+
+The reference has none (SURVEY §5: the only perf artifact is the `-Ofast
+-march=native` build comment, main.cpp:2). The TPU-native replacements:
+
+  * `trace(logdir)` — context manager around `jax.profiler.trace`; captures a
+    device trace (XLA ops, fusion boundaries, HBM traffic) viewable in
+    TensorBoard / xprof. Wrap any training region with it; the CLI exposes it
+    as `--profile DIR`.
+  * `annotate(name)` — host-side named region that shows up on the trace
+    timeline (wraps `jax.profiler.TraceAnnotation`), for marking batcher /
+    transfer / step phases.
+  * `StepTimer` — a `jax.block_until_ready` wall-clock harness for steady-
+    state step timing with percentile stats, used by benchmarks/ablate.py
+    and bench.py-style meters. Timing without blocking measures dispatch,
+    not compute — this forces the sync.
+
+Words/sec metering itself lives in the Trainer's log records
+(utils/logging.py); this module is for *why is the step slow*, not *how fast
+is it going*.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a jax.profiler device+host trace into `logdir`.
+
+    View with: tensorboard --logdir <logdir>  (or xprof). Safe on any
+    backend; on TPU the trace includes per-op device timing.
+    """
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def annotate(name: str):
+    """Named region on the profiler timeline (host side)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Steady-state step timing: call `lap(result)` once per step.
+
+    `lap` blocks on the step's output before reading the clock, so each
+    recorded lap is true wall time of (host overhead + device compute),
+    not dispatch latency. Skips the first `warmup` laps (compile).
+    """
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self.laps: List[float] = []
+        self._seen = 0
+        self._t: Optional[float] = None
+
+    def lap(self, result) -> None:
+        jax.block_until_ready(result)
+        now = time.perf_counter()
+        if self._t is not None:
+            self._seen += 1
+            if self._seen > self.warmup:
+                self.laps.append(now - self._t)
+        self._t = now
+
+    def stats(self) -> dict:
+        if not self.laps:
+            return {"laps": 0}
+        laps = sorted(self.laps)
+        n = len(laps)
+        # nearest-rank percentile: ceil(q*n) - 1
+        p90 = max(0, -(-9 * n // 10) - 1)
+        return {
+            "laps": n,
+            "mean_ms": 1e3 * sum(laps) / n,
+            "p50_ms": 1e3 * laps[n // 2],
+            "p90_ms": 1e3 * laps[p90],
+            "min_ms": 1e3 * laps[0],
+            "max_ms": 1e3 * laps[-1],
+        }

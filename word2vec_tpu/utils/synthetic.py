@@ -1,12 +1,23 @@
-"""Synthetic Zipfian corpora for benchmarks and dry runs.
+"""Synthetic corpora for benchmarks, dry runs, and cross-implementation parity.
 
-No-network environments have no text8; a Zipf(1.0) token stream over a
-text8-sized vocabulary reproduces the performance-relevant corpus properties
-(vocab size, frequency skew, subsampling hit rate, negative-table shape) so
-throughput numbers transfer. Not meant for accuracy evaluation.
+No-network environments have no text8; two generators stand in:
+
+  * `zipf_vocab`/`zipf_corpus_ids` — a Zipf(1.0) token stream over a
+    text8-sized vocabulary. Reproduces the performance-relevant corpus
+    properties (vocab size, frequency skew, subsampling hit rate,
+    negative-table shape) so throughput numbers transfer. No semantic
+    structure — not for accuracy evaluation.
+  * `topic_corpus`/`topic_similarity_pairs` — sentences with PLANTED topic
+    structure: words of the same topic co-occur, so a correct word2vec
+    recovers same-topic similarity. This is the accuracy-parity stand-in for
+    WS-353 (BASELINE.md gate) when the real datasets are unreachable: train
+    the C++ reference and this framework on the same generated stream and
+    compare their eval scores (benchmarks/parity.py).
 """
 
 from __future__ import annotations
+
+from typing import Dict, List, Tuple
 
 import numpy as np
 
@@ -36,3 +47,81 @@ def zipf_corpus_ids(
     return [
         flat[i : i + sentence_len] for i in range(0, num_tokens, sentence_len)
     ]
+
+
+def topic_corpus(
+    n_topics: int = 8,
+    words_per_topic: int = 40,
+    shared_words: int = 20,
+    n_tokens: int = 200_000,
+    span_len: int = 20,
+    p_shared: float = 0.25,
+    seed: int = 0,
+) -> Tuple[List[str], Dict[str, int]]:
+    """A flat token stream with planted topic structure.
+
+    The stream is a sequence of `span_len`-token spans; each span draws one
+    topic and emits that topic's content words (Zipf-weighted within the
+    topic) mixed with topic-agnostic shared words. Same-topic words therefore
+    co-occur within any window <= span_len while cross-topic words co-occur
+    only through shared words — exactly the contrast word2vec's objective
+    should recover.
+
+    Returns (tokens, topic_of): the flat token list (write it whitespace-
+    separated for the reference's text8 reader, main.cpp:63-92) and the
+    content-word -> topic map for building eval pairs.
+    """
+    rng = np.random.default_rng(seed)
+    topic_words = [
+        [f"t{t}w{i}" for i in range(words_per_topic)] for t in range(n_topics)
+    ]
+    shared = [f"s{i}" for i in range(shared_words)]
+    zipf = 1.0 / np.arange(1, words_per_topic + 1)
+    zipf /= zipf.sum()
+    zipf_s = 1.0 / np.arange(1, shared_words + 1)
+    zipf_s /= zipf_s.sum()
+
+    tokens: List[str] = []
+    n_spans = n_tokens // span_len
+    topics = rng.integers(0, n_topics, size=n_spans)
+    for t in topics:
+        is_shared = rng.random(span_len) < p_shared
+        content_ids = rng.choice(words_per_topic, size=span_len, p=zipf)
+        shared_ids = rng.choice(shared_words, size=span_len, p=zipf_s)
+        pool = topic_words[t]
+        for k in range(span_len):
+            tokens.append(
+                shared[shared_ids[k]] if is_shared[k] else pool[content_ids[k]]
+            )
+    topic_of = {w: t for t, pool in enumerate(topic_words) for w in pool}
+    return tokens, topic_of
+
+
+def topic_similarity_pairs(
+    topic_of: Dict[str, int],
+    n_pairs: int = 400,
+    seed: int = 0,
+    same_score: float = 8.0,
+    diff_score: float = 2.0,
+) -> List[Tuple[str, str, float]]:
+    """WS-353-shaped (word1, word2, gold) pairs from the planted topics:
+    half same-topic (high gold), half cross-topic (low gold). Spearman of
+    model cosines against these golds measures structure recovery; comparing
+    two implementations' Spearman on the SAME pairs is the parity gate."""
+    rng = np.random.default_rng(seed)
+    by_topic: Dict[int, List[str]] = {}
+    for w, t in topic_of.items():
+        by_topic.setdefault(t, []).append(w)
+    topics = sorted(by_topic)
+    pairs: List[Tuple[str, str, float]] = []
+    for i in range(n_pairs):
+        if i % 2 == 0:
+            t = topics[rng.integers(len(topics))]
+            a, b = rng.choice(by_topic[t], size=2, replace=False)
+            pairs.append((str(a), str(b), same_score))
+        else:
+            t1, t2 = rng.choice(topics, size=2, replace=False)
+            a = rng.choice(by_topic[t1])
+            b = rng.choice(by_topic[t2])
+            pairs.append((str(a), str(b), diff_score))
+    return pairs

@@ -150,3 +150,102 @@ def test_word_dim_divisibility_enforced():
     corpus = PackedCorpus.pack([np.arange(10, dtype=np.int32)], 16)
     with pytest.raises(ValueError, match="divisible"):
         ShardedTrainer(cfg, vocab, corpus, dp=1, tp=4)
+
+
+# ---------------------------------------------------------------- sequence
+
+
+def _degenerate_tables():
+    """keep-prob 1 everywhere + every negative draw lands on word 0, so
+    per-shard RNG forks cannot cause divergence (same trick as
+    test_band_step_golden)."""
+    from word2vec_tpu.data.negative import build_alias_table
+
+    keep = jnp.ones(V, jnp.float32)
+    p = np.zeros(V)
+    p[0] = 1.0
+    at = build_alias_table(p)
+    return DeviceTables(
+        keep, jnp.asarray(at.accept), jnp.asarray(at.alias), None, None, None
+    )
+
+
+def test_sequence_parallel_conserves_the_single_chip_update():
+    """sp=2: halo exchange must preserve every window pair across the shard
+    boundary, and each directed pair must be trained exactly once — so the
+    SUM of the two shards' update deltas equals the single-chip update
+    exactly. window=1 pins w_eff; subsample off + degenerate negatives pin
+    the remaining RNG, making the comparison exact, not statistical."""
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=2, word_dim=D, window=1,
+        min_count=1, subsample_threshold=0.0, compute_dtype="float32",
+        shared_negatives=4, max_sentence_len=24,
+    )
+    tables = _degenerate_tables()
+    rng = np.random.default_rng(8)
+    # word 0 excluded: keeps both kernels' negative-collision masks inert
+    tokens = rng.integers(1, V, size=(4, 24)).astype(np.int32)
+    params = init_params(cfg, V, jax.random.key(7))
+    key = jax.random.key(42)
+    alpha = jnp.float32(ALPHA)
+
+    single = jax.jit(make_train_step(cfg, tables))
+    ref_new, ref_metrics = single(params, jnp.asarray(tokens), key, alpha)
+
+    mesh = make_mesh(dp=1, tp=1, sp=2)
+    sharded = make_sharded_step(cfg, tables, mesh)
+    repl = replicate_params(params, mesh)
+    out, metrics = sharded(repl, jnp.asarray(tokens), key, alpha)
+
+    for k in params:
+        ref_delta = np.asarray(ref_new[k]) - np.asarray(params[k])
+        sp_delta = (np.asarray(out[k][0]) - np.asarray(params[k])) + (
+            np.asarray(out[k][1]) - np.asarray(params[k])
+        )
+        np.testing.assert_allclose(sp_delta, ref_delta, atol=1e-4, err_msg=k)
+    assert float(metrics["pairs"]) == pytest.approx(float(ref_metrics["pairs"]))
+    np.testing.assert_allclose(
+        float(metrics["loss_sum"]), float(ref_metrics["loss_sum"]), rtol=1e-4
+    )
+
+
+def test_seq_parallel_trainer_end_to_end_all_axes():
+    """dp=2 x sp=2 x tp=2 — all 8 virtual devices, full trainer loop."""
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        min_count=1, subsample_threshold=0, iters=2, batch_rows=4,
+        max_sentence_len=12, init_alpha=0.05, dp_sync_every=4,
+    )
+    rng = np.random.default_rng(3)
+    sents = [[f"w{j}" for j in rng.integers(0, 20, size=10)] for _ in range(200)]
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    tr = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2, sp=2)
+    state, report = tr.train(log_every=0)
+    assert report.total_words == corpus.num_tokens * cfg.iters
+    exported = tr.export_params(state)
+    for k, v in exported.items():
+        assert np.all(np.isfinite(v)), k
+
+
+def test_sp_requires_band_kernel_and_divisibility():
+    vocab = Vocab.from_counter({f"w{i}": 5 for i in range(10)}, min_count=1)
+    corpus = PackedCorpus.pack([np.arange(10, dtype=np.int32)], 16)
+    cfg_hs = Word2VecConfig(train_method="hs", negative=0, word_dim=8,
+                            min_count=1, max_sentence_len=16)
+    with pytest.raises(ValueError, match="band kernel"):
+        ShardedTrainer(cfg_hs, vocab, corpus, sp=2)
+    cfg_odd = Word2VecConfig(negative=2, word_dim=8, min_count=1,
+                             max_sentence_len=15)
+    with pytest.raises(ValueError, match="divisible"):
+        ShardedTrainer(cfg_odd, vocab, corpus, sp=2)
+    # per-shard slice shorter than the window: single-hop halo can't cover it
+    cfg_short = Word2VecConfig(negative=2, word_dim=8, min_count=1,
+                               max_sentence_len=8, window=3)
+    with pytest.raises(ValueError, match="shorter than window"):
+        ShardedTrainer(cfg_short, vocab, corpus, sp=4)
+    # scatter_mean counts are shard-local; rejected under sp
+    cfg_sm = Word2VecConfig(negative=2, word_dim=8, min_count=1,
+                            max_sentence_len=16, scatter_mean=True)
+    with pytest.raises(ValueError, match="scatter_mean"):
+        ShardedTrainer(cfg_sm, vocab, corpus, sp=2)

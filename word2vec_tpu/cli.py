@@ -17,9 +17,9 @@ Reference divergences (deliberate, each a reference bug or gap):
     cbow-mean default applies only when model=cbow and -alpha was not given
     (word2vec.c behavior).
   - `-threads` is accepted for compatibility and ignored: parallelism is
-    --dp/--tp over the device mesh, not host threads.
+    --dp/--sp/--tp over the device mesh, not host threads.
 
-TPU extensions: --backend {tpu,cpu}, --dp/--tp mesh shape, --corpus-format,
+TPU extensions: --backend {tpu,cpu}, --dp/--sp/--tp mesh shape, --corpus-format,
 --checkpoint-dir/--checkpoint-every, --eval-ws353/--eval-analogy.
 """
 
@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-negative", dest="negative", type=int, default=0,
                    help="negative samples (reference default 0, main.cpp:118)")
     p.add_argument("-threads", dest="threads", type=int, default=1,
-                   help="accepted for compatibility; ignored (use --dp/--tp)")
+                   help="accepted for compatibility; ignored (use --dp/--sp/--tp)")
     p.add_argument("-iter", dest="iter", type=int, default=1)
     p.add_argument("-min-count", dest="min_count", type=int, default=5)
     p.add_argument("-alpha", dest="alpha", type=float, default=None)
@@ -65,6 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device backend (BASELINE.json north star)")
     p.add_argument("--dp", type=int, default=1, help="data-parallel mesh axis")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel mesh axis (halo-exchange context "
+                        "parallelism for long rows; band kernel only)")
     p.add_argument("--dp-sync-every", type=int, default=64)
     p.add_argument("--batch-rows", type=int, default=0,
                    help="sentence rows per device step; 0 = auto-size so an "
@@ -225,11 +228,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"batch-rows auto: {auto} (~{steps} steps/epoch)")
 
     log_fn = None if args.quiet else progress_logger()
-    if args.dp * args.tp > 1:
+    if args.dp * args.tp * args.sp > 1:
         from .parallel import ShardedTrainer
 
         trainer = ShardedTrainer(
-            cfg, vocab, corpus, dp=args.dp, tp=args.tp, log_fn=log_fn
+            cfg, vocab, corpus, dp=args.dp, tp=args.tp, sp=args.sp,
+            log_fn=log_fn,
         )
     else:
         trainer = Trainer(cfg, vocab, corpus, log_fn=log_fn)

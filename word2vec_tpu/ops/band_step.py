@@ -48,6 +48,17 @@ Mesh axes mirror the pair kernel: with tp_axis the embedding dim is sharded
 and every logit matmul is psum'd over the axis before the sigmoid; all
 gradients are then local to the dim shard. With dp_axis the PRNG key is
 folded with the shard index.
+
+sp_axis adds sequence (context) parallelism for long rows: tokens [B, L] are
+sharded along L, and each shard halo-exchanges `window` edge tokens with its
+neighbors over ICI (jax.lax.ppermute) so window pairs crossing the shard
+boundary are preserved. Each shard then trains only the centers it OWNS
+(halo positions stay context-only), which keeps every directed (center,
+context) pair trained exactly once across the mesh: the i->j direction on
+i's owner, j->i on j's owner. Updates land in the shard-local replica and
+are reconciled by the same periodic averaging as the data axis
+(parallel/trainer.py) — sequence parallelism here is data parallelism over
+position slices plus the halo exchange that plain slicing would miss.
 """
 
 from __future__ import annotations
@@ -65,15 +76,45 @@ from .train_step import _draw_negatives, _dup_mean_scale
 Metrics = Dict[str, jnp.ndarray]
 
 
+def _halo_exchange(tok: jnp.ndarray, w: int, axis: str) -> jnp.ndarray:
+    """[B, Lloc] -> [B, w + Lloc + w]: fetch w edge tokens from each sequence
+    neighbor over ICI. Outermost shards have no neighbor on one side; their
+    halo is -1 (invalid), matching row-end padding semantics."""
+    if tok.shape[1] < w:
+        # the slice can't supply a full one-hop halo; multi-hop exchange is
+        # deliberately unsupported (ShardedTrainer validates this upfront)
+        raise ValueError(
+            f"per-shard slice length {tok.shape[1]} < window {w}"
+        )
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    # left halo = right edge of the left neighbor (shift right: i -> i+1)
+    left = jax.lax.ppermute(
+        tok[:, -w:], axis, [(i, i + 1) for i in range(n - 1)]
+    )
+    # right halo = left edge of the right neighbor (shift left: i+1 -> i)
+    right = jax.lax.ppermute(
+        tok[:, :w], axis, [(i + 1, i) for i in range(n - 1)]
+    )
+    # ppermute delivers zeros to shards with no source; zero is a real token
+    # id, so explicitly invalidate the missing halos
+    left = jnp.where(idx == 0, -1, left)
+    right = jnp.where(idx == n - 1, -1, right)
+    return jnp.concatenate([left, tok, right], axis=1)
+
+
 def make_band_train_step(
     config: Word2VecConfig,
     tables: DeviceTables,
     tp_axis: str | None = None,
     dp_axis: str | None = None,
+    sp_axis: str | None = None,
 ) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
     """step(params, tokens[B,L], key, alpha) -> (params, metrics).
 
     Same contract as train_step.make_train_step; negative sampling only.
+    With sp_axis, tokens is this shard's [B, Lloc] position slice of a longer
+    row (see module docstring).
     """
     if not config.use_ns or config.use_hs:
         raise ValueError("band kernel supports negative sampling only (use pair for hs)")
@@ -91,9 +132,18 @@ def make_band_train_step(
     def step(
         params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
     ) -> Tuple[Params, Metrics]:
-        B, L = tokens.shape
         if dp_axis is not None:
             key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+        center_zone = None
+        if sp_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(sp_axis))
+            Lloc = tokens.shape[1]
+            tokens = _halo_exchange(tokens, W, sp_axis)
+            pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            # halo positions are context-only: their center direction is
+            # owned (and trained) by the neighboring shard
+            center_zone = (pos >= W) & (pos < W + Lloc)
+        B, L = tokens.shape
         k_sub, k_win, k_neg = jax.random.split(key, 3)
 
         valid = tokens >= 0
@@ -102,6 +152,8 @@ def make_band_train_step(
         # Center-word subsample gate (Word2Vec.cpp:282,332) and per-center
         # window shrink w_eff in {1..W} (Word2Vec.cpp:285-287,335-337).
         keep = valid & (jax.random.uniform(k_sub, (B, L)) < tables.keep_probs[tok])
+        if center_zone is not None:
+            keep = keep & center_zone[None, :]
         w_eff = W - jax.random.randint(k_win, (B, L), 0, W, dtype=jnp.int32)
 
         # Band mask over the [L, L] pair plane: rows = centers, cols = contexts.

@@ -123,16 +123,23 @@ def make_train_step(
     tables: DeviceTables,
     tp_axis: str | None = None,
     dp_axis: str | None = None,
+    sp_axis: str | None = None,
 ) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
     """Build the jittable step, dispatching on config.kernel.
 
     "band" (the fast path, ns only) lives in ops/band_step.py; "pair" is the
     reference-faithful enumeration below. "auto" picks band when it applies.
+    sp_axis (sequence/context parallelism via halo exchange) is implemented
+    by the band kernel only.
     """
     if config.resolved_kernel == "band":
         from .band_step import make_band_train_step
 
-        return make_band_train_step(config, tables, tp_axis, dp_axis)
+        return make_band_train_step(config, tables, tp_axis, dp_axis, sp_axis)
+    if sp_axis is not None:
+        raise ValueError(
+            "sequence parallelism requires the band kernel (ns objective)"
+        )
     return make_pair_train_step(config, tables, tp_axis, dp_axis)
 
 

@@ -29,22 +29,33 @@ import numpy as np
 from jax.sharding import Mesh
 
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
 
 def make_mesh(
-    dp: int, tp: int, devices: Optional[Sequence[jax.Device]] = None
+    dp: int,
+    tp: int,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """A (dp, tp) mesh over the first dp*tp available devices.
+    """A (dp, sp, tp) mesh over the first dp*sp*tp available devices.
+
+    sp is the sequence/context-parallel axis: tokens are sharded along the
+    row-position dimension and the band kernel halo-exchanges `window` edge
+    tokens with ppermute neighbors (ops/band_step._halo_exchange) — the
+    word2vec-scale analog of ring attention's neighbor exchange.
 
     On real hardware, `jax.devices()` order follows the torus topology, so
     adjacent mesh coordinates map to ICI neighbors; the `model` axis is the
     fastest-varying (innermost) so the per-step logit psum rides the
-    tightest ICI ring.
+    tightest ICI ring, with the sp halo ppermute on the next ring out.
     """
     devices = list(devices if devices is not None else jax.devices())
-    need = dp * tp
+    need = dp * sp * tp
     if need > len(devices):
-        raise ValueError(f"mesh ({dp}x{tp}) needs {need} devices, have {len(devices)}")
-    grid = np.array(devices[:need]).reshape(dp, tp)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+        raise ValueError(
+            f"mesh ({dp}x{sp}x{tp}) needs {need} devices, have {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(dp, sp, tp)
+    return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))

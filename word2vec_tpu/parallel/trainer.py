@@ -1,20 +1,27 @@
 """Multi-chip training: shard_map step + periodic replica averaging.
 
-Layout (see parallel/mesh.py for the axes):
-  params   — every table carries a leading replica axis: [DP, V, d], sharded
-             PartitionSpec("data", None, "model"). Each data shard trains its
-             own replica slice [1, V, d/TP]; each model shard holds a dim
-             slice. HBM per chip: V * d / TP floats per table.
-  tokens   — global [DP*B, L], PartitionSpec("data", None): each data shard
-             consumes its own corpus slice.
-  step     — ops/train_step with tp_axis/dp_axis bound; inside one step the
-             only cross-chip traffic is the [P, T] logit psum on the model
-             axis (tensor parallelism).
+Layout (see parallel/mesh.py for the axes; the mesh is (data, seq, model)):
+  params   — every table carries a leading replica axis: [DP*SP, V, d],
+             sharded PartitionSpec(("data", "seq"), None, "model"). Each
+             (data, seq) shard trains its own replica slice [1, V, d/TP];
+             each model shard holds a dim slice. HBM per chip: V * d / TP
+             floats per table.
+  tokens   — global [DP*B, L], PartitionSpec("data", "seq"): rows over data
+             shards, row positions over seq shards.
+  step     — ops/train_step with tp/dp/sp axes bound; inside one step the
+             cross-chip traffic is the [P, T] logit psum on the model axis
+             (tensor parallelism) and the window-token halo ppermute on the
+             seq axis.
   sync     — every dp_sync_every steps, replicas are pmean-averaged over the
-             data axis (ICI all-reduce). This replaces the reference's shared-
-             memory Hogwild (Word2Vec.cpp:375-394) and is the BASELINE.json
-             north-star design ("periodically psum the embedding matrices
-             over ICI").
+             data and seq axes (ICI all-reduce). This replaces the reference's
+             shared-memory Hogwild (Word2Vec.cpp:375-394) and is the
+             BASELINE.json north-star design ("periodically psum the embedding
+             matrices over ICI").
+  seq      — sequence/context parallelism for long rows: tokens' position
+             axis is sharded, the band kernel halo-exchanges `window` edge
+             tokens with ppermute neighbors, and each shard trains only the
+             centers it owns (ops/band_step module docstring). Replica-wise
+             it behaves like the data axis and shares its sync.
 
 ShardedTrainer subclasses train.Trainer: the epoch loop, alpha schedule,
 metering and checkpoint hooks are inherited; only param layout, batch
@@ -37,41 +44,48 @@ from ..models.params import Params, init_params
 from ..ops.tables import DeviceTables
 from ..ops.train_step import make_train_step
 from ..train import Trainer, TrainState
-from .mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh
 
-PARAM_SPEC = P(DATA_AXIS, None, MODEL_AXIS)
-TOKEN_SPEC = P(DATA_AXIS, None)
+# params replicate over data AND seq shards (both train independent replicas
+# between syncs); the leading replica axis is sharded over the two jointly
+PARAM_SPEC = P((DATA_AXIS, SEQ_AXIS), None, MODEL_AXIS)
+# tokens: rows over data shards, row positions over seq shards (band kernel
+# halo-exchanges the window-crossing edges, ops/band_step._halo_exchange)
+TOKEN_SPEC = P(DATA_AXIS, SEQ_AXIS)
+REPLICA_AXES = (DATA_AXIS, SEQ_AXIS)
 
 
 def replicate_params(params: Params, mesh: Mesh) -> Params:
-    """[V, d] -> [DP, V, d] identical replicas, sharded over the mesh.
+    """[V, d] -> [DP*SP, V, d] identical replicas, sharded over the mesh.
 
     The replicated view is built host-side with np.broadcast_to (zero-copy);
     device_put then places only each shard's slice, so no single device ever
-    materializes the full [DP, V, d] array.
+    materializes the full replicated array.
     """
-    dp = mesh.shape[DATA_AXIS]
+    reps = mesh.shape[DATA_AXIS] * mesh.shape[SEQ_AXIS]
     sharding = NamedSharding(mesh, PARAM_SPEC)
     return {
-        k: jax.device_put(np.broadcast_to(np.asarray(v), (dp, *v.shape)), sharding)
+        k: jax.device_put(np.broadcast_to(np.asarray(v), (reps, *v.shape)), sharding)
         for k, v in params.items()
     }
 
 
 def unreplicate_params(params: Params) -> Params:
-    """[DP, V, d] -> [V, d]; call after a sync so replicas are equal."""
+    """[DP*SP, V, d] -> [V, d]; call after a sync so replicas are equal."""
     return {k: v[0] for k, v in params.items()}
 
 
 def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
     """Jitted global-array step over the mesh (donates params)."""
     dp = mesh.shape[DATA_AXIS]
+    sp = mesh.shape[SEQ_AXIS]
     tp = mesh.shape[MODEL_AXIS]
     inner = make_train_step(
         config,
         tables,
         tp_axis=MODEL_AXIS if tp > 1 else None,
         dp_axis=DATA_AXIS if dp > 1 else None,
+        sp_axis=SEQ_AXIS if sp > 1 else None,
     )
 
     def local_step(params, tokens, key, alpha):
@@ -83,7 +97,7 @@ def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
         # (and proves replication to the vma checker), psum over data sums the
         # genuinely distinct per-shard contributions.
         metrics = {
-            k: jax.lax.psum(jax.lax.psum(v, MODEL_AXIS) / tp, DATA_AXIS)
+            k: jax.lax.psum(jax.lax.psum(v, MODEL_AXIS) / tp, REPLICA_AXES)
             for k, v in metrics.items()
         }
         return {k: v[None] for k, v in new_p.items()}, metrics
@@ -101,13 +115,14 @@ def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
 
 
 def make_sync(mesh: Mesh):
-    """Jitted pmean of all replicas over the data axis (ICI all-reduce)."""
+    """Jitted pmean of all replicas over the data and seq axes (ICI
+    all-reduce)."""
 
     def syncfn(params):
         specs = {k: PARAM_SPEC for k in params}
 
         def local(p):
-            return {k: jax.lax.pmean(v, DATA_AXIS) for k, v in p.items()}
+            return {k: jax.lax.pmean(v, REPLICA_AXES) for k, v in p.items()}
 
         return jax.shard_map(
             local, mesh=mesh, in_specs=(specs,), out_specs=specs
@@ -117,7 +132,7 @@ def make_sync(mesh: Mesh):
 
 
 class ShardedTrainer(Trainer):
-    """Data+tensor-parallel trainer. dp*tp must not exceed len(jax.devices())."""
+    """Data+sequence+tensor-parallel trainer; dp*sp*tp <= len(jax.devices())."""
 
     def __init__(
         self,
@@ -126,16 +141,43 @@ class ShardedTrainer(Trainer):
         corpus: PackedCorpus,
         dp: int = 1,
         tp: int = 1,
+        sp: int = 1,
         mesh: Optional[Mesh] = None,
         log_fn=None,
     ):
-        self.mesh = mesh if mesh is not None else make_mesh(dp, tp)
+        self.mesh = mesh if mesh is not None else make_mesh(dp, tp, sp)
         self.dp = self.mesh.shape[DATA_AXIS]
+        self.sp = self.mesh.shape[SEQ_AXIS]
         self.tp = self.mesh.shape[MODEL_AXIS]
         # validate against the *resolved* mesh, not the constructor args
         if config.word_dim % self.tp != 0:
             raise ValueError(
                 f"word_dim {config.word_dim} not divisible by tp={self.tp}"
+            )
+        if config.max_sentence_len % self.sp != 0:
+            raise ValueError(
+                f"max_sentence_len {config.max_sentence_len} not divisible "
+                f"by sp={self.sp}"
+            )
+        if self.sp > 1 and config.max_sentence_len // self.sp < config.window:
+            # a shard slice shorter than the window would need halo tokens
+            # from beyond its immediate neighbors (multi-hop exchange);
+            # _halo_exchange is single-hop by design
+            raise ValueError(
+                f"per-shard slice {config.max_sentence_len // self.sp} is "
+                f"shorter than window {config.window}; lower sp or raise "
+                f"max_sentence_len"
+            )
+        if self.sp > 1 and config.resolved_kernel != "band":
+            raise ValueError(
+                "sequence parallelism (sp > 1) requires the band kernel "
+                "(negative sampling)"
+            )
+        if self.sp > 1 and config.scatter_mean:
+            raise ValueError(
+                "scatter_mean duplicate counts are shard-local and would "
+                "diverge from single-chip semantics under sp > 1; use the "
+                "default sum semantics with sequence parallelism"
             )
         self.token_sharding = NamedSharding(self.mesh, TOKEN_SPEC)
         self._last_sync_step: Optional[int] = None
@@ -152,7 +194,8 @@ class ShardedTrainer(Trainer):
         )
 
     def _batches(self, batcher: BatchIterator) -> Iterator[Tuple[jnp.ndarray, int]]:
-        """Group dp consecutive [B, L] batches into one sharded [DP*B, L]."""
+        """Group dp consecutive [B, L] batches into one sharded [DP*B, L]
+        (the seq axis splits L at placement; no host-side reshaping)."""
         buf, words = [], 0
         for tokens, w in batcher.epoch():
             buf.append(tokens)
@@ -171,19 +214,19 @@ class ShardedTrainer(Trainer):
 
     def _post_step(self, state: TrainState) -> None:
         cfg = self.config
-        if self.dp > 1 and cfg.dp_sync_every and state.step % cfg.dp_sync_every == 0:
+        if self.dp * self.sp > 1 and cfg.dp_sync_every and state.step % cfg.dp_sync_every == 0:
             state.params = self.sync_fn(state.params)
             self._last_sync_step = state.step
 
     def _finalize(self, state: TrainState) -> None:
-        if self.dp > 1 and self._last_sync_step != state.step:
+        if self.dp * self.sp > 1 and self._last_sync_step != state.step:
             state.params = self.sync_fn(state.params)
             self._last_sync_step = state.step
 
     # ----------------------------------------------------------------- api
     def export_params(self, state: TrainState) -> Params:
         """Synced, de-replicated [V, d] tables on host."""
-        if self.dp > 1 and self._last_sync_step != state.step:
+        if self.dp * self.sp > 1 and self._last_sync_step != state.step:
             state.params = self.sync_fn(state.params)
             self._last_sync_step = state.step
         return {k: np.asarray(v[0]) for k, v in state.params.items()}

@@ -60,11 +60,12 @@ class Word2VecConfig:
     compute_dtype: str = "bfloat16"  # dot-product dtype (MXU-native; "float32" for exactness)
 
     # Which device kernel realizes the objective (ops/):
-    #   "band" — banded-matmul formulation with shared negatives
-    #            (ops/band_step.py; the fast path, ns only)
+    #   "band" — the fast paths: banded-matmul ns with shared negatives
+    #            (ops/band_step.py) or positional hs with per-position path
+    #            gather/scatter (ops/hs_step.py)
     #   "pair" — explicit per-pair enumeration, reference-faithful semantics
     #            incl. per-pair negative draws (ops/train_step.py)
-    #   "auto" — band when it applies (ns without hs), else pair
+    #   "auto" — band (the objective's fast path)
     kernel: str = "auto"
     # Shared negative draws per batch row for the band kernel; each center
     # weights them by (its reference draw count) / shared_negatives, so the
@@ -109,17 +110,16 @@ class Word2VecConfig:
             raise ValueError("window must be >= 1")
         if self.kernel not in ("auto", "band", "pair"):
             raise ValueError(f"kernel must be auto|band|pair, got {self.kernel!r}")
-        if self.kernel == "band" and (self.use_hs or not self.use_ns):
-            raise ValueError("kernel='band' requires negative sampling (no hs)")
         if self.shared_negatives < 1:
             raise ValueError("shared_negatives must be >= 1")
 
     @property
     def resolved_kernel(self) -> str:
-        """The kernel 'auto' resolves to for this config."""
+        """The kernel 'auto' resolves to for this config (ns/hs mutual
+        exclusion is enforced above, so 'band' is unambiguous)."""
         if self.kernel != "auto":
             return self.kernel
-        return "band" if (self.use_ns and not self.use_hs) else "pair"
+        return "band"
 
     @staticmethod
     def auto_batch_rows(

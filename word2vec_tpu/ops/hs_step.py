@@ -1,0 +1,262 @@
+"""Positional hierarchical-softmax training step: the fast path for hs.
+
+The pair kernel (ops/train_step.py) enumerates (center, context) pairs and
+gathers/scatters each context word's Huffman path rows once PER PAIR —
+[P, C, d] traffic with P = B*L*2W. But a position's path is the same for
+every center that predicts it, so this kernel:
+
+  sg+hs   — gathers each position's path rows ONCE ([B, L, C, d], C = padded
+            code length) and sweeps the window with 2W static shifted slices
+            (the j-loop of Word2Vec.cpp:339-345 becomes a static offset
+            loop over views of one padded tensor): per offset o,
+            logit[b,i,c] = h_i . syn1[points[tok_{i+o}], c], with the
+            reference's label 1-code and per-node mask. Path-row gradients
+            accumulate positionally in the padded buffer, so the final
+            scatter writes B*(L+2W)*C aggregated rows — 2W x fewer gather
+            and scatter rows than the pair kernel.
+  cbow+hs — no offset sweep at all: targets are the CENTER's own path
+            (Word2Vec.cpp:304-309 with hs), so one gather, one [B, L, C]
+            logit einsum, one scatter; the projection h is the banded
+            context sum/mean exactly as in ops/band_step.py.
+
+Update-rule semantics are reference-exact (same per-pair math as the pair
+kernel, Word2Vec.cpp:232-249): only the gather/scatter aggregation is
+restructured, so this kernel must agree with the pair kernel bitwise-modulo
+f32 reassociation — pinned by tests/test_hs_step_golden.py, including
+scatter_mean (the per-row contribution counts are identical sums).
+
+RNG streams match the pair kernel exactly: same key split, same (B, L) draw
+shapes for the subsample gate and window shrink, and hs draws no negatives —
+which is what makes exact cross-kernel agreement possible at any window.
+
+Mesh axes: tp_axis shards the embedding dim (logit einsums psum'd before the
+sigmoid); dp_axis folds the PRNG key per shard. Sequence parallelism is not
+implemented for hs (ShardedTrainer validates sp requires the ns band kernel).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Word2VecConfig
+from ..models.params import Params
+from .tables import DeviceTables
+from .train_step import _dup_mean_scale
+
+Metrics = Dict[str, jnp.ndarray]
+
+
+def make_hs_train_step(
+    config: Word2VecConfig,
+    tables: DeviceTables,
+    tp_axis: str | None = None,
+    dp_axis: str | None = None,
+) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
+    """step(params, tokens[B,L], key, alpha) -> (params, metrics).
+
+    Same contract as train_step.make_train_step; hierarchical softmax only.
+    """
+    if not config.use_hs or config.use_ns:
+        raise ValueError("hs kernel supports hierarchical softmax only")
+    W = config.window
+    is_cbow = config.model == "cbow"
+    cbow_mean = config.cbow_mean
+    scatter_mean = config.scatter_mean
+    cdt = jnp.dtype(config.compute_dtype)
+
+    def psum(x):
+        return jax.lax.psum(x, tp_axis) if tp_axis is not None else x
+
+    def step(
+        params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
+    ) -> Tuple[Params, Metrics]:
+        B, L = tokens.shape
+        if dp_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+        k_sub, k_win, _ = jax.random.split(key, 3)
+
+        valid = tokens >= 0
+        tok = jnp.where(valid, tokens, 0)
+        keep = valid & (jax.random.uniform(k_sub, (B, L)) < tables.keep_probs[tok])
+        w_eff = W - jax.random.randint(k_win, (B, L), 0, W, dtype=jnp.int32)
+
+        emb_in = params["emb_in"]
+        syn1 = params["emb_out_hs"]
+        C = tables.hs_points.shape[1]
+
+        if not is_cbow:
+            # ---- skip-gram: h = center row; targets = each context's path.
+            h = emb_in[tok]  # [B, L, d]
+            # padded position axis: q = j + W for context position j
+            tok_pad = jnp.pad(tokens, ((0, 0), (W, W)), constant_values=-1)
+            vpad = tok_pad >= 0
+            tpad = jnp.where(vpad, tok_pad, 0)
+            paths = tables.hs_points[tpad]  # [B, L+2W, C]
+            codes = tables.hs_codes[tpad]   # [B, L+2W, C]
+            cmask = (
+                jnp.arange(C, dtype=jnp.int32)[None, None, :]
+                < tables.hs_len[tpad][:, :, None]
+            ) & vpad[:, :, None]            # [B, L+2W, C]
+            rows = syn1[paths]              # [B, L+2W, C, d] — ONE gather
+
+            d_h = jnp.zeros(h.shape, jnp.float32)
+            d_rows = jnp.zeros(rows.shape, jnp.float32)
+            loss = jnp.float32(0.0)
+            pairs = jnp.float32(0.0)
+            ctx_hit = jnp.zeros((B, L), bool)  # any active pair per center
+            out_touch = jnp.zeros((B, L + 2 * W, C), jnp.float32)
+            for o in [o for o in range(-W, W + 1) if o != 0]:
+                sl = slice(W + o, W + o + L)  # context j = i + o, padded coords
+                pair_ok = keep & vpad[:, sl] & (abs(o) <= w_eff)  # [B, L]
+                m = (pair_ok[:, :, None] & cmask[:, sl]).astype(jnp.float32)
+                logit = psum(
+                    jnp.einsum(
+                        "bid,bicd->bic",
+                        h.astype(cdt),
+                        rows[:, sl].astype(cdt),
+                        preferred_element_type=jnp.float32,
+                    )
+                )  # [B, L, C]
+                # g = (1 - code - f) * alpha (Word2Vec.cpp:241-242)
+                label = 1.0 - codes[:, sl].astype(jnp.float32)
+                g = (label - jax.nn.sigmoid(logit)) * m * alpha
+                d_h = d_h + jnp.einsum(
+                    "bic,bicd->bid",
+                    g.astype(cdt),
+                    rows[:, sl].astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+                d_rows = d_rows.at[:, sl].add(
+                    jnp.einsum(
+                        "bic,bid->bicd",
+                        g.astype(cdt),
+                        h.astype(cdt),
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+                ls = jax.nn.log_sigmoid(logit)
+                loss += -jnp.sum(m * jnp.where(label > 0.5, ls, ls - logit))
+                pairs += jnp.sum(m)
+                ctx_hit = ctx_hit | pair_ok
+                if scatter_mean:
+                    out_touch = out_touch.at[:, sl].add(m)
+
+            # center rows: W.row(center) += accumulated grad (:351)
+            flat_c = tok.reshape(-1)
+            vals = d_h.reshape(B * L, -1)
+            if scatter_mean:
+                vals = vals * _dup_mean_scale(
+                    emb_in.shape[0], flat_c,
+                    ctx_hit.reshape(-1).astype(jnp.float32),
+                )[:, None]
+            new_in = emb_in.at[flat_c].add(vals.astype(emb_in.dtype))
+
+            # path rows: one aggregated scatter over the padded positions
+            flat_p = paths.reshape(-1)
+            order = jnp.argsort(flat_p)
+            d_rows_flat = d_rows.reshape(-1, d_rows.shape[-1])[order]
+            if scatter_mean:
+                d_rows_flat = d_rows_flat * _dup_mean_scale(
+                    syn1.shape[0], flat_p[order], out_touch.reshape(-1)[order]
+                )[:, None]
+            new_out = syn1.at[flat_p[order]].add(
+                d_rows_flat.astype(syn1.dtype), indices_are_sorted=True
+            )
+        else:
+            # ---- CBOW: h = (mean of) context rows; targets = center's path.
+            i_idx = jnp.arange(L, dtype=jnp.int32)
+            dist = jnp.abs(i_idx[:, None] - i_idx[None, :])
+            band = (
+                keep[:, :, None]
+                & valid[:, None, :]
+                & (dist[None] <= w_eff[:, :, None])
+                & (dist[None] > 0)
+            )
+            band_f = band.astype(jnp.float32)  # [B, L, L]
+            n_ctx = band_f.sum(axis=2)
+            ein = emb_in[tok]  # [B, L, d]
+            h = jnp.einsum(
+                "bij,bjd->bid",
+                band_f.astype(cdt),
+                ein.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            if cbow_mean:
+                h = h / jnp.maximum(n_ctx, 1.0)[:, :, None]
+
+            paths = tables.hs_points[tok]  # [B, L, C]
+            codes = tables.hs_codes[tok]
+            active = keep & (n_ctx > 0)    # skip centers without context, :289
+            cmask = (
+                jnp.arange(C, dtype=jnp.int32)[None, None, :]
+                < tables.hs_len[tok][:, :, None]
+            ) & active[:, :, None]
+            rows = syn1[paths]             # [B, L, C, d]
+            logit = psum(
+                jnp.einsum(
+                    "bid,bicd->bic",
+                    h.astype(cdt),
+                    rows.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+            m = cmask.astype(jnp.float32)
+            label = 1.0 - codes.astype(jnp.float32)
+            g = (label - jax.nn.sigmoid(logit)) * m * alpha
+            d_h = jnp.einsum(
+                "bic,bicd->bid",
+                g.astype(cdt),
+                rows.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            d_rows = jnp.einsum(
+                "bic,bid->bicd",
+                g.astype(cdt),
+                h.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            ls = jax.nn.log_sigmoid(logit)
+            loss = -jnp.sum(m * jnp.where(label > 0.5, ls, ls - logit))
+            pairs = jnp.sum(m)
+
+            # fan d_h to context rows (second /n under cbow_mean, :313-315)
+            if cbow_mean:
+                d_h = d_h / jnp.maximum(n_ctx, 1.0)[:, :, None]
+            d_in_pos = jnp.einsum(
+                "bij,bid->bjd",
+                band_f.astype(cdt),
+                d_h.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            flat_c = tok.reshape(-1)
+            order = jnp.argsort(flat_c)
+            d_in_flat = d_in_pos.reshape(-1, d_in_pos.shape[-1])[order]
+            if scatter_mean:
+                d_in_flat = d_in_flat * _dup_mean_scale(
+                    emb_in.shape[0], flat_c[order],
+                    band_f.sum(axis=1).reshape(-1)[order],
+                )[:, None]
+            new_in = emb_in.at[flat_c[order]].add(
+                d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
+            )
+
+            flat_p = paths.reshape(-1)
+            porder = jnp.argsort(flat_p)
+            d_rows_flat = d_rows.reshape(-1, d_rows.shape[-1])[porder]
+            if scatter_mean:
+                d_rows_flat = d_rows_flat * _dup_mean_scale(
+                    syn1.shape[0], flat_p[porder], m.reshape(-1)[porder]
+                )[:, None]
+            new_out = syn1.at[flat_p[porder]].add(
+                d_rows_flat.astype(syn1.dtype), indices_are_sorted=True
+            )
+
+        new_params = dict(params)
+        new_params["emb_in"] = new_in
+        new_params["emb_out_hs"] = new_out
+        return new_params, {"loss_sum": loss, "pairs": pairs}
+
+    return step

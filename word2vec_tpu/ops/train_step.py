@@ -127,19 +127,25 @@ def make_train_step(
 ) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
     """Build the jittable step, dispatching on config.kernel.
 
-    "band" (the fast path, ns only) lives in ops/band_step.py; "pair" is the
-    reference-faithful enumeration below. "auto" picks band when it applies.
-    sp_axis (sequence/context parallelism via halo exchange) is implemented
-    by the band kernel only.
+    "band" selects the objective's fast path — banded-matmul ns
+    (ops/band_step.py) or positional hs (ops/hs_step.py); "pair" is the
+    reference-faithful enumeration below. sp_axis (sequence/context
+    parallelism via halo exchange) is implemented by the ns band kernel only.
     """
     if config.resolved_kernel == "band":
+        if config.use_hs:
+            if sp_axis is not None:
+                raise ValueError(
+                    "sequence parallelism requires the ns band kernel"
+                )
+            from .hs_step import make_hs_train_step
+
+            return make_hs_train_step(config, tables, tp_axis, dp_axis)
         from .band_step import make_band_train_step
 
         return make_band_train_step(config, tables, tp_axis, dp_axis, sp_axis)
     if sp_axis is not None:
-        raise ValueError(
-            "sequence parallelism requires the band kernel (ns objective)"
-        )
+        raise ValueError("sequence parallelism requires the ns band kernel")
     return make_pair_train_step(config, tables, tp_axis, dp_axis)
 
 

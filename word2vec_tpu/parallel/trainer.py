@@ -168,9 +168,11 @@ class ShardedTrainer(Trainer):
                 f"shorter than window {config.window}; lower sp or raise "
                 f"max_sentence_len"
             )
-        if self.sp > 1 and config.resolved_kernel != "band":
+        if self.sp > 1 and not (
+            config.resolved_kernel == "band" and config.use_ns
+        ):
             raise ValueError(
-                "sequence parallelism (sp > 1) requires the band kernel "
+                "sequence parallelism (sp > 1) requires the ns band kernel "
                 "(negative sampling)"
             )
         if self.sp > 1 and config.scatter_mean:

@@ -2,11 +2,22 @@
 """Benchmark: SGNS training words/sec on the flagship config (BASELINE.json:
 skip-gram, negative=5, dim=300, window=5, text8-scale corpus).
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+— ALWAYS, even when the TPU backend is unreachable (the axon tunnel can hang
+indefinitely on backend init, so availability is probed in a subprocess with a
+timeout and the bench falls back to CPU with an explicit marker) or when the
+run itself fails (the line then carries an "error" field instead of rc=1).
 
-Corpus: ./text8 if present, else a synthetic Zipf stream with text8's vocab
-size and skew (utils/synthetic.py) — the perf-relevant properties match, so
-words/sec transfers.
+Extra fields: "platform"/"device_kind" (where it actually ran), "mfu" and
+"model_tflops_per_sec" (model-FLOPs utilisation: algorithmically useful FLOPs
+from the trained-pair count over the chip's peak — executed FLOPs may be
+higher, e.g. band-kernel masking, so this is the honest denominator-side
+number), and "tpu_fallback_reason" when the TPU was requested but unusable.
+
+Corpus: ./text8 if present (streamed through the native ingest — no Python
+token lists), else a synthetic Zipf stream with text8's vocab size and skew
+(utils/synthetic.py) — the perf-relevant properties match, so words/sec
+transfers.
 
 Baseline: benchmarks/reference_baseline.json holds the measured words/sec of
 the compiled C++ reference on this machine (see benchmarks/reference_harness/
@@ -18,25 +29,59 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+# bf16 MXU peak per chip, by jax device_kind prefix. Model-FLOPs MFU is only
+# reported when the chip is recognised; CPU runs report mfu=null.
+PEAK_FLOPS_BF16 = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--tokens", type=int, default=2_000_000)
-    ap.add_argument("--dim", type=int, default=300)
-    ap.add_argument("--window", type=int, default=5)
-    ap.add_argument("--negative", type=int, default=5)
-    ap.add_argument("--batch-rows", type=int, default=256)
-    ap.add_argument("--max-len", type=int, default=192)
-    ap.add_argument("--warmup-steps", type=int, default=3)
-    ap.add_argument("--measure-steps", type=int, default=0,
-                    help="0 = one full epoch")
-    ap.add_argument("--text8", default="text8")
-    args = ap.parse_args()
 
+def emit(record: dict) -> None:
+    print(json.dumps(record))
+
+
+def probe_backend(timeout_s: float) -> tuple[bool, str]:
+    """Check in a SUBPROCESS whether the default jax backend initialises.
+
+    The axon TPU tunnel fails by hanging, not by raising, so an in-process
+    check could wedge the bench forever. Returns (ok, platform_or_reason).
+    """
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init hang (> {timeout_s:.0f}s)"
+    if out.returncode != 0:
+        tail = (out.stderr or "").strip().splitlines()
+        return False, "backend init error: " + (tail[-1] if tail else "unknown")
+    return True, out.stdout.strip()
+
+
+def model_flops_per_target(dim: int) -> float:
+    """Algorithmic FLOPs for one sigmoid target: a d-dot logit + d-axpy
+    hidden-grad + d-axpy row update (Word2Vec.cpp:262-268) ~= 3 * 2d FLOPs.
+    The kernels' "pairs" metric counts TARGETS (positives and negatives
+    alike: train_step.py sums tmask over all K+1; band_step.py adds
+    sum(w_neg)), so no extra (K+1) factor belongs here."""
+    return 6.0 * dim
+
+
+def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -60,11 +105,12 @@ def main() -> None:
     )
 
     if os.path.exists(args.text8):
-        from word2vec_tpu.data.corpus import text8_corpus
+        from word2vec_tpu import native
 
-        sents = list(text8_corpus(args.text8))
-        vocab = Vocab.build(sents, min_count=cfg.min_count)
-        corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+        counts, _total = native.count_file(args.text8)
+        vocab = Vocab.from_counter(counts, min_count=cfg.min_count)
+        flat = native.encode_file(args.text8, vocab, native.MODE_STREAM)
+        corpus = PackedCorpus.from_flat(flat, cfg.max_sentence_len)
         corpus_name = "text8"
     else:
         vocab = zipf_vocab(71000, 17_000_000)
@@ -86,13 +132,15 @@ def main() -> None:
         params, m = step(params, jnp.asarray(tokens), base_key, alpha)
     jax.block_until_ready(params)
 
-    # timed steady-state
+    # timed steady-state; pairs accumulate on device (no per-step sync)
     words = 0
     steps = 0
+    pairs_acc = jnp.float32(0.0)
     t0 = time.perf_counter()
     for tokens, w in prefetch(it):
         key = jax.random.fold_in(base_key, steps)
         params, m = step(params, jnp.asarray(tokens), key, alpha)
+        pairs_acc = pairs_acc + m["pairs"]
         words += w
         steps += 1
         if args.measure_steps and steps >= args.measure_steps:
@@ -100,6 +148,7 @@ def main() -> None:
     jax.block_until_ready(params)
     dt = time.perf_counter() - t0
     wps = words / dt
+    pairs = float(pairs_acc)
 
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
@@ -114,16 +163,136 @@ def main() -> None:
             vs = wps / float(ref["words_per_sec"])
 
     dev = jax.devices()[0]
-    print(
-        json.dumps(
-            {
-                "metric": f"sgns-dim{args.dim}-w{args.window}-k{args.negative} "
-                f"words/sec ({corpus_name}, {dev.platform})",
-                "value": round(wps, 1),
-                "unit": "words/sec",
-                "vs_baseline": round(vs, 2) if vs is not None else None,
-            }
+    model_fps = pairs * model_flops_per_target(args.dim) / dt
+    peak = next(
+        (v for k, v in PEAK_FLOPS_BF16.items() if dev.device_kind.startswith(k)),
+        None,
+    )
+    record = {
+        "metric": f"sgns-dim{args.dim}-w{args.window}-k{args.negative} "
+        f"words/sec ({corpus_name}, {dev.platform})",
+        "value": round(wps, 1),
+        "unit": "words/sec",
+        "vs_baseline": round(vs, 2) if vs is not None else None,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "steps": steps,
+        "words": words,
+        "model_tflops_per_sec": round(model_fps / 1e12, 4),
+        "mfu": round(model_fps / peak, 5) if peak else None,
+    }
+    if platform_note:
+        record["tpu_fallback_reason"] = platform_note
+    return record
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=2_000_000)
+    ap.add_argument("--dim", type=int, default=300)
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--negative", type=int, default=5)
+    ap.add_argument("--batch-rows", type=int, default=256)
+    ap.add_argument("--max-len", type=int, default=192)
+    ap.add_argument("--warmup-steps", type=int, default=3)
+    ap.add_argument("--measure-steps", type=int, default=0,
+                    help="0 = one full epoch")
+    ap.add_argument("--text8", default="text8")
+    ap.add_argument("--probe-timeout", type=float, default=90.0,
+                    help="seconds to wait for backend init before CPU fallback")
+    ap.add_argument("--run-timeout", type=float, default=3600.0,
+                    help="watchdog for the measured run itself (the tunnel "
+                    "can hang MID-run, after a successful probe)")
+    ap.add_argument("--cpu", action="store_true", help="skip probe, run on CPU")
+    ap.add_argument("--inner", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--fallback-reason", default=None, help=argparse.SUPPRESS)
+    return ap
+
+
+def error_record(args: argparse.Namespace, err: str, note: str | None) -> dict:
+    return {
+        "metric": f"sgns-dim{args.dim}-w{args.window}-k{args.negative} words/sec",
+        "value": None,
+        "unit": "words/sec",
+        "vs_baseline": None,
+        "error": err,
+        "tpu_fallback_reason": note,
+    }
+
+
+def inner_main(args: argparse.Namespace) -> None:
+    """The measured run. Any failure still emits the one JSON line, with a
+    traceback tail for post-hoc diagnosis."""
+    try:
+        import jax
+
+        if args.cpu:
+            # JAX_PLATFORMS env is overridden by the axon sitecustomize's
+            # jax.config call; config.update after import wins over both.
+            jax.config.update("jax_platforms", "cpu")
+        emit(run(args, args.fallback_reason))
+    except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
+        import traceback
+
+        tb = traceback.format_exc().strip().splitlines()
+        emit(
+            error_record(
+                args,
+                f"{type(e).__name__}: {e}",
+                args.fallback_reason,
+            )
+            | {"traceback_tail": tb[-12:]}
         )
+        sys.exit(0)
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    if args.inner:
+        inner_main(args)
+        return
+
+    # Outer shell: probe the backend, then run the bench in a watchdogged
+    # child — a tunnel hang mid-run (post-probe) would otherwise wedge with
+    # no output at all, which is exactly the BENCH_r01 failure mode.
+    platform_note = None
+    force_cpu = args.cpu
+    if not force_cpu:
+        ok, info = probe_backend(args.probe_timeout)
+        if not ok:
+            platform_note = info
+            force_cpu = True
+
+    child_cmd = [sys.executable, os.path.abspath(__file__), "--inner"]
+    child_cmd += ["--cpu"] if force_cpu else []
+    child_cmd += ["--fallback-reason", platform_note] if platform_note else []
+    for flag, val in [
+        ("--tokens", args.tokens), ("--dim", args.dim),
+        ("--window", args.window), ("--negative", args.negative),
+        ("--batch-rows", args.batch_rows), ("--max-len", args.max_len),
+        ("--warmup-steps", args.warmup_steps),
+        ("--measure-steps", args.measure_steps), ("--text8", args.text8),
+    ]:
+        child_cmd += [flag, str(val)]
+    try:
+        out = subprocess.run(
+            child_cmd, capture_output=True, text=True, timeout=args.run_timeout
+        )
+    except subprocess.TimeoutExpired:
+        emit(error_record(
+            args, f"bench run hang (> {args.run_timeout:.0f}s)", platform_note
+        ))
+        return
+    lines = [l for l in (out.stdout or "").strip().splitlines() if l.startswith("{")]
+    if lines:
+        print(lines[-1])
+        return
+    tail = (out.stderr or "").strip().splitlines()[-12:]
+    emit(
+        error_record(
+            args, f"bench child died rc={out.returncode} with no JSON", platform_note
+        )
+        | {"traceback_tail": tail}
     )
 
 

@@ -67,6 +67,12 @@ def eval_vectors(path: str, pairs, topic_of) -> dict:
     from word2vec_tpu.io.embeddings import load_embeddings_text
 
     words, W = load_embeddings_text(path)
+    if W.size == 0:
+        # The reference writes a "0 0" matrix for cbow+hs: init_weights
+        # allocates C only under ns (Word2Vec.cpp:208-209) yet main.cpp:199
+        # saves C for hs+cbow. Our framework fixes this (SURVEY §2 latent
+        # bug), so in this config parity is ours-absolute, not a delta.
+        return {"error": "empty embedding matrix (reference cbow+hs latent bug)"}
     idx = {w: i for i, w in enumerate(words)}
     ii, jj, gold = [], [], []
     for a, b, s in pairs:
@@ -95,6 +101,8 @@ def main() -> None:
     ap.add_argument("--model", choices=["sg", "cbow"], default="sg")
     ap.add_argument("--train-method", choices=["ns", "hs"], default="ns")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kernel", choices=["auto", "band", "pair"], default="auto",
+                    help="device kernel for OUR side (reference has no analog)")
     ap.add_argument("--skip-reference", action="store_true",
                     help="evaluate only this framework (no g++/reference)")
     args = ap.parse_args()
@@ -111,7 +119,7 @@ def main() -> None:
     result = {
         "config": f"{args.model}+{args.train_method} k={args.negative} "
         f"dim={args.dim} w={args.window} iter={args.iters} "
-        f"subsample={args.subsample}",
+        f"subsample={args.subsample} kernel={args.kernel}",
         "corpus": f"topic-synthetic-{args.tokens} tokens",
     }
     with tempfile.TemporaryDirectory() as tmp:
@@ -140,6 +148,7 @@ def main() -> None:
             [
                 sys.executable, "-m", "word2vec_tpu.cli", *common,
                 "-output", "vec_ours.txt", "--backend", "cpu", "--quiet",
+                "--kernel", args.kernel,
             ],
             cwd=tmp, check=True, capture_output=True,
             env={**os.environ, "PYTHONPATH": REPO + os.pathsep
@@ -149,7 +158,7 @@ def main() -> None:
             os.path.join(tmp, "vec_ours.txt"), pairs, topic_of
         )
 
-    if "reference" in result:
+    if "reference" in result and "error" not in result["reference"]:
         result["delta_spearman"] = round(
             result["ours"]["spearman"] - result["reference"]["spearman"], 4
         )

@@ -202,11 +202,16 @@ def test_band_step_matches_oracle_scatter_mean(kw):
         )
 
 
-def test_auto_kernel_resolves_to_band_for_ns():
+def test_auto_kernel_resolves_to_band_fast_paths():
+    # "band" means "the objective's fast path": the banded-matmul ns kernel
+    # (ops/band_step.py) for ns, the positional hs kernel (ops/hs_step.py)
+    # for hs. Explicit kernel="pair" stays untouched.
     cfg = Word2VecConfig(model="sg", train_method="ns", negative=5)
     assert cfg.resolved_kernel == "band"
     cfg_hs = Word2VecConfig(model="sg", train_method="hs", negative=0)
-    assert cfg_hs.resolved_kernel == "pair"
+    assert cfg_hs.resolved_kernel == "band"
+    cfg_pair = Word2VecConfig(model="sg", train_method="hs", negative=0, kernel="pair")
+    assert cfg_pair.resolved_kernel == "pair"
 
 
 def test_band_pad_only_batch_is_noop():

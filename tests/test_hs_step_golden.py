@@ -1,0 +1,218 @@
+"""Golden tests for the positional hs fast kernel (ops/hs_step.py).
+
+Two independent pins, per SURVEY §4 "Numerics":
+
+1. A pure-NumPy scalar oracle of the reference hs update rule
+   (Word2Vec.cpp:232-249 kernel; :319-353 sg driver; :273-317 cbow driver)
+   with batched semantics (reads from pre-update weights, duplicates summed).
+   Randomness is eliminated by construction: window=1 => shrink draw is 0,
+   subsample_threshold=0 => keep prob 1.
+
+2. Exact hs-kernel-vs-pair-kernel agreement at window 1 and 3, with and
+   without scatter_mean — possible because both kernels consume identical
+   RNG streams (same 3-way key split, same (B, L) draw shapes) and hs draws
+   no negatives. This is the claim in ops/hs_step.py's module docstring.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.huffman import build_huffman
+from word2vec_tpu.ops.tables import DeviceTables
+from word2vec_tpu.ops.train_step import make_train_step
+
+V, D = 12, 8
+ALPHA = 0.02
+COUNTS = np.arange(2 * V, V, -1)  # descending
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def make_tables():
+    keep = jnp.ones(V, jnp.float32)
+    hc = build_huffman(COUNTS)
+    return (
+        DeviceTables(
+            keep,
+            None,
+            None,
+            jnp.asarray(hc.codes.astype(np.int8)),
+            jnp.asarray(hc.points),
+            jnp.asarray(hc.code_len),
+        ),
+        hc,
+    )
+
+
+def make_params(rng):
+    return {
+        "emb_in": rng.normal(0, 0.1, (V, D)).astype(np.float32),
+        "emb_out_hs": rng.normal(0, 0.1, (V - 1, D)).astype(np.float32),
+    }
+
+
+def oracle_hs(hc, params, h, pred, alpha, new):
+    """One hs kernel call (Word2Vec.cpp:232-249); returns grad_h."""
+    grad_h = np.zeros(D, np.float64)
+    for k in range(int(hc.code_len[pred])):
+        pt = int(hc.points[pred, k])
+        code = int(hc.codes[pred, k])
+        row = params["emb_out_hs"][pt].astype(np.float64)
+        g = (1.0 - code - sigmoid(row @ h)) * alpha  # :241-242
+        grad_h += g * row
+        new["emb_out_hs"][pt] += (g * h).astype(np.float32)
+    return grad_h
+
+
+def oracle_step(cfg, hc, params, tokens, alpha):
+    new = {k: v.copy() for k, v in params.items()}
+    B, L = tokens.shape
+    for b in range(B):
+        for i in range(L):
+            center = tokens[b, i]
+            if center < 0:
+                continue
+            ctx = [
+                tokens[b, j]
+                for j in (i - 1, i + 1)
+                if 0 <= j < L and tokens[b, j] >= 0
+            ]
+            if cfg.model == "sg":
+                h = params["emb_in"][center].astype(np.float64)
+                grad_h = np.zeros(D, np.float64)
+                for pred in ctx:
+                    grad_h += oracle_hs(hc, params, h, pred, alpha, new)
+                new["emb_in"][center] += grad_h.astype(np.float32)
+            else:  # cbow: context rows project, center's path is the target
+                n = len(ctx)
+                if n == 0:
+                    continue
+                h = np.sum(
+                    [params["emb_in"][c].astype(np.float64) for c in ctx], axis=0
+                )
+                if cfg.cbow_mean:
+                    h = h / n
+                grad_h = oracle_hs(hc, params, h, center, alpha, new)
+                if cfg.cbow_mean:
+                    grad_h = grad_h / n  # second division, Word2Vec.cpp:313-314
+                for c in ctx:
+                    new["emb_in"][c] += grad_h.astype(np.float32)
+    return new
+
+
+TOKENS = np.array(
+    [
+        [3, 1, 4, 1, 5, 9, 2, 6, -1],
+        [0, 7, 1, 0, -1, -1, -1, -1, -1],
+    ],
+    dtype=np.int32,
+)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(model="sg"),
+        dict(model="cbow", cbow_mean=True),
+        dict(model="cbow", cbow_mean=False),
+    ],
+    ids=lambda kw: f"{kw['model']}-mean{kw.get('cbow_mean')}",
+)
+def test_hs_step_matches_oracle(kw):
+    # kernel="auto" so this pins the SHIPPED default route for hs (hs_step),
+    # not the pair kernel. scatter_mean=False matches the oracle's sum
+    # semantics.
+    cfg = Word2VecConfig(
+        window=1, subsample_threshold=0.0, word_dim=D, scatter_mean=False,
+        train_method="hs", negative=0, kernel="auto",
+        compute_dtype="float32", **kw
+    )
+    assert cfg.resolved_kernel == "band"
+    tables, hc = make_tables()
+    rng = np.random.default_rng(42)
+    params = make_params(rng)
+
+    step = make_train_step(cfg, tables)
+    jparams = {k: jnp.asarray(v) for k, v in params.items()}
+    new_j, metrics = jax.jit(step)(
+        jparams, jnp.asarray(TOKENS), jax.random.key(0), jnp.float32(ALPHA)
+    )
+
+    expected = oracle_step(cfg, hc, params, TOKENS, ALPHA)
+    for k in expected:
+        np.testing.assert_allclose(
+            np.asarray(new_j[k]), expected[k], atol=2e-5, err_msg=k
+        )
+    assert float(metrics["pairs"]) > 0
+    assert np.isfinite(float(metrics["loss_sum"]))
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+@pytest.mark.parametrize("scatter_mean", [False, True])
+@pytest.mark.parametrize("window", [1, 3])
+def test_hs_vs_pair_agree(window, scatter_mean, model):
+    """The positional hs kernel restructures only aggregation, not math, so
+    it must agree with the per-pair kernel to f32-reassociation tolerance.
+    Subsampling stays ON (threshold default-like) to also pin the shared
+    keep-gate stream; both kernels draw it with the same key and shape."""
+    kw = dict(
+        window=window, word_dim=D, model=model, train_method="hs",
+        negative=0, scatter_mean=scatter_mean, compute_dtype="float32",
+        subsample_threshold=0.01,
+    )
+    tables, _ = make_tables()
+    # non-trivial keep probs exercise the subsample gate identically
+    keep = jnp.asarray(np.linspace(0.55, 1.0, V).astype(np.float32))
+    tables = DeviceTables(
+        keep, None, None, tables.hs_codes, tables.hs_points, tables.hs_len
+    )
+    rng = np.random.default_rng(5)
+    params_np = make_params(rng)
+    tokens = jnp.asarray(
+        np.array(
+            [
+                [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, -1],
+                [0, 7, 1, 0, 8, 10, 11, 2, -1, -1, -1, -1],
+            ],
+            dtype=np.int32,
+        )
+    )
+    outs = {}
+    for kernel in ("pair", "band"):
+        cfg = Word2VecConfig(kernel=kernel, **kw)
+        step = jax.jit(make_train_step(cfg, tables))
+        params = {k: jnp.asarray(v) for k, v in params_np.items()}
+        new, metrics = step(params, tokens, jax.random.key(7), jnp.float32(ALPHA))
+        outs[kernel] = (new, metrics)
+    for k in outs["pair"][0]:
+        np.testing.assert_allclose(
+            np.asarray(outs["pair"][0][k]),
+            np.asarray(outs["band"][0][k]),
+            atol=2e-5,
+            err_msg=k,
+        )
+    assert float(outs["pair"][1]["pairs"]) == pytest.approx(
+        float(outs["band"][1]["pairs"])
+    )
+
+
+def test_hs_pad_only_batch_is_noop():
+    cfg = Word2VecConfig(
+        window=2, subsample_threshold=0.0, word_dim=D, model="sg",
+        train_method="hs", negative=0, kernel="auto", compute_dtype="float32",
+    )
+    tables, _ = make_tables()
+    rng = np.random.default_rng(9)
+    params = {k: jnp.asarray(v) for k, v in make_params(rng).items()}
+    tokens = jnp.full((2, 6), -1, dtype=jnp.int32)
+    step = jax.jit(make_train_step(cfg, tables))
+    new, metrics = step(params, tokens, jax.random.key(1), jnp.float32(ALPHA))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(new[k]), np.asarray(params[k]))
+    assert float(metrics["pairs"]) == 0.0

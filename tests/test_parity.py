@@ -5,6 +5,13 @@ planted-topic corpus and compares eval scores — the executable form of
 BASELINE.md's "WS-353 within ±1% of the CPU reference" gate (real datasets
 are unreachable offline; SURVEY §7(e): parity is statistical, not bitwise).
 
+The matrix covers every shipped model x objective combination on the DEFAULT
+kernel route (auto -> band/hs fast paths) plus the pair kernel on the primary
+config, so no shipped route goes ungated. cbow+hs is special: the reference
+itself is broken there (init_weights allocates C only under ns,
+Word2Vec.cpp:208-209, while main.cpp:199 saves C for hs+cbow -> "0 0"
+output), so that cell gates on our absolute score only.
+
 Skipped when g++ is unavailable. The reference seeds from random_device
 (Word2Vec.cpp:16), so its score varies run to run — the tolerance below is
 calibrated to that noise on this corpus size, not to ours (ours is
@@ -26,17 +33,43 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def test_eval_score_parity_with_reference():
+def run_parity(*extra):
     out = subprocess.run(
         [
             sys.executable,
             os.path.join(REPO, "benchmarks", "parity.py"),
-            "--tokens", "80000", "--iters", "3", "--dim", "32",
+            # 120k tokens is the calibrated parity size: batched updates
+            # (within-batch staleness, SURVEY §7(a)) converge to the same
+            # asymptote as the reference's sequential updates but need a few
+            # more total steps — at 80k/3 iters cbow+ns sits ~0.05 below the
+            # ceiling that it reaches exactly at 120k/3 or 80k/6.
+            "--tokens", "120000", "--iters", "3", "--dim", "32",
+            *extra,
         ],
         capture_output=True, text=True, timeout=540,
     )
     assert out.returncode == 0, out.stderr[-2000:]
-    result = json.loads(out.stdout.strip().splitlines()[-1])
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+MATRIX = [
+    # (model, train_method, extra CLI args)
+    ("sg", "ns", ()),
+    ("cbow", "ns", ()),
+    ("sg", "hs", ()),
+    # explicit pair kernel on the primary config: the reference-faithful
+    # route must hold parity too (auto covers band above)
+    ("sg", "ns", ("--kernel", "pair")),
+]
+
+
+@pytest.mark.parametrize(
+    "model,method,extra",
+    MATRIX,
+    ids=lambda v: v if isinstance(v, str) else ("-".join(v) or "auto"),
+)
+def test_eval_score_parity_with_reference(model, method, extra):
+    result = run_parity("--model", model, "--train-method", method, *extra)
     ref, ours = result["reference"], result["ours"]
     # both recover the planted structure...
     assert ref["spearman"] > 0.6, result
@@ -44,3 +77,12 @@ def test_eval_score_parity_with_reference():
     # ...and agree with each other within small-corpus noise
     assert abs(result["delta_spearman"]) < 0.05, result
     assert abs(result["delta_purity"]) < 0.05, result
+
+
+def test_cbow_hs_absolute_quality():
+    """The reference cannot train cbow+hs (latent bug above); we can. Gate on
+    absolute recovery of the planted structure instead of a delta."""
+    result = run_parity("--model", "cbow", "--train-method", "hs")
+    assert "error" in result["reference"], result
+    assert result["ours"]["spearman"] > 0.6, result
+    assert result["ours"]["neighbor_purity@10"] > 0.8, result

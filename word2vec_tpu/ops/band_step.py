@@ -41,8 +41,9 @@ Semantics deltas vs the reference, all documented and eval-gated:
     across positive targets and shared negative draws (each draw counting
     its expected per-pair multiplicity k_i/KP summed over centers).
 
-Hierarchical softmax has no dense reformulation (per-word Huffman paths), so
-config.kernel="auto" routes hs to the pair kernel.
+Hierarchical softmax has no shared-negative reformulation (per-word Huffman
+paths), so config.kernel="auto" routes hs to the positional hs fast kernel
+(ops/hs_step.py) instead of this one.
 
 Mesh axes mirror the pair kernel: with tp_axis the embedding dim is sharded
 and every logit matmul is psum'd over the axis before the sigmoid; all

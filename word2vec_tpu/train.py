@@ -137,6 +137,7 @@ class Trainer:
         t0 = time.perf_counter()
         loss_hist: List[float] = []
         last_metrics = None
+        self._warned_nonfinite = False
         # state.epoch = next epoch to run; a mid-epoch checkpoint resumes from
         # the start of its epoch (batch position within an epoch is not saved)
         for epoch in range(state.epoch, cfg.iters):
@@ -153,6 +154,18 @@ class Trainer:
                     m = jax.device_get(metrics)
                     loss = float(m["loss_sum"]) / max(1.0, float(m["pairs"]))
                     loss_hist.append(loss)
+                    if not np.isfinite(loss) and not self._warned_nonfinite:
+                        self._warned_nonfinite = True
+                        import warnings
+
+                        warnings.warn(
+                            f"non-finite loss at step {state.step}: batched-sum "
+                            "updates have diverged. Known cause: extreme "
+                            "duplicate-row aggregation (tiny vocabulary or "
+                            "hot rows) — shrink the batch, or set "
+                            "config.scatter_mean=True (see config.py notes).",
+                            stacklevel=2,
+                        )
                     if self.log_fn:
                         dt = time.perf_counter() - t0
                         self.log_fn(

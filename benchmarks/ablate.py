@@ -37,6 +37,21 @@ def timeit(name, fn, *args, steps=30):
     return dt
 
 
+def timeit_carry(name, fn, carry, *args, steps=30):
+    """Like timeit but threads the first argument through iterations —
+    required for the jitted train step, which donates its params buffer."""
+    import jax
+
+    carry = jax.block_until_ready(fn(carry, *args))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        carry = fn(carry, *args)
+    jax.block_until_ready(carry)
+    dt = (time.perf_counter() - t0) / steps
+    print(f"  {name:<38s} {dt * 1e3:8.3f} ms")
+    return dt
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--dim", type=int, default=300)
@@ -92,9 +107,45 @@ def main() -> None:
             new_p, _ = step(p, t, k, alpha)
             return new_p
 
-        dt = timeit(f"full step [{kern}]", run, params, tokens_d, key,
-                    steps=args.steps)
+        dt = timeit_carry(f"full step [{kern}]", run, params, tokens_d, key,
+                          steps=args.steps)
         print(f"    -> {words_per_step / dt:,.0f} words/sec")
+
+    # ---- window-blocked band scaling (ops/banded.py): at fixed tokens/step,
+    # dense positive-side cost grows with L (the [L, L] plane), chunked cost
+    # stays ~flat (the [S, S+2W] slabs). VERDICT r1 item 3's "done" check.
+    print("band chunking (fixed tokens/step, sg+ns):")
+    tot = B * L
+    for Lx in (L, 2 * L, 4 * L):
+        Bx = max(1, tot // Lx)
+        idx = np.concatenate(ids)[: Bx * Lx]
+        tk = np.full((Bx, Lx), -1, np.int32)
+        tk.reshape(-1)[: idx.size] = idx
+        tk_d = jnp.asarray(tk)
+        for chunk, tag in ((Lx, "dense"), (0, "auto")):
+            cfg = Word2VecConfig(
+                model="sg", train_method="ns", negative=args.negative,
+                word_dim=D, window=args.window, subsample_threshold=1e-4,
+                batch_rows=Bx, max_sentence_len=Lx, kernel="band",
+                shared_negatives=KP, band_chunk=chunk,
+            )
+            from word2vec_tpu.ops.banded import resolve_chunk
+
+            S = resolve_chunk(Lx, args.window, chunk)
+            tables = DeviceTables.build(vocab, cfg)
+            step = jit_train_step(cfg, tables)
+            params = init_params(cfg, len(vocab), jax.random.key(1))
+            alpha = jnp.float32(cfg.init_alpha)
+
+            def run(p, t, k):
+                new_p, _ = step(p, t, k, alpha)
+                return new_p
+
+            dt = timeit_carry(
+                f"band step B={Bx:<4d} L={Lx:<5d} {tag} (S={S or Lx})",
+                run, params, tk_d, key, steps=args.steps,
+            )
+            print(f"    -> {Bx * Lx / dt:,.0f} words/sec")
 
     # ---- band-kernel piece timings (same shapes as the step above)
     print("band pieces:")

@@ -202,6 +202,42 @@ def test_band_step_matches_oracle_scatter_mean(kw):
         )
 
 
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+@pytest.mark.parametrize("scatter_mean", [False, True])
+def test_chunked_band_matches_dense_full_step(model, scatter_mean):
+    """The window-blocked representation (ops/banded.py, band_chunk=S) must
+    reproduce the dense band kernel's full step bit-for-bit up to f32
+    reassociation — same RNG streams, same draws, only the band contraction
+    layout differs. L=19 with S=4 exercises ragged chunks."""
+    kw = dict(
+        window=2, subsample_threshold=0.01, word_dim=D, model=model,
+        train_method="ns", negative=2, scatter_mean=scatter_mean,
+        compute_dtype="float32", shared_negatives=KP,
+    )
+    tables = make_tables()
+    rng = np.random.default_rng(17)
+    params_np = make_params(Word2VecConfig(**kw), rng)
+    tokens = jnp.asarray(
+        rng.integers(-1, V, size=(3, 19)).astype(np.int32)
+    )
+    outs = {}
+    for chunk in (0, 4):  # 0 -> auto -> dense at L=19
+        cfg = Word2VecConfig(band_chunk=chunk, **kw)
+        step = jax.jit(make_band_train_step(cfg, tables))
+        params = {k: jnp.asarray(v) for k, v in params_np.items()}
+        new, metrics = step(params, tokens, jax.random.key(11), jnp.float32(ALPHA))
+        outs[chunk] = (new, metrics)
+    for k in outs[0][0]:
+        np.testing.assert_allclose(
+            np.asarray(outs[0][0][k]), np.asarray(outs[4][0][k]),
+            atol=2e-5, err_msg=k,
+        )
+    for mk in ("loss_sum", "pairs"):
+        assert float(outs[0][1][mk]) == pytest.approx(
+            float(outs[4][1][mk]), abs=1e-3
+        )
+
+
 def test_auto_kernel_resolves_to_band_fast_paths():
     # "band" means "the objective's fast path": the banded-matmul ns kernel
     # (ops/band_step.py) for ns, the positional hs kernel (ops/hs_step.py)

@@ -1,0 +1,114 @@
+"""Chunked-vs-dense exactness for the window-blocked band primitives
+(ops/banded.py): every helper must produce identical results (up to f32
+reassociation) in the dense [B,L,L] and chunked [B,C,S,S+2W] representations,
+including ragged last chunks (L not a multiple of S) and the minimum legal
+chunk S = 2W."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu.ops import banded
+
+B, D, KP = 3, 8, 5
+F32 = jnp.float32
+
+
+def make_inputs(L, W, seed=0):
+    rng = np.random.default_rng(seed)
+    a = jnp.asarray(rng.normal(size=(B, L, D)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(B, L, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, L, KP)).astype(np.float32))
+    keep = jnp.asarray(rng.random((B, L)) < 0.8)
+    valid = jnp.asarray(rng.random((B, L)) < 0.9)
+    w_eff = jnp.asarray(rng.integers(1, W + 1, size=(B, L)).astype(np.int32))
+    return a, b, v, keep, valid, w_eff
+
+
+# (L, W, S): ragged chunks, exact multiples, minimum S = 2W
+GEOMS = [(12, 2, 4), (13, 2, 4), (16, 3, 6), (21, 1, 5), (9, 2, 8)]
+
+
+@pytest.mark.parametrize("L,W,S", GEOMS)
+def test_chunked_matches_dense(L, W, S):
+    a, b, v, keep, valid, w_eff = make_inputs(L, W)
+
+    m_d = banded.band_mask(keep, valid, w_eff, W, 0)
+    m_c = banded.band_mask(keep, valid, w_eff, W, S)
+    md_f = m_d.astype(F32)
+    mc_f = m_c.astype(F32)
+
+    # qk scores agree wherever the mask is on (chunked computes garbage-free
+    # zeros outside its slab, dense computes out-of-band logits — both masked)
+    qk_d = banded.band_qk(a, b, W, 0, F32) * md_f
+    qk_c = banded.band_qk(a, b, W, S, F32) * mc_f
+
+    # masked score planes must carry the same multiset of values: compare
+    # through every downstream reduction
+    np.testing.assert_allclose(
+        np.asarray(banded.band_row_sum(qk_d, L)),
+        np.asarray(banded.band_row_sum(qk_c, L)),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(banded.band_col_sum(qk_d, L, W, 0)),
+        np.asarray(banded.band_col_sum(qk_c, L, W, S)),
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(banded.band_loss_sum(qk_d)),
+        float(banded.band_loss_sum(qk_c)),
+        atol=1e-4,
+    )
+
+    # contractions against context values and center values
+    np.testing.assert_allclose(
+        np.asarray(banded.band_sv(qk_d, v, W, 0, F32)),
+        np.asarray(banded.band_sv(qk_c, v, W, S, F32)),
+        atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(banded.band_vs(qk_d, a, W, 0, F32)),
+        np.asarray(banded.band_vs(qk_c, a, W, S, F32)),
+        atol=1e-4,
+    )
+
+    # mask population counts agree
+    np.testing.assert_array_equal(
+        np.asarray(banded.band_row_sum(md_f, L)),
+        np.asarray(banded.band_row_sum(mc_f, L)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(banded.band_col_sum(md_f, L, W, 0)),
+        np.asarray(banded.band_col_sum(mc_f, L, W, S)),
+    )
+
+
+def test_resolve_chunk_rules():
+    # short rows stay dense
+    assert banded.resolve_chunk(64, 5) == 0
+    assert banded.resolve_chunk(118, 5) == 0
+    # long rows: slab sized to 128 lanes
+    assert banded.resolve_chunk(192, 5) == 118
+    assert banded.resolve_chunk(1024, 5) == 118
+    # explicit request honored, dense when >= L
+    assert banded.resolve_chunk(192, 5, requested=64) == 64
+    assert banded.resolve_chunk(192, 5, requested=192) == 0
+    assert banded.resolve_chunk(192, 5, requested=500) == 0
+    # S < 2W rejected (slab overlap-add invariant)
+    with pytest.raises(ValueError):
+        banded.resolve_chunk(192, 5, requested=9)
+    # very wide windows fall back to S = 2W
+    assert banded.resolve_chunk(1024, 60, 0) == 120
+
+
+def test_band_dist_static():
+    d = banded.band_dist(6, 2, 0)
+    assert d.shape == (6, 6) and d[0, 3] == 3
+    dc = banded.band_dist(6, 2, 3)
+    assert dc.shape == (3, 7)
+    # row s=1, slab col k=3 -> global j = k - W + c*S; dist |s + W - k|
+    assert dc[1, 3] == 0  # own position
+    assert dc[1, 5] == 2

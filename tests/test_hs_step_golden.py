@@ -202,6 +202,33 @@ def test_hs_vs_pair_agree(window, scatter_mean, model):
     )
 
 
+@pytest.mark.parametrize("scatter_mean", [False, True])
+def test_hs_cbow_chunked_band_matches_dense(scatter_mean):
+    """cbow+hs routes its context projection through ops/banded.py; the
+    window-blocked representation must match the dense one at full step."""
+    kw = dict(
+        window=2, subsample_threshold=0.01, word_dim=D, model="cbow",
+        train_method="hs", negative=0, scatter_mean=scatter_mean,
+        compute_dtype="float32",
+    )
+    tables, _ = make_tables()
+    rng = np.random.default_rng(23)
+    params_np = make_params(rng)
+    tokens = jnp.asarray(rng.integers(-1, V, size=(3, 19)).astype(np.int32))
+    outs = {}
+    for chunk in (0, 4):
+        cfg = Word2VecConfig(band_chunk=chunk, **kw)
+        step = jax.jit(make_train_step(cfg, tables))
+        params = {k: jnp.asarray(v) for k, v in params_np.items()}
+        new, _ = step(params, tokens, jax.random.key(29), jnp.float32(ALPHA))
+        outs[chunk] = new
+    for k in outs[0]:
+        np.testing.assert_allclose(
+            np.asarray(outs[0][k]), np.asarray(outs[4][k]),
+            atol=2e-5, err_msg=k,
+        )
+
+
 def test_hs_pad_only_batch_is_noop():
     cfg = Word2VecConfig(
         window=2, subsample_threshold=0.0, word_dim=D, model="sg",

@@ -71,6 +71,10 @@ class Word2VecConfig:
     # weights them by (its reference draw count) / shared_negatives, so the
     # expected update matches per-pair sampling (see ops/band_step.py).
     shared_negatives: int = 64
+    # Window-blocked band chunk size S (ops/banded.py): positive-side band
+    # contractions cost L*(S+2W) instead of L^2. 0 = auto (dense for short
+    # rows, 128-lane slabs for long); explicit S must be >= 2*window.
+    band_chunk: int = 0
 
     # Batched-update stabilizer. The reference's Hogwild updates are sequential:
     # after each update to a row, the next sigmoid sees the moved row, so
@@ -112,6 +116,13 @@ class Word2VecConfig:
             raise ValueError(f"kernel must be auto|band|pair, got {self.kernel!r}")
         if self.shared_negatives < 1:
             raise ValueError("shared_negatives must be >= 1")
+        if self.band_chunk < 0:
+            raise ValueError("band_chunk must be >= 0 (0 = auto)")
+        if self.band_chunk and self.band_chunk < 2 * self.window:
+            raise ValueError(
+                f"band_chunk={self.band_chunk} < 2*window={2 * self.window} "
+                "(slab overlap-add requires S >= 2W; see ops/banded.py)"
+            )
 
     @property
     def resolved_kernel(self) -> str:

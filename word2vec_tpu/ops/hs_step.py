@@ -43,6 +43,7 @@ import jax.numpy as jnp
 
 from ..config import Word2VecConfig
 from ..models.params import Params
+from . import banded
 from .tables import DeviceTables
 from .train_step import _dup_mean_scale
 
@@ -167,23 +168,15 @@ def make_hs_train_step(
             )
         else:
             # ---- CBOW: h = (mean of) context rows; targets = center's path.
-            i_idx = jnp.arange(L, dtype=jnp.int32)
-            dist = jnp.abs(i_idx[:, None] - i_idx[None, :])
-            band = (
-                keep[:, :, None]
-                & valid[:, None, :]
-                & (dist[None] <= w_eff[:, :, None])
-                & (dist[None] > 0)
+            # Band contractions use the window-blocked representation
+            # (ops/banded.py) — cost L*(S+2W), not L^2.
+            S = banded.resolve_chunk(L, W, config.band_chunk)
+            band_f = banded.band_mask(keep, valid, w_eff, W, S).astype(
+                jnp.float32
             )
-            band_f = band.astype(jnp.float32)  # [B, L, L]
-            n_ctx = band_f.sum(axis=2)
+            n_ctx = banded.band_row_sum(band_f, L)
             ein = emb_in[tok]  # [B, L, d]
-            h = jnp.einsum(
-                "bij,bjd->bid",
-                band_f.astype(cdt),
-                ein.astype(cdt),
-                preferred_element_type=jnp.float32,
-            )
+            h = banded.band_sv(band_f, ein, W, S, cdt)
             if cbow_mean:
                 h = h / jnp.maximum(n_ctx, 1.0)[:, :, None]
 
@@ -225,19 +218,14 @@ def make_hs_train_step(
             # fan d_h to context rows (second /n under cbow_mean, :313-315)
             if cbow_mean:
                 d_h = d_h / jnp.maximum(n_ctx, 1.0)[:, :, None]
-            d_in_pos = jnp.einsum(
-                "bij,bid->bjd",
-                band_f.astype(cdt),
-                d_h.astype(cdt),
-                preferred_element_type=jnp.float32,
-            )
+            d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
             flat_c = tok.reshape(-1)
             order = jnp.argsort(flat_c)
             d_in_flat = d_in_pos.reshape(-1, d_in_pos.shape[-1])[order]
             if scatter_mean:
                 d_in_flat = d_in_flat * _dup_mean_scale(
                     emb_in.shape[0], flat_c[order],
-                    band_f.sum(axis=1).reshape(-1)[order],
+                    banded.band_col_sum(band_f, L, W, S).reshape(-1)[order],
                 )[:, None]
             new_in = emb_in.at[flat_c[order]].add(
                 d_in_flat.astype(emb_in.dtype), indices_are_sorted=True

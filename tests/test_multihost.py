@@ -1,0 +1,82 @@
+"""Multi-host wiring logic (parallel/multihost.py, SURVEY §5 "distributed
+communication backend").
+
+Real multi-process execution needs multiple hosts; what CAN be pinned here:
+the env contract, the DCN x ICI mesh factoring policy (only the data axis
+spans slices), and the local-replica assembly used by multi-host export —
+the latter runs identically on the single-process 8-virtual-device mesh
+(tests/conftest.py)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from word2vec_tpu.parallel import multihost
+from word2vec_tpu.parallel.mesh import make_mesh
+from word2vec_tpu.parallel.trainer import (
+    PARAM_SPEC,
+    assemble_local_replica,
+    replicate_params,
+)
+
+
+def test_dist_config_from_env():
+    env = {
+        "W2V_COORDINATOR": "10.0.0.1:8476",
+        "W2V_NUM_PROCS": "4",
+        "W2V_PROC_ID": "2",
+    }
+    cfg = multihost.DistConfig.from_env(env)
+    assert cfg == multihost.DistConfig("10.0.0.1:8476", 4, 2)
+    # absent or single-process -> None (single-process path untouched)
+    assert multihost.DistConfig.from_env({}) is None
+    assert (
+        multihost.DistConfig.from_env(
+            {"W2V_COORDINATOR": "h:1", "W2V_NUM_PROCS": "1"}
+        )
+        is None
+    )
+    # missing rank with the rest configured: hard error, NOT a silent rank 0
+    # (two hosts both claiming rank 0 hang the coordinator undiagnosably)
+    with pytest.raises(ValueError, match="W2V_PROC_ID"):
+        multihost.DistConfig.from_env(
+            {"W2V_COORDINATOR": "h:1", "W2V_NUM_PROCS": "2"}
+        )
+
+
+def test_initialize_noop_without_env():
+    assert multihost.initialize_from_env({}) is False
+
+
+def test_hybrid_axes_policy():
+    # dp factors across slices; sp/tp stay in the ICI shape
+    assert multihost.hybrid_axes(8, 2, 4, 2) == ((2, 1, 1), (4, 2, 4))
+    assert multihost.hybrid_axes(4, 1, 1, 4) == ((4, 1, 1), (1, 1, 1))
+    # dp not divisible by slice count is a hard error, not a silent remap
+    with pytest.raises(ValueError, match="divisible"):
+        multihost.hybrid_axes(3, 1, 1, 2)
+    with pytest.raises(ValueError, match="num_slices"):
+        multihost.hybrid_axes(4, 1, 1, 0)
+
+
+def test_make_global_mesh_single_process_fallback():
+    mesh = multihost.make_global_mesh(2, 2, sp=2)
+    assert mesh.shape == {"data": 2, "seq": 2, "model": 2}
+
+
+def test_assemble_local_replica_matches_unreplicated():
+    """On the virtual 8-device mesh every shard is addressable, so the
+    multi-host export path must reproduce the plain v[0] export exactly —
+    including re-concatenating the model-axis dim slices."""
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    rng = np.random.default_rng(3)
+    table = rng.normal(size=(10, 8)).astype(np.float32)
+    params = replicate_params({"emb_in": table}, mesh)
+    out = assemble_local_replica(params["emb_in"])
+    np.testing.assert_array_equal(out, table)
+
+
+def test_global_agree_single_process_identity():
+    assert multihost.global_agree_min(7) == 7
+    assert multihost.global_agree_sum(7) == 7

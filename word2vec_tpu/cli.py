@@ -69,6 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-parallel mesh axis (halo-exchange context "
                         "parallelism for long rows; band kernel only)")
     p.add_argument("--dp-sync-every", type=int, default=64)
+    p.add_argument("--multihost", action="store_true",
+                   help="multi-process mode: jax.distributed.initialize from "
+                        "the W2V_COORDINATOR/W2V_NUM_PROCS/W2V_PROC_ID env "
+                        "contract, mesh over the global device set with the "
+                        "data axis spanning slices/DCN (parallel/multihost.py);"
+                        " pass each process its own corpus shard via -train")
     p.add_argument("--batch-rows", type=int, default=0,
                    help="sentence rows per device step; 0 = auto-size so an "
                         "epoch has enough optimizer steps to learn (see "
@@ -116,6 +122,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.print_help()  # reference: help on no args (main.cpp:99-103)
         return 0
     args = parser.parse_args(argv)
+
+    if args.multihost:
+        # must run before any backend use on every host
+        from .parallel.multihost import initialize_from_env
+
+        if not initialize_from_env() and not args.quiet:
+            print(
+                "warning: --multihost set but W2V_COORDINATOR/W2V_NUM_PROCS "
+                "not configured; continuing single-process",
+                file=sys.stderr,
+            )
 
     if args.backend == "cpu":
         import jax
@@ -174,6 +191,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .data.corpus import load_corpus
     from .train import TrainState
 
+    # In multi-host mode only process 0 writes shared artifacts (vectors,
+    # vocab, checkpoints): every process reaching the save paths with the
+    # same -output on a shared filesystem would interleave writes.
+    is_primary = jax.process_index() == 0
+
     # Resume: the checkpoint's config and vocab are authoritative — resuming
     # against a rebuilt vocab would silently re-attribute embedding rows.
     state = None
@@ -211,14 +233,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"vocab: {len(vocab)} words, {vocab.total_words} total "
               f"({time.perf_counter() - t0:.1f}s, {impl} data layer)")
     corpus = PackedCorpus.from_flat(flat, cfg.max_sentence_len)
-    if args.save_vocab:
+    if args.save_vocab and is_primary:
         vocab.save(args.save_vocab)  # Word2Vec.cpp:171-177
 
     if args.batch_rows == 0 and not args.resume:
         import dataclasses as _dc
 
+        # multi-host: size from the GLOBAL token count (sum over shards) so
+        # every process derives the same batch_rows and global array shapes
+        auto_tokens = corpus.num_tokens
+        if jax.process_count() > 1:
+            from .parallel.multihost import global_agree_sum
+
+            auto_tokens = global_agree_sum(auto_tokens)
         auto = Word2VecConfig.auto_batch_rows(
-            corpus.num_tokens, cfg.max_sentence_len, dp=args.dp
+            auto_tokens, cfg.max_sentence_len, dp=args.dp
         )
         cfg = _dc.replace(cfg, batch_rows=auto)
         if not args.quiet:
@@ -227,13 +256,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             print(f"batch-rows auto: {auto} (~{steps} steps/epoch)")
 
+    if args.multihost and jax.process_count() > 1 and args.dp * args.tp * args.sp <= 1:
+        print(
+            "error: --multihost with a 1-device mesh: every process would "
+            "train a redundant full model; set --dp (and optionally "
+            "--tp/--sp) to span the global device set",
+            file=sys.stderr,
+        )
+        return 1
+
     log_fn = None if args.quiet else progress_logger()
     if args.dp * args.tp * args.sp > 1:
         from .parallel import ShardedTrainer
 
+        mesh = None
+        if args.multihost:
+            from .parallel.multihost import make_global_mesh
+
+            mesh = make_global_mesh(args.dp, args.tp, args.sp)
         trainer = ShardedTrainer(
             cfg, vocab, corpus, dp=args.dp, tp=args.tp, sp=args.sp,
-            log_fn=log_fn,
+            mesh=mesh, log_fn=log_fn,
         )
     else:
         trainer = Trainer(cfg, vocab, corpus, log_fn=log_fn)
@@ -253,7 +296,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ckpt_cb = None
     if args.checkpoint_dir and args.checkpoint_every:
         def ckpt_cb(s):
-            save_checkpoint(args.checkpoint_dir, unreplicated(s), cfg, vocab)
+            # export_params is collective-free (local shards only), so
+            # non-primary processes can skip the whole callback safely
+            if is_primary:
+                save_checkpoint(args.checkpoint_dir, unreplicated(s), cfg, vocab)
 
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
@@ -275,7 +321,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"({report.words_per_sec:,.0f} words/sec), final loss "
               f"{report.final_loss:.4f}")
 
-    if args.checkpoint_dir:
+    if args.checkpoint_dir and is_primary:
         save_checkpoint(args.checkpoint_dir, unreplicated(state), cfg, vocab)
 
     # matrix choice per main.cpp:196-202
@@ -284,7 +330,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         params = {k: v for k, v in state.params.items()}
     matrix = export_matrix(params, cfg)
-    if args.output:
+    if args.output and is_primary:
         save_word2vec(
             args.output, vocab, matrix,
             binary=bool(args.binary), layout=args.binary_layout,

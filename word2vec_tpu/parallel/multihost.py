@@ -1,0 +1,168 @@
+"""Multi-host (multi-process) distributed wiring.
+
+The reference has no cross-node story at all — its parallelism ends at
+shared-memory OpenMP threads (main.cpp:186, Word2Vec.cpp:375). SURVEY §5
+names the TPU-native replacement as a first-class deliverable:
+`jax.distributed` + a mesh over the GLOBAL device set, with the data axis
+laid out so replica sync rides ICI within a slice and crosses DCN only
+between slices.
+
+Topology policy (the "How to Scale Your Model" recipe):
+  - the `model` (tensor) axis and the `seq` (halo-exchange) axis carry
+    per-step traffic — they must stay INSIDE a slice, on ICI;
+  - the `data` axis carries traffic only every dp_sync_every steps (the
+    pmean replica average, parallel/trainer.py), so it is the only axis
+    allowed to span slices/DCN. `hybrid_axes` therefore factors dp into
+    (dcn_dp = num_slices) x (ici_dp = dp / num_slices) and keeps sp, tp
+    entirely in the ICI factor.
+
+Single-process behavior is unchanged: `initialize_from_env` is a no-op
+without coordinator configuration, and `make_global_mesh` falls back to
+parallel.mesh.make_mesh over the local devices.
+
+This environment has one host, so the multi-process branches cannot be
+executed here; the factoring logic is unit-tested (tests/test_multihost.py)
+and the single-process path is exercised by the whole parallel test suite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+import jax
+
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh
+
+# Environment contract (set by the launcher on every host):
+#   W2V_COORDINATOR  host:port of process 0           (e.g. "10.0.0.1:8476")
+#   W2V_NUM_PROCS    total process count
+#   W2V_PROC_ID      this process's rank in [0, num_procs)
+ENV_COORDINATOR = "W2V_COORDINATOR"
+ENV_NUM_PROCS = "W2V_NUM_PROCS"
+ENV_PROC_ID = "W2V_PROC_ID"
+
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DistConfig:
+    coordinator: str
+    num_processes: int
+    process_id: int
+
+    @classmethod
+    def from_env(cls, env=os.environ) -> Optional["DistConfig"]:
+        """None unless configured for > 1 process; a missing W2V_PROC_ID with
+        the rest configured is a hard error (defaulting it to 0 would give
+        two hosts rank 0 and hang the coordinator with no useful message)."""
+        coord = env.get(ENV_COORDINATOR)
+        if not coord:
+            return None
+        n = int(env.get(ENV_NUM_PROCS, "1"))
+        if n <= 1:
+            return None
+        pid = env.get(ENV_PROC_ID)
+        if pid is None:
+            raise ValueError(
+                f"{ENV_COORDINATOR}/{ENV_NUM_PROCS} are set but "
+                f"{ENV_PROC_ID} is not; every host must export its rank"
+            )
+        return cls(coord, n, int(pid))
+
+
+def initialize_from_env(env=os.environ) -> bool:
+    """Call jax.distributed.initialize from the W2V_* environment contract.
+
+    Must run before the first backend use on every host. Returns True when
+    distributed mode is active (now or from an earlier call), False for
+    single-process. Idempotent.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    cfg = DistConfig.from_env(env)
+    if cfg is None:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _initialized = True
+    return True
+
+
+def hybrid_axes(
+    dp: int, sp: int, tp: int, num_slices: int
+) -> tuple[tuple[int, int, int], tuple[int, int, int]]:
+    """Factor the (data, seq, model) mesh into DCN x ICI shapes.
+
+    Only the data axis may span slices (it syncs every dp_sync_every steps;
+    seq/model traffic is per-step and must stay on ICI). Returns
+    (dcn_shape, ici_shape), each (data, seq, model)-ordered, with
+    dcn = (num_slices, 1, 1) and ici = (dp/num_slices, sp, tp).
+    """
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    if dp % num_slices != 0:
+        raise ValueError(
+            f"data-parallel width {dp} must be divisible by the slice count "
+            f"{num_slices}: the data axis is the only one allowed to span "
+            f"DCN, so each slice carries dp/num_slices replicas"
+        )
+    return (num_slices, 1, 1), (dp // num_slices, sp, tp)
+
+
+def make_global_mesh(
+    dp: int, tp: int, sp: int = 1, num_slices: Optional[int] = None
+) -> jax.sharding.Mesh:
+    """A (data, seq, model) mesh over the global device set.
+
+    Single-process: identical to parallel.mesh.make_mesh. Multi-process:
+    builds a hybrid DCN x ICI device grid via mesh_utils so that mesh
+    coordinates map to the physical topology per the policy above.
+    `num_slices` defaults to jax.process_count() (one slice per host).
+    """
+    if jax.process_count() == 1:
+        return make_mesh(dp, tp, sp)
+    from jax.experimental import mesh_utils
+
+    slices = jax.process_count() if num_slices is None else num_slices
+    dcn, ici = hybrid_axes(dp, sp, tp, slices)
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=ici, dcn_mesh_shape=dcn
+    )
+    return jax.sharding.Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+
+
+def global_agree_min(value: int) -> int:
+    """The minimum of a per-process integer across all processes.
+
+    Used to agree on a common number of global steps per epoch: processes
+    feed their own corpus shards, and unequal shard sizes would otherwise
+    make one host run a collective step the others never join (a hang, not
+    an error). Single-process: identity.
+    """
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    gathered = multihost_utils.process_allgather(np.int64(value))
+    return int(np.min(gathered))
+
+
+def global_agree_sum(value: int) -> int:
+    """Sum of a per-process integer across all processes (e.g. total corpus
+    tokens for the batch-size auto-tuner). Single-process: identity."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    import numpy as np
+
+    gathered = multihost_utils.process_allgather(np.int64(value))
+    return int(np.sum(gathered))

@@ -75,6 +75,26 @@ def unreplicate_params(params: Params) -> Params:
     return {k: v[0] for k, v in params.items()}
 
 
+def assemble_local_replica(v: jax.Array) -> np.ndarray:
+    """One full [V, d] table from this process's addressable shards.
+
+    After a sync every replica (leading axis) is identical, so any one will
+    do — but in multi-host mode replica 0 may live on another host, and the
+    model-axis dim slices of a replica must be re-concatenated. The hybrid
+    mesh keeps the model axis inside a slice (parallel/multihost.py), so
+    every process holds at least one complete replica's worth of dim shards.
+    Works identically (and is tested) on a single-process virtual mesh.
+    """
+    shards = v.addressable_shards
+    rep = shards[0].index[0]  # leading-axis slice of some locally-held replica
+    parts = {}
+    for s in shards:
+        if s.index[0] == rep:
+            d0 = s.index[2].start or 0
+            parts[d0] = np.asarray(s.data)[0]
+    return np.concatenate([parts[k] for k in sorted(parts)], axis=1)
+
+
 def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
     """Jitted global-array step over the mesh (donates params)."""
     dp = mesh.shape[DATA_AXIS]
@@ -182,7 +202,15 @@ class ShardedTrainer(Trainer):
                 "default sum semantics with sequence parallelism"
             )
         self.token_sharding = NamedSharding(self.mesh, TOKEN_SPEC)
+        self.procs = jax.process_count()
+        if self.procs > 1 and self.dp % self.procs != 0:
+            raise ValueError(
+                f"multi-host: data-parallel width {self.dp} must be divisible "
+                f"by the process count {self.procs} (each process feeds "
+                f"dp/procs replicas; parallel/multihost.py)"
+            )
         self._last_sync_step: Optional[int] = None
+        self._epoch_steps: Optional[int] = None
         super().__init__(config, vocab, corpus, log_fn=log_fn)
 
     # ---------------------------------------------------------------- hooks
@@ -196,23 +224,60 @@ class ShardedTrainer(Trainer):
         )
 
     def _batches(self, batcher: BatchIterator) -> Iterator[Tuple[jnp.ndarray, int]]:
-        """Group dp consecutive [B, L] batches into one sharded [DP*B, L]
-        (the seq axis splits L at placement; no host-side reshaping)."""
+        """Group consecutive [B, L] batches into one sharded [DP*B, L]
+        (the seq axis splits L at placement; no host-side reshaping).
+
+        Single-process: this host supplies all dp row blocks. Multi-process:
+        the corpus handed to this trainer is this process's shard, the
+        batcher supplies dp/procs row blocks per global step, and
+        make_array_from_process_local_data assembles the global array (data
+        shard order follows process order, parallel/multihost.py). The word
+        count is per-process; the alpha schedule stays consistent across
+        hosts when corpus shards are of similar size.
+        """
+        local_dp = self.dp // self.procs
+        limit = self._agreed_steps_per_epoch(batcher, local_dp)
+        emitted = 0
         buf, words = [], 0
         for tokens, w in batcher.epoch():
             buf.append(tokens)
             words += w
-            if len(buf) == self.dp:
-                yield jax.device_put(
-                    np.concatenate(buf, axis=0), self.token_sharding
-                ), words
+            if len(buf) == local_dp:
+                if emitted >= limit:
+                    break  # larger shard: drop the excess this epoch
+                yield self._place(np.concatenate(buf, axis=0)), words
+                emitted += 1
                 buf, words = [], 0
-        if buf:
+        if buf and emitted < limit:
             # pad the trailing global batch with empty rows
-            pad = [np.full_like(buf[0], -1)] * (self.dp - len(buf))
-            yield jax.device_put(
-                np.concatenate(buf + pad, axis=0), self.token_sharding
-            ), words
+            pad = [np.full_like(buf[0], -1)] * (local_dp - len(buf))
+            yield self._place(np.concatenate(buf + pad, axis=0)), words
+
+    def _agreed_steps_per_epoch(self, batcher: BatchIterator, local_dp: int) -> int:
+        """Global steps per epoch every process will run.
+
+        Each process feeds its own corpus shard; the shard_map step is a
+        collective, so all processes must issue the SAME number of steps —
+        a host whose shard packs one extra batch would otherwise enter a
+        collective alone and hang the job. Agreed once (cached), as the
+        cross-process min of local capacity.
+        """
+        if self._epoch_steps is None:
+            local = -(-batcher.steps_per_epoch() // local_dp)  # ceil
+            if self.procs == 1:
+                self._epoch_steps = local
+            else:
+                from .multihost import global_agree_min
+
+                self._epoch_steps = global_agree_min(local)
+        return self._epoch_steps
+
+    def _place(self, local_rows: np.ndarray) -> jnp.ndarray:
+        if self.procs == 1:
+            return jax.device_put(local_rows, self.token_sharding)
+        return jax.make_array_from_process_local_data(
+            self.token_sharding, local_rows
+        )
 
     def _post_step(self, state: TrainState) -> None:
         cfg = self.config
@@ -231,7 +296,12 @@ class ShardedTrainer(Trainer):
         if self.dp * self.sp > 1 and self._last_sync_step != state.step:
             state.params = self.sync_fn(state.params)
             self._last_sync_step = state.step
-        return {k: np.asarray(v[0]) for k, v in state.params.items()}
+        if self.procs == 1:
+            return {k: np.asarray(v[0]) for k, v in state.params.items()}
+        # multi-host: replica 0 may be remote; assemble from local shards
+        return {
+            k: assemble_local_replica(v) for k, v in state.params.items()
+        }
 
     def import_params(self, params: Params, state: TrainState) -> None:
         """Load unreplicated [V, d] tables (e.g. from a checkpoint) into the

@@ -75,6 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "contract, mesh over the global device set with the "
                         "data axis spanning slices/DCN (parallel/multihost.py);"
                         " pass each process its own corpus shard via -train")
+    p.add_argument("--micro-steps", type=int, default=0,
+                   help="sequential optimizer sub-steps per dispatched batch "
+                        "(0 = auto with --batch-rows 0, else 1); decouples "
+                        "convergence from dispatch size (config.auto_geometry)")
     p.add_argument("--batch-rows", type=int, default=0,
                    help="sentence rows per device step; 0 = auto-size so an "
                         "epoch has enough optimizer steps to learn (see "
@@ -171,6 +175,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             train_method=args.train_method,
             model=args.model,
             batch_rows=args.batch_rows or 32,  # placeholder; auto-sized below
+            # with auto batch sizing the real (rows, micro) pair is set
+            # below; constructing with micro here would trip the
+            # divisibility check against the placeholder
+            micro_steps=max(1, args.micro_steps) if args.batch_rows else 1,
             max_sentence_len=args.max_sentence_len,
             seed=args.seed,
             dp_sync_every=args.dp_sync_every,
@@ -246,15 +254,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             from .parallel.multihost import global_agree_sum
 
             auto_tokens = global_agree_sum(auto_tokens)
-        auto = Word2VecConfig.auto_batch_rows(
+        auto_rows, auto_micro = Word2VecConfig.auto_geometry(
             auto_tokens, cfg.max_sentence_len, dp=args.dp
         )
-        cfg = _dc.replace(cfg, batch_rows=auto)
+        if args.micro_steps:  # explicit micro with auto rows: keep divisible
+            auto_micro = args.micro_steps
+            auto_rows = max(1, auto_rows // auto_micro) * auto_micro
+        cfg = _dc.replace(cfg, batch_rows=auto_rows, micro_steps=auto_micro)
         if not args.quiet:
             steps = max(
-                1, corpus.num_tokens // (auto * cfg.max_sentence_len * args.dp)
+                1,
+                auto_tokens
+                * auto_micro
+                // (auto_rows * cfg.max_sentence_len * args.dp),
             )
-            print(f"batch-rows auto: {auto} (~{steps} steps/epoch)")
+            print(
+                f"batch geometry auto: {auto_rows} rows x {auto_micro} "
+                f"micro-steps (~{steps} optimizer steps/epoch)"
+            )
 
     if args.multihost and jax.process_count() > 1 and args.dp * args.tp * args.sp <= 1:
         print(
@@ -296,10 +313,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ckpt_cb = None
     if args.checkpoint_dir and args.checkpoint_every:
         def ckpt_cb(s):
-            # export_params is collective-free (local shards only), so
-            # non-primary processes can skip the whole callback safely
+            # unreplicated() may run the pmean sync — a collective — so ALL
+            # processes must enter it; only the file write is primary-gated
+            snap = unreplicated(s)
             if is_primary:
-                save_checkpoint(args.checkpoint_dir, unreplicated(s), cfg, vocab)
+                save_checkpoint(args.checkpoint_dir, snap, cfg, vocab)
 
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
@@ -316,13 +334,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             checkpoint_cb=ckpt_cb,
             checkpoint_every=args.checkpoint_every,
         )
-    if not args.quiet:
+    if not args.quiet and is_primary:
         print(f"\ntrained {report.total_words} words in {report.wall_time:.1f}s "
               f"({report.words_per_sec:,.0f} words/sec), final loss "
               f"{report.final_loss:.4f}")
 
-    if args.checkpoint_dir and is_primary:
-        save_checkpoint(args.checkpoint_dir, unreplicated(state), cfg, vocab)
+    if args.checkpoint_dir:
+        snap = unreplicated(state)  # collective-capable: all processes enter
+        if is_primary:
+            save_checkpoint(args.checkpoint_dir, snap, cfg, vocab)
 
     # matrix choice per main.cpp:196-202
     if hasattr(trainer, "export_params"):
@@ -339,7 +359,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"saved {'binary' if args.binary else 'text'} vectors to "
                   f"{args.output}")
 
-    if args.eval_ws353 or args.eval_analogy:
+    if (args.eval_ws353 or args.eval_analogy) and is_primary:
         from .eval.similarity import evaluate_ws353
         from .eval.analogy import evaluate_analogies
 

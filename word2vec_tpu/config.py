@@ -93,6 +93,15 @@ class Word2VecConfig:
     # hot-row workloads.
     scatter_mean: bool = False
 
+    # Sequential optimizer sub-steps per dispatched batch (ops/train_step.py
+    # micro wrapper): the [B, L] batch is split into micro_steps row blocks
+    # applied one after another inside the jit step, updates visible between
+    # blocks. Convergence then depends on B / micro_steps (the effective
+    # optimizer batch), not on the dispatch size — small corpora keep big,
+    # device-efficient dispatches without starving the ~70-steps/epoch
+    # threshold (auto_geometry below). batch_rows must divide evenly.
+    micro_steps: int = 1
+
     # --- multi-chip (no reference counterpart; replaces OpenMP Hogwild) ---
     # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
     dp_sync_every: int = 64
@@ -123,6 +132,13 @@ class Word2VecConfig:
                 f"band_chunk={self.band_chunk} < 2*window={2 * self.window} "
                 "(slab overlap-add requires S >= 2W; see ops/banded.py)"
             )
+        if self.micro_steps < 1:
+            raise ValueError("micro_steps must be >= 1")
+        if self.batch_rows % self.micro_steps != 0:
+            raise ValueError(
+                f"batch_rows {self.batch_rows} must be divisible by "
+                f"micro_steps {self.micro_steps}"
+            )
 
     @property
     def resolved_kernel(self) -> str:
@@ -133,21 +149,39 @@ class Word2VecConfig:
         return "band"
 
     @staticmethod
+    def auto_geometry(
+        corpus_tokens: int,
+        max_sentence_len: int = 192,
+        dp: int = 1,
+        cap: int = 256,
+        max_micro: int = 64,
+    ) -> Tuple[int, int]:
+        """(batch_rows, micro_steps) giving ~100 OPTIMIZER steps per epoch
+        with the largest device-efficient dispatch.
+
+        Batched-sum updates (scatter_mean notes above) need enough optimizer
+        steps per epoch to converge — measured threshold ~70 on the parity
+        corpus (benchmarks/parity.py). The micro-step wrapper
+        (ops/train_step.py) makes the optimizer batch batch_rows/micro_steps
+        while the dispatch stays batch_rows, so small corpora no longer
+        force tiny dispatches: the optimizer block is sized for ~100
+        steps/epoch and up to max_micro of them are packed per dispatch
+        (bounded by cap rows). `dp` is the data-parallel width: replicas
+        consume dp dispatches per global step.
+        """
+        block = max(1, min(cap, corpus_tokens // (100 * max_sentence_len * dp)))
+        micro = max(1, min(max_micro, cap // block))
+        return block * micro, micro
+
+    @staticmethod
     def auto_batch_rows(
         corpus_tokens: int,
         max_sentence_len: int = 192,
         dp: int = 1,
         cap: int = 256,
     ) -> int:
-        """Batch rows giving ~100 optimizer steps per epoch.
-
-        Batched-sum updates (scatter_mean notes above) need enough steps per
-        epoch to converge — measured threshold ~70 on the parity corpus
-        (benchmarks/parity.py). `dp` is the data-parallel width: replicas
-        consume dp batches per global step, so the per-replica batch shrinks
-        accordingly. Capped at `cap` rows for device efficiency on corpora
-        big enough not to care.
-        """
+        """The optimizer-block rows of auto_geometry (micro_steps = 1 view);
+        kept for callers that size without the micro-step wrapper."""
         return max(1, min(cap, corpus_tokens // (100 * max_sentence_len * dp)))
 
     @property

@@ -131,7 +131,49 @@ def make_train_step(
     (ops/band_step.py) or positional hs (ops/hs_step.py); "pair" is the
     reference-faithful enumeration below. sp_axis (sequence/context
     parallelism via halo exchange) is implemented by the ns band kernel only.
+
+    With config.micro_steps = k > 1 the step is wrapped in a sequential
+    lax.fori_loop over k row sub-blocks of the dispatched batch: updates
+    apply BETWEEN sub-blocks, so convergence behaves like k-times-smaller
+    batches (the batched-sum staleness window shrinks k-fold) while the host
+    still dispatches — and XLA still compiles — one fused program. This is
+    what decouples device batch geometry from the ~70-optimizer-steps/epoch
+    convergence threshold (config.auto_geometry).
     """
+    base = _make_base_step(config, tables, tp_axis, dp_axis, sp_axis)
+    k = config.micro_steps
+    if k <= 1:
+        return base
+
+    def micro(params, tokens, key, alpha):
+        B, L = tokens.shape
+        if B % k != 0:
+            raise ValueError(
+                f"batch_rows {B} not divisible by micro_steps {k}"
+            )
+        sub = tokens.reshape(k, B // k, L)
+
+        def body(i, carry):
+            p, loss, pairs = carry
+            ki = jax.random.fold_in(key, i)
+            p, m = base(p, sub[i], ki, alpha)
+            return p, loss + m["loss_sum"], pairs + m["pairs"]
+
+        params, loss, pairs = jax.lax.fori_loop(
+            0, k, body, (params, jnp.float32(0.0), jnp.float32(0.0))
+        )
+        return params, {"loss_sum": loss, "pairs": pairs}
+
+    return micro
+
+
+def _make_base_step(
+    config: Word2VecConfig,
+    tables: DeviceTables,
+    tp_axis: str | None = None,
+    dp_axis: str | None = None,
+    sp_axis: str | None = None,
+):
     if config.resolved_kernel == "band":
         if config.use_hs:
             if sp_axis is not None:

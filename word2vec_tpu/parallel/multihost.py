@@ -137,6 +137,15 @@ def make_global_mesh(
     return jax.sharding.Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
+def _global_agree(value: int, reduce_fn) -> int:
+    if jax.process_count() == 1:
+        return value
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    return int(reduce_fn(multihost_utils.process_allgather(np.int64(value))))
+
+
 def global_agree_min(value: int) -> int:
     """The minimum of a per-process integer across all processes.
 
@@ -145,24 +154,14 @@ def global_agree_min(value: int) -> int:
     make one host run a collective step the others never join (a hang, not
     an error). Single-process: identity.
     """
-    if jax.process_count() == 1:
-        return value
-    from jax.experimental import multihost_utils
-
     import numpy as np
 
-    gathered = multihost_utils.process_allgather(np.int64(value))
-    return int(np.min(gathered))
+    return _global_agree(value, np.min)
 
 
 def global_agree_sum(value: int) -> int:
     """Sum of a per-process integer across all processes (e.g. total corpus
     tokens for the batch-size auto-tuner). Single-process: identity."""
-    if jax.process_count() == 1:
-        return value
-    from jax.experimental import multihost_utils
-
     import numpy as np
 
-    gathered = multihost_utils.process_allgather(np.int64(value))
-    return int(np.sum(gathered))
+    return _global_agree(value, np.sum)

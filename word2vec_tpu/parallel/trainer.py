@@ -281,7 +281,12 @@ class ShardedTrainer(Trainer):
 
     def _post_step(self, state: TrainState) -> None:
         cfg = self.config
-        if self.dp * self.sp > 1 and cfg.dp_sync_every and state.step % cfg.dp_sync_every == 0:
+        # dp_sync_every is calibrated in OPTIMIZER steps; with micro-stepping
+        # one dispatch carries micro_steps of them, so the dispatch cadence
+        # shrinks accordingly (else small-corpus auto geometry would stretch
+        # the replica-averaging window by up to 64x)
+        every = max(1, cfg.dp_sync_every // cfg.micro_steps)
+        if self.dp * self.sp > 1 and cfg.dp_sync_every and state.step % every == 0:
             state.params = self.sync_fn(state.params)
             self._last_sync_step = state.step
 

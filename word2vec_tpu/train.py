@@ -79,16 +79,19 @@ class Trainer:
 
         cfg = self.config
         tokens_per_step = cfg.batch_rows * cfg.max_sentence_len
-        steps_per_epoch = max(1, self.total_words // max(1, tokens_per_step))
+        steps_per_epoch = max(
+            1, self.total_words * cfg.micro_steps // max(1, tokens_per_step)
+        )
         if self.total_words and steps_per_epoch < 70:
-            suggested = cfg.auto_batch_rows(self.total_words, cfg.max_sentence_len)
+            rows, micro = cfg.auto_geometry(self.total_words, cfg.max_sentence_len)
             warnings.warn(
                 f"batch geometry ({cfg.batch_rows} rows x "
-                f"{cfg.max_sentence_len}) gives only ~{steps_per_epoch} "
-                f"optimizer steps/epoch on this {self.total_words}-token "
-                f"corpus — batched updates may not converge (threshold ~70; "
-                f"benchmarks/parity.py). Suggested batch_rows: "
-                f"Word2VecConfig.auto_batch_rows(...) = {suggested}.",
+                f"{cfg.max_sentence_len} x {cfg.micro_steps} micro-steps) "
+                f"gives only ~{steps_per_epoch} optimizer steps/epoch on "
+                f"this {self.total_words}-token corpus — batched updates may "
+                f"not converge (threshold ~70; benchmarks/parity.py). "
+                f"Suggested: Word2VecConfig.auto_geometry(...) = "
+                f"(batch_rows={rows}, micro_steps={micro}).",
                 stacklevel=3,
             )
 

@@ -90,7 +90,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     from word2vec_tpu.data.vocab import Vocab
     from word2vec_tpu.models.params import init_params
     from word2vec_tpu.ops.tables import DeviceTables
-    from word2vec_tpu.ops.train_step import jit_train_step
+    from word2vec_tpu.ops.train_step import jit_chunk_runner
     from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
 
     cfg = Word2VecConfig(
@@ -119,36 +119,44 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         corpus_name = f"zipf-synthetic-{args.tokens // 1_000_000}M"
 
     tables = DeviceTables.build(vocab, cfg)
-    step = jit_train_step(cfg, tables)
     params = init_params(cfg, len(vocab), jax.random.key(0))
     batcher = BatchIterator(corpus, cfg.batch_rows, cfg.max_sentence_len, seed=1)
-    alpha = jnp.float32(cfg.init_alpha)
     base_key = jax.random.key(7)
 
-    # warmup / compile
-    it = batcher.epoch()
-    for _ in range(args.warmup_steps):
-        tokens, _ = next(it)
-        params, m = step(params, jnp.asarray(tokens), base_key, alpha)
+    # Chunked dispatch (ops/train_step.make_chunk_runner): S optimizer steps
+    # per device program, so per-dispatch overhead — which through the remote
+    # tunnel costs ~4-5x the 8 ms device step — amortizes to noise. The
+    # trajectory is identical to per-step dispatch (tests/test_chunk_runner.py).
+    S, _ = cfg.chunk_geometry(batcher.steps_per_epoch(), cap=args.chunk_cap)
+    chunk_fn = jit_chunk_runner(cfg, tables)
+    alphas = jnp.full((S,), cfg.init_alpha, jnp.float32)
+
+    from word2vec_tpu.data.batcher import chunk_batches
+
+    # warmup / compile on a throwaway chunk
+    warm = next(chunk_batches(batcher.epoch(), S))
+    params, m = chunk_fn(params, jnp.asarray(warm[0]), base_key, 0, alphas)
     jax.block_until_ready(params)
 
-    # timed steady-state; pairs accumulate on device (no per-step sync)
+    # timed steady-state over one full epoch; metrics stay on device until
+    # the end (no per-chunk sync)
     words = 0
     steps = 0
-    pairs_acc = jnp.float32(0.0)
+    chunk_metrics = []
     t0 = time.perf_counter()
-    for tokens, w in prefetch(it):
-        key = jax.random.fold_in(base_key, steps)
-        params, m = step(params, jnp.asarray(tokens), key, alpha)
-        pairs_acc = pairs_acc + m["pairs"]
-        words += w
-        steps += 1
+    for np_chunk, wlist in prefetch(chunk_batches(batcher.epoch(), S)):
+        params, m = chunk_fn(
+            params, jnp.asarray(np_chunk), base_key, steps, alphas
+        )
+        chunk_metrics.append(m["pairs"])
+        words += sum(wlist)
+        steps += S
         if args.measure_steps and steps >= args.measure_steps:
             break
     jax.block_until_ready(params)
     dt = time.perf_counter() - t0
     wps = words / dt
-    pairs = float(pairs_acc)
+    pairs = float(sum(float(np.sum(jax.device_get(p))) for p in chunk_metrics))
 
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
@@ -194,9 +202,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--negative", type=int, default=5)
     ap.add_argument("--batch-rows", type=int, default=256)
     ap.add_argument("--max-len", type=int, default=192)
-    ap.add_argument("--warmup-steps", type=int, default=3)
+    ap.add_argument("--chunk-cap", type=int, default=32,
+                    help="max optimizer steps fused per dispatch")
     ap.add_argument("--measure-steps", type=int, default=0,
-                    help="0 = one full epoch")
+                    help="0 = one full epoch (rounded up to whole chunks)")
     ap.add_argument("--text8", default="text8")
     ap.add_argument("--probe-timeout", type=float, default=90.0,
                     help="seconds to wait for backend init before CPU fallback")
@@ -270,7 +279,7 @@ def main() -> None:
         ("--tokens", args.tokens), ("--dim", args.dim),
         ("--window", args.window), ("--negative", args.negative),
         ("--batch-rows", args.batch_rows), ("--max-len", args.max_len),
-        ("--warmup-steps", args.warmup_steps),
+        ("--chunk-cap", args.chunk_cap),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:
         child_cmd += [flag, str(val)]

@@ -1,0 +1,124 @@
+"""Chunked-dispatch equivalence: the lax.scan chunk runner must reproduce the
+per-step driver's parameter trajectory exactly.
+
+The chunk runner (ops/train_step.make_chunk_runner) exists purely for
+dispatch economics — one host->device round trip per S optimizer steps —
+so its contract is that training is *indistinguishable* from per-step
+dispatch: same fold_in(base_key, step) RNG stream, same per-step alpha,
+same update order, and all-padding pad batches are provable no-ops.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.models.params import init_params
+from word2vec_tpu.ops.tables import DeviceTables
+from word2vec_tpu.ops.train_step import jit_chunk_runner, jit_train_step
+from word2vec_tpu.train import Trainer
+from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+
+def _setup(model="sg", train_method="ns", tokens=6000, **kw):
+    cfg = Word2VecConfig(
+        model=model,
+        train_method=train_method,
+        negative=3 if train_method == "ns" else 0,
+        word_dim=16,
+        window=3,
+        batch_rows=4,
+        max_sentence_len=24,
+        min_count=1,
+        subsample_threshold=1e-3,
+        seed=11,
+        **kw,
+    )
+    vocab = zipf_vocab(50, 5000)
+    ids = zipf_corpus_ids(vocab, tokens, seed=3)
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    return cfg, vocab, corpus
+
+
+def _final_params(cfg, vocab, corpus):
+    trainer = Trainer(cfg, vocab, corpus)
+    state, report = trainer.train(log_every=0)
+    return {k: np.asarray(v) for k, v in state.params.items()}, state, report
+
+
+@pytest.mark.parametrize("model,method", [("sg", "ns"), ("cbow", "hs")])
+def test_chunked_matches_per_step_trajectory(model, method):
+    cfg1, vocab, corpus = _setup(model=model, train_method=method, chunk_steps=1)
+    cfg8, _, _ = _setup(model=model, train_method=method, chunk_steps=8)
+    p1, s1, _ = _final_params(cfg1, vocab, corpus)
+    p8, s8, _ = _final_params(cfg8, vocab, corpus)
+    assert s1.step == s8.step
+    assert s1.words_done == s8.words_done
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p8[k], rtol=0, atol=1e-6, err_msg=k)
+
+
+def test_chunked_matches_with_micro_steps():
+    """chunk_steps composes with micro_steps (chunk of scans of fori_loops)."""
+    cfg1, vocab, corpus = _setup(chunk_steps=1, micro_steps=2)
+    cfgc, _, _ = _setup(chunk_steps=4, micro_steps=2)
+    p1, _, _ = _final_params(cfg1, vocab, corpus)
+    pc, _, _ = _final_params(cfgc, vocab, corpus)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], pc[k], rtol=0, atol=1e-6, err_msg=k)
+
+
+def test_pad_batches_are_noops():
+    """An all-(-1) batch inside a chunk changes nothing: the padded trailing
+    chunk of an epoch is exactly as if the epoch ended early."""
+    cfg, vocab, corpus = _setup()
+    tables = DeviceTables.build(vocab, cfg)
+    params = init_params(cfg, len(vocab), jax.random.key(0))
+    chunk = jit_chunk_runner(cfg, tables)
+    step = jit_train_step(cfg, tables)
+
+    B, L = cfg.batch_rows, cfg.max_sentence_len
+    rng = np.random.default_rng(0)
+    real = rng.integers(0, len(vocab), size=(B, L), dtype=np.int32)
+    dead = np.full((B, L), -1, dtype=np.int32)
+    toks = jnp.asarray(np.stack([real, dead, dead]))
+    alphas = jnp.asarray(np.float32([0.025, 0.025, 0.025]))
+    key = jax.random.key(5)
+
+    # donation consumes the input buffers, so each call gets its own copy
+    p_chunk, m = chunk(jax.tree.map(jnp.copy, params), toks, key, 0, alphas)
+    p_step, _ = step(jax.tree.map(jnp.copy, params), jnp.asarray(real),
+                     jax.random.fold_in(key, 0), jnp.float32(0.025))
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p_chunk[k]), np.asarray(p_step[k]), rtol=0, atol=1e-6
+        )
+    m = jax.device_get(m)
+    assert m["pairs"][1] == 0.0 and m["pairs"][2] == 0.0
+
+
+def test_chunk_geometry():
+    g = Word2VecConfig.chunk_geometry
+    assert g(1) == (1, 1)
+    assert g(32) == (32, 1)
+    assert g(33) == (17, 2)
+    assert g(46) == (23, 2)
+    assert g(100) == (25, 4)
+    assert g(101) == (26, 4)
+    s, k = g(1000)
+    assert s <= 32 and k * s >= 1000 and k * s - 1000 < k
+
+
+def test_report_consistency_chunked():
+    cfg, vocab, corpus = _setup(chunk_steps=0)  # auto
+    logs = []
+    trainer = Trainer(cfg, vocab, corpus, log_fn=logs.append)
+    state, report = trainer.train()
+    assert report.total_words == state.words_done == corpus.num_tokens * cfg.iters
+    assert report.steps == state.step
+    assert np.isfinite(report.final_loss)
+    assert logs and logs[-1]["progress"] == pytest.approx(1.0)

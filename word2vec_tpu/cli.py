@@ -79,6 +79,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequential optimizer sub-steps per dispatched batch "
                         "(0 = auto with --batch-rows 0, else 1); decouples "
                         "convergence from dispatch size (config.auto_geometry)")
+    p.add_argument("--chunk-steps", type=int, default=0,
+                   help="optimizer steps fused into one dispatched device "
+                        "program (lax.scan); 0 = auto, 1 = per-step dispatch. "
+                        "Identical trajectory either way — purely dispatch "
+                        "economics (single-chip trainer only)")
     p.add_argument("--batch-rows", type=int, default=0,
                    help="sentence rows per device step; 0 = auto-size so an "
                         "epoch has enough optimizer steps to learn (see "
@@ -179,6 +184,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # below; constructing with micro here would trip the
             # divisibility check against the placeholder
             micro_steps=max(1, args.micro_steps) if args.batch_rows else 1,
+            chunk_steps=args.chunk_steps,
             max_sentence_len=args.max_sentence_len,
             seed=args.seed,
             dp_sync_every=args.dp_sync_every,

@@ -102,6 +102,18 @@ class Word2VecConfig:
     # threshold (auto_geometry below). batch_rows must divide evenly.
     micro_steps: int = 1
 
+    # Optimizer steps fused into one dispatched device program (lax.scan over
+    # the step, ops/train_step.make_chunk_runner). 1 = dispatch per step;
+    # 0 = auto (Trainer picks ~chunk_cap-step chunks sized to divide the
+    # epoch evenly); >1 = explicit chunk length. Orthogonal to micro_steps:
+    # micro-steps subdivide one dispatched batch, chunk steps aggregate many
+    # batches into one dispatch. Convergence is unaffected either way — the
+    # chunked trajectory is step-for-step identical to per-step dispatch
+    # (tests/test_chunk_runner.py); this is purely dispatch economics
+    # (through a remote-dispatch tunnel, per-step dispatch costs ~4-5x the
+    # device step time; see bench.py).
+    chunk_steps: int = 1
+
     # --- multi-chip (no reference counterpart; replaces OpenMP Hogwild) ---
     # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
     dp_sync_every: int = 64
@@ -134,6 +146,8 @@ class Word2VecConfig:
             )
         if self.micro_steps < 1:
             raise ValueError("micro_steps must be >= 1")
+        if self.chunk_steps < 0:
+            raise ValueError("chunk_steps must be >= 0 (0 = auto)")
         if self.batch_rows % self.micro_steps != 0:
             raise ValueError(
                 f"batch_rows {self.batch_rows} must be divisible by "
@@ -172,6 +186,17 @@ class Word2VecConfig:
         block = max(1, min(cap, corpus_tokens // (100 * max_sentence_len * dp)))
         micro = max(1, min(max_micro, cap // block))
         return block * micro, micro
+
+    @staticmethod
+    def chunk_geometry(steps_per_epoch: int, cap: int = 32) -> Tuple[int, int]:
+        """(chunk_len S, chunks per epoch k) with k*S >= steps_per_epoch and
+        minimal padding: S = ceil(steps/k) for the smallest k with S <= cap.
+        At most k-1 no-op pad steps per epoch (each an all-padding batch the
+        step provably ignores), so one compiled shape covers every chunk."""
+        steps = max(1, steps_per_epoch)
+        k = -(-steps // max(1, cap))
+        s = -(-steps // k)
+        return s, k
 
     @staticmethod
     def auto_batch_rows(

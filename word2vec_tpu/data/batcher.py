@@ -141,6 +141,27 @@ class BatchIterator:
             yield batch, words
 
 
+def chunk_batches(
+    epoch_iter: Iterator[Tuple[np.ndarray, int]], s: int
+) -> Iterator[Tuple[np.ndarray, List[int]]]:
+    """Group an epoch's [B, L] batches into [S, B, L] chunks for the chunked
+    dispatch runner (ops/train_step.make_chunk_runner). The trailing partial
+    chunk is padded with all-(-1) batches — provable no-op steps — so one
+    compiled shape covers every chunk. Yields (tokens, per-batch word counts:
+    len(words) < S exactly when the chunk is padded)."""
+    buf: List[np.ndarray] = []
+    words: List[int] = []
+    for tokens, w in epoch_iter:
+        buf.append(tokens)
+        words.append(w)
+        if len(buf) == s:
+            yield np.stack(buf), words
+            buf, words = [], []
+    if buf:
+        dead = np.full_like(buf[0], PAD)
+        yield np.stack(buf + [dead] * (s - len(buf))), words
+
+
 def prefetch(iterator: Iterator, depth: int = 2) -> Iterator:
     """Background-thread prefetch so host batch assembly overlaps device compute.
 

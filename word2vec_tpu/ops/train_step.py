@@ -412,3 +412,49 @@ def make_pair_train_step(
 def jit_train_step(config: Word2VecConfig, tables: DeviceTables):
     """The step jitted with params-buffer donation (in-place table updates)."""
     return jax.jit(make_train_step(config, tables), donate_argnums=0)
+
+
+def make_chunk_runner(
+    config: Word2VecConfig,
+    tables: DeviceTables,
+    tp_axis: str | None = None,
+    dp_axis: str | None = None,
+    sp_axis: str | None = None,
+):
+    """S sequential optimizer steps as ONE device program (lax.scan).
+
+    chunk(params, tokens[S, B, L], base_key, step0, alphas[S])
+        -> (params, {"loss_sum": [S], "pairs": [S]})
+
+    Step i applies make_train_step with key = fold_in(base_key, step0 + i)
+    and LR alphas[i] — the exact per-step driver sequence (train.Trainer),
+    so chunked and per-step training produce identical parameter trajectories
+    (pinned by tests/test_chunk_runner.py). The point is dispatch economics:
+    one host->device round trip per S steps instead of per step. Through a
+    remote-dispatch link (the axon tunnel) per-step dispatch costs ~4-5x the
+    8 ms device step; chunked, the overhead amortizes to noise.
+
+    A batch whose rows are all padding (-1) is a provable no-op (every mask
+    derives from token validity), which is how the trailing partial chunk of
+    an epoch is padded to the compiled shape without a second XLA program.
+    """
+    step = make_train_step(config, tables, tp_axis, dp_axis, sp_axis)
+
+    def chunk(params, tokens, base_key, step0, alphas):
+        def body(p, xs):
+            toks, i, a = xs
+            key = jax.random.fold_in(base_key, step0 + i)
+            p, m = step(p, toks, key, a)
+            return p, (m["loss_sum"], m["pairs"])
+
+        s = tokens.shape[0]
+        idx = jnp.arange(s, dtype=jnp.int32)
+        params, (loss, pairs) = jax.lax.scan(body, params, (tokens, idx, alphas))
+        return params, {"loss_sum": loss, "pairs": pairs}
+
+    return chunk
+
+
+def jit_chunk_runner(config: Word2VecConfig, tables: DeviceTables):
+    """The chunk runner jitted with params-buffer donation."""
+    return jax.jit(make_chunk_runner(config, tables), donate_argnums=0)

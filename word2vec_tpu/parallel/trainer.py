@@ -154,6 +154,10 @@ def make_sync(mesh: Mesh):
 class ShardedTrainer(Trainer):
     """Data+sequence+tensor-parallel trainer; dp*sp*tp <= len(jax.devices())."""
 
+    # chunked dispatch (config.chunk_steps) not yet wired through shard_map;
+    # the sharded driver dispatches per step (chunk_steps=0 resolves to 1)
+    supports_chunking = False
+
     def __init__(
         self,
         config: Word2VecConfig,

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import Word2VecConfig
-from .data.batcher import BatchIterator, PackedCorpus, prefetch
+from .data.batcher import BatchIterator, PackedCorpus, chunk_batches, prefetch
 from .data.vocab import Vocab
 from .models.params import Params, init_params
 from .ops.tables import DeviceTables
@@ -53,6 +53,10 @@ class TrainReport:
 
 class Trainer:
     """End-to-end single-chip trainer (multi-chip: parallel.ShardedTrainer)."""
+
+    #: chunked dispatch (config.chunk_steps) — ShardedTrainer overrides until
+    #: the scan-over-shard_map runner lands
+    supports_chunking = True
 
     def __init__(
         self,
@@ -98,6 +102,7 @@ class Trainer:
     # ---------------------------------------------------------------- hooks
     def _build_step(self) -> None:
         self.step_fn = jit_train_step(self.config, self.tables)
+        self.chunk_fn = None  # built lazily (geometry needs the corpus)
 
     def _init_params(self, key: jax.Array) -> Params:
         return init_params(self.config, len(self.vocab), key)
@@ -141,6 +146,12 @@ class Trainer:
         loss_hist: List[float] = []
         last_metrics = None
         self._warned_nonfinite = False
+        chunk_len = self._resolve_chunk_len(batcher)
+        if chunk_len > 1:
+            return self._train_chunked(
+                state, batcher, base_key, chunk_len, t0, loss_hist,
+                log_every, checkpoint_cb, checkpoint_every,
+            )
         # state.epoch = next epoch to run; a mid-epoch checkpoint resumes from
         # the start of its epoch (batch position within an epoch is not saved)
         for epoch in range(state.epoch, cfg.iters):
@@ -203,3 +214,158 @@ class Trainer:
             loss_history=loss_hist,
         )
         return state, report
+
+    # ------------------------------------------------------- chunked driver
+    def _resolve_chunk_len(self, batcher: BatchIterator) -> int:
+        """config.chunk_steps resolved against this corpus (0 = auto)."""
+        cfg = self.config
+        if not self.supports_chunking or cfg.chunk_steps == 1:
+            return 1
+        steps = batcher.steps_per_epoch()
+        if cfg.chunk_steps == 0:
+            s, _ = cfg.chunk_geometry(steps)
+            return s
+        return min(cfg.chunk_steps, steps)
+
+    def _train_chunked(
+        self,
+        state: TrainState,
+        batcher: BatchIterator,
+        base_key: jax.Array,
+        chunk_len: int,
+        t0: float,
+        loss_hist: List[float],
+        log_every: int,
+        checkpoint_cb: Optional[Callable[[TrainState], None]],
+        checkpoint_every: int,
+    ) -> Tuple[TrainState, TrainReport]:
+        """Epochs dispatched chunk_len optimizer steps at a time.
+
+        The parameter trajectory is identical to the per-step loop (same
+        fold_in(base_key, step) stream, same per-step alpha schedule,
+        tests/test_chunk_runner.py); only dispatch granularity changes.
+        Metrics of chunk i are fetched after chunk i+1 is dispatched, so the
+        host never stalls the device pipeline. Logging and checkpointing run
+        at chunk boundaries.
+        """
+        cfg = self.config
+        from .ops.train_step import jit_chunk_runner
+
+        if self.chunk_fn is None:
+            self.chunk_fn = jit_chunk_runner(cfg, self.tables)
+        self._last_chunk_loss = float("nan")
+        pending: Optional[Tuple[Dict, int, int, float, int, bool]] = None
+
+        def drain() -> None:
+            nonlocal pending
+            if pending is None:
+                return
+            metrics, at_step, at_epoch, at_alpha, at_words, do_log = pending
+            pending = None
+            m = jax.device_get(metrics)  # blocks only on an already-queued chunk
+            self._note_metrics(
+                m, at_step, at_epoch, at_alpha, at_words, t0, loss_hist, do_log
+            )
+
+        for epoch in range(state.epoch, cfg.iters):
+            state.epoch = epoch
+            for np_chunk, words_list in prefetch(chunk_batches(batcher.epoch(), chunk_len)):
+                alphas = np.empty(chunk_len, np.float32)
+                wd = state.words_done
+                for i in range(chunk_len):
+                    alphas[i] = self.alpha_at(wd)
+                    wd += words_list[i] if i < len(words_list) else 0
+                tokens, al = self._place_chunk(np_chunk, alphas)
+                state.params, metrics = self.chunk_fn(
+                    state.params, tokens, base_key, state.step, al
+                )
+                prev_step = state.step
+                state.step += len(words_list)
+                state.words_done = wd
+                self._post_step(state)
+                drain()
+                # per-step contract: history/logs only at log_every boundaries
+                # (here: once per chunk that crosses one); log_every=0 disables
+                do_log = bool(
+                    log_every
+                    and state.step // log_every != prev_step // log_every
+                )
+                pending = (
+                    metrics, state.step, epoch,
+                    float(alphas[len(words_list) - 1]), state.words_done, do_log,
+                )
+                if (
+                    checkpoint_every
+                    and checkpoint_cb
+                    and state.step // checkpoint_every
+                    != (state.step - len(words_list)) // checkpoint_every
+                ):
+                    checkpoint_cb(state)
+            state.epoch = epoch + 1
+
+        self._finalize(state)
+        jax.block_until_ready(state.params)
+        wall = time.perf_counter() - t0
+        drain()
+        return state, TrainReport(
+            words_per_sec=state.words_done / max(wall, 1e-9),
+            total_words=state.words_done,
+            steps=state.step,
+            wall_time=wall,
+            final_loss=self._last_chunk_loss,
+            loss_history=loss_hist,
+        )
+
+    def _place_chunk(
+        self, np_chunk: np.ndarray, alphas: np.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Host chunk -> device arrays (sharded trainers override placement)."""
+        return jnp.asarray(np_chunk), jnp.asarray(alphas)
+
+    _last_chunk_loss: float = float("nan")
+
+    def _note_metrics(
+        self,
+        m: Dict,
+        at_step: int,
+        at_epoch: int,
+        at_alpha: float,
+        at_words: int,
+        t0: float,
+        loss_hist: List[float],
+        do_log: bool,
+    ) -> None:
+        """Aggregate a fetched chunk's per-step metrics into loss history,
+        the divergence warning, and the log stream (chunk boundaries are the
+        logging granularity of the chunked driver; do_log mirrors the
+        per-step loop's `step % log_every == 0` gate)."""
+        loss_sum = float(np.sum(m["loss_sum"]))
+        pairs = float(np.sum(m["pairs"]))
+        loss = loss_sum / max(1.0, pairs)
+        self._last_chunk_loss = loss
+        if not np.isfinite(loss) and not self._warned_nonfinite:
+            self._warned_nonfinite = True
+            import warnings
+
+            warnings.warn(
+                f"non-finite loss in chunk ending at step {at_step}: "
+                "batched-sum updates have diverged (see config.scatter_mean "
+                "notes).",
+                stacklevel=2,
+            )
+        if not do_log:
+            return
+        loss_hist.append(loss)
+        if self.log_fn:
+            dt = time.perf_counter() - t0
+            self.log_fn(
+                {
+                    "step": at_step,
+                    "epoch": at_epoch,
+                    "alpha": at_alpha,
+                    "loss": loss,
+                    "progress": at_words
+                    / (self.config.iters * max(1, self.total_words)),
+                    "words_per_sec": at_words / max(dt, 1e-9),
+                }
+            )

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Replica-sync cost/accuracy sweep (VERDICT r1 item 8).
+
+The multi-chip design trains independent data-parallel replicas and
+reconciles them every `dp_sync_every` optimizer steps over ICI
+(parallel/trainer.py). Two costs trade off:
+
+  * accuracy — longer windows let replicas drift (their updates are computed
+    against stale peers, the batched analog of Hogwild staleness);
+  * communication — each sync moves the tables over ICI: "mean" mode moves
+    full f32 tables, "delta" mode (delta-psum, SURVEY §7(d)) moves bf16
+    deltas — half the bytes.
+
+This sweep trains a ShardedTrainer (dp=4 on the 8-virtual-CPU-device mesh,
+the SURVEY §4 "distributed-without-a-cluster" rig) on the planted-structure
+topic corpus for every (dp_sync_every, sync_mode) point and reports the
+parity eval (Spearman vs planted gold + neighbor purity) plus the modeled
+ICI bytes per epoch. One JSON line per point; a summary line at the end.
+
+Usage: python benchmarks/sync_sweep.py [--tokens 200000] [--dim 64]
+           [--every 8,32,64,128,256] [--modes mean,delta]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from parity import eval_vectors  # noqa: E402  (benchmarks/parity.py)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=200_000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--negative", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--dp", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--every", default="8,32,64,128,256")
+    ap.add_argument("--modes", default="mean,delta")
+    args = ap.parse_args()
+
+    import tempfile
+
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.data.vocab import Vocab
+    from word2vec_tpu.io.embeddings import save_embeddings_text
+    from word2vec_tpu.parallel import ShardedTrainer
+    from word2vec_tpu.utils.synthetic import topic_corpus, topic_similarity_pairs
+
+    tokens, topic_of = topic_corpus(n_tokens=args.tokens, seed=args.seed)
+    pairs = topic_similarity_pairs(topic_of, seed=args.seed + 1)
+    sents = [tokens[i : i + 1000] for i in range(0, len(tokens), 1000)]
+    vocab = Vocab.build(sents, min_count=5)
+
+    results = []
+    for every in [int(x) for x in args.every.split(",")]:
+        for mode in args.modes.split(","):
+            cfg = Word2VecConfig(
+                model="sg", train_method="ns", negative=args.negative,
+                word_dim=args.dim, window=args.window, min_count=5,
+                subsample_threshold=1e-4, iters=args.iters, seed=args.seed,
+                dp_sync_every=every, sync_mode=mode,
+                max_sentence_len=96,
+            )
+            rows, micro = cfg.auto_geometry(
+                args.tokens, cfg.max_sentence_len, dp=args.dp
+            )
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, batch_rows=rows, micro_steps=micro)
+            corpus = PackedCorpus.pack(
+                vocab.encode_corpus(sents), cfg.max_sentence_len
+            )
+            tr = ShardedTrainer(cfg, vocab, corpus, dp=args.dp, tp=1)
+            state, report = tr.train(log_every=0)
+            exported = tr.export_params(state)
+
+            from word2vec_tpu.models.params import export_matrix
+
+            W = export_matrix(exported, cfg)
+            with tempfile.TemporaryDirectory() as tmp:
+                path = os.path.join(tmp, "vec.txt")
+                save_embeddings_text(path, vocab.words, W)
+                scores = eval_vectors(path, pairs, topic_of)
+
+            # modeled ICI bytes per sync event: every replica contributes its
+            # table bytes to the all-reduce (ring: 2*(R-1)/R per element and
+            # direction — report the per-element payload instead, which is
+            # what the mode changes)
+            table_elems = sum(int(np.prod(v.shape)) for v in exported.values())
+            bytes_per_elem = 2 if mode == "delta" else 4
+            spe = -(-corpus.num_rows // cfg.batch_rows)
+            dispatch_every = max(1, every // cfg.micro_steps)
+            syncs_per_epoch = max(1, spe // dispatch_every)
+            rec = {
+                "dp_sync_every": every,
+                "sync_mode": mode,
+                "spearman": scores.get("spearman"),
+                "neighbor_purity@10": scores.get("neighbor_purity@10"),
+                "final_loss": round(report.final_loss, 4),
+                "sync_payload_mb_per_epoch": round(
+                    table_elems * bytes_per_elem * syncs_per_epoch / 1e6, 1
+                ),
+                "syncs_per_epoch": syncs_per_epoch,
+            }
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+
+    best = max(results, key=lambda r: r["spearman"] or -1)
+    print(json.dumps({
+        "summary": "sync sweep",
+        "dp": args.dp,
+        "tokens": args.tokens,
+        "best": best,
+        "spearman_spread": round(
+            max(r["spearman"] for r in results)
+            - min(r["spearman"] for r in results), 4
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

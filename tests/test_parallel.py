@@ -249,3 +249,59 @@ def test_sp_requires_band_kernel_and_divisibility():
                             max_sentence_len=16, scatter_mean=True)
     with pytest.raises(ValueError, match="scatter_mean"):
         ShardedTrainer(cfg_sm, vocab, corpus, sp=2)
+
+
+# ------------------------------------------------------------- delta sync
+
+
+def test_delta_sync_matches_mean_sync():
+    """base + pmean(bf16(delta)) must track pmean(params) to bf16-of-the-
+    delta precision (config.sync_mode notes)."""
+    from word2vec_tpu.parallel.mesh import make_mesh
+    from word2vec_tpu.parallel.trainer import (
+        make_delta_sync, make_sync, replicate_params,
+    )
+
+    mesh = make_mesh(dp=4, tp=1)
+    rng = np.random.default_rng(0)
+    base_np = {"emb_in": rng.normal(size=(40, 8)).astype(np.float32)}
+    base = replicate_params(base_np, mesh)
+    # per-replica divergence of realistic SGD scale
+    drift = rng.normal(scale=1e-2, size=(4, 40, 8)).astype(np.float32)
+    params = {"emb_in": base["emb_in"] + jnp.asarray(drift)}
+
+    mean_out = make_sync(mesh)({k: v.copy() for k, v in params.items()})
+    delta_out = make_delta_sync(mesh)(
+        {k: v.copy() for k, v in params.items()},
+        {k: v.copy() for k, v in base.items()},
+    )
+    m = np.asarray(mean_out["emb_in"])
+    d = np.asarray(delta_out["emb_in"])
+    # replicas agree exactly after either sync
+    for r in range(1, 4):
+        np.testing.assert_array_equal(d[0], d[r])
+    # and the two modes agree to bf16 precision OF THE DELTA (~1e-2 * 1/128)
+    np.testing.assert_allclose(d, m, atol=1e-4)
+
+
+def test_sharded_trainer_delta_sync_end_to_end():
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        min_count=1, subsample_threshold=0, iters=2, batch_rows=4,
+        max_sentence_len=12, init_alpha=0.05, dp_sync_every=4,
+        sync_mode="delta",
+    )
+    rng = np.random.default_rng(3)
+    sents = [[f"w{j}" for j in rng.integers(0, 20, size=10)] for _ in range(200)]
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    tr = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2)
+    state, report = tr.train(log_every=5)
+    exported = tr.export_params(state)
+    for k, v in exported.items():
+        assert np.all(np.isfinite(v)), k
+    # final sync ran: all replicas identical
+    for k, v in state.params.items():
+        arr = np.asarray(v)
+        for r in range(1, arr.shape[0]):
+            np.testing.assert_array_equal(arr[0], arr[r], err_msg=k)

@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-parallel mesh axis (halo-exchange context "
                         "parallelism for long rows; band kernel only)")
     p.add_argument("--dp-sync-every", type=int, default=64)
+    p.add_argument("--sync-mode", choices=["mean", "delta"], default="mean",
+                   help="replica reconciliation: mean = full-table pmean; "
+                        "delta = delta-psum with bf16 wire compression "
+                        "(half the ICI bytes; parallel/trainer.py)")
     p.add_argument("--multihost", action="store_true",
                    help="multi-process mode: jax.distributed.initialize from "
                         "the W2V_COORDINATOR/W2V_NUM_PROCS/W2V_PROC_ID env "
@@ -162,13 +166,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .train import Trainer
     from .utils.logging import progress_logger
 
+    # Resume: the checkpoint's config and vocab are authoritative — resuming
+    # against a rebuilt vocab would silently re-attribute embedding rows; and
+    # the flag-derived config is never even validated (default flags need not
+    # form a valid config to resume from one that does).
+    state = None
+    ck_cfg = None
+    ck_vocab = None
+    if args.resume:
+        state, ck_cfg, ck_vocab = load_checkpoint(args.resume)
+        if not args.quiet:
+            print(f"resumed from {args.resume} at step {state.step}")
+
     # validation mirrors main.cpp:164-181 (raised by Word2VecConfig)
     alpha = args.alpha
     if alpha is None:
         # word2vec.c-style default: 0.05 for cbow(+mean), 0.025 for sg
         alpha = 0.05 if (args.model == "cbow" and args.cbow_mean) else 0.025
     try:
-        cfg = Word2VecConfig(
+        cfg = ck_cfg if ck_cfg is not None else Word2VecConfig(
             iters=args.iter,
             window=args.window,
             min_count=args.min_count,
@@ -188,6 +204,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             max_sentence_len=args.max_sentence_len,
             seed=args.seed,
             dp_sync_every=args.dp_sync_every,
+            sync_mode=args.sync_mode,
             kernel=args.kernel,
             compute_dtype=args.compute_dtype,
             shared_negatives=args.shared_negatives,
@@ -210,25 +227,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     # same -output on a shared filesystem would interleave writes.
     is_primary = jax.process_index() == 0
 
-    # Resume: the checkpoint's config and vocab are authoritative — resuming
-    # against a rebuilt vocab would silently re-attribute embedding rows.
-    state = None
-    ck_vocab = None
-    if args.resume:
-        state, ck_cfg, ck_vocab = load_checkpoint(args.resume)
-        import dataclasses as _dc
+    if ck_cfg is not None and not args.quiet:
+        # best-effort notice about flags the checkpoint config overrides
+        # (the flag combo itself may not even be constructible — fine)
+        try:
+            flag_cfg = Word2VecConfig(
+                iters=args.iter, window=args.window, min_count=args.min_count,
+                word_dim=args.size, negative=args.negative,
+                subsample_threshold=args.subsample, init_alpha=alpha,
+                cbow_mean=bool(args.cbow_mean), train_method=args.train_method,
+                model=args.model,
+            )
+        except ValueError:
+            flag_cfg = None
+        if flag_cfg is not None:
+            import dataclasses as _dc
 
-        diffs = {
-            f.name: (getattr(cfg, f.name), getattr(ck_cfg, f.name))
-            for f in _dc.fields(cfg)
-            if getattr(cfg, f.name) != getattr(ck_cfg, f.name)
-        }
-        if diffs and not args.quiet:
-            print(f"resume: using checkpoint config; ignoring differing flags "
-                  f"{sorted(diffs)}", file=sys.stderr)
-        cfg = ck_cfg
-        if not args.quiet:
-            print(f"resumed from {args.resume} at step {state.step}")
+            diffs = sorted(
+                f.name
+                for f in _dc.fields(flag_cfg)
+                if getattr(flag_cfg, f.name) != getattr(ck_cfg, f.name)
+            )
+            if diffs:
+                print(
+                    "resume: using checkpoint config; ignoring differing "
+                    f"flags {diffs}", file=sys.stderr,
+                )
 
     t0 = time.perf_counter()
     mode = native.MODE_STREAM if args.corpus_format == "text8" else native.MODE_LINES

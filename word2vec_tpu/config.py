@@ -118,6 +118,18 @@ class Word2VecConfig:
     # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
     dp_sync_every: int = 64
 
+    # How replicas are reconciled at each sync (parallel/trainer.make_sync):
+    #   "mean"  — pmean the full f32 tables over the replica axes.
+    #   "delta" — delta-psum (SURVEY §7(d)): each replica sends only what
+    #             CHANGED since the last sync, compressed to bf16 on the
+    #             wire, and the shared base advances by the replica-mean
+    #             delta: new = base + pmean(bf16(params - base)). Halves
+    #             ICI bytes per sync; rounding applies to the (small) delta,
+    #             not the weights, so the drift vs "mean" is bounded by
+    #             bf16 eps * |delta| per sync (tests/test_parallel.py).
+    #             Costs one extra table-sized buffer per replica shard.
+    sync_mode: str = "mean"
+
     def __post_init__(self) -> None:
         if self.min_alpha is None:
             self.min_alpha = self.init_alpha * 1e-4
@@ -148,6 +160,10 @@ class Word2VecConfig:
             raise ValueError("micro_steps must be >= 1")
         if self.chunk_steps < 0:
             raise ValueError("chunk_steps must be >= 0 (0 = auto)")
+        if self.sync_mode not in ("mean", "delta"):
+            raise ValueError(
+                f"sync_mode must be 'mean' or 'delta', got {self.sync_mode!r}"
+            )
         if self.batch_rows % self.micro_steps != 0:
             raise ValueError(
                 f"batch_rows {self.batch_rows} must be divisible by "

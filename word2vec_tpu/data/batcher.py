@@ -101,6 +101,13 @@ class BatchIterator:
 
     The final partial batch of an epoch is padded with empty rows so every
     device step has the same shape (no recompilation).
+
+    Each epoch's permutation is a pure function of (seed, epoch index) —
+    epoch k can be regenerated in isolation, which is what makes mid-epoch
+    checkpoint resume possible (epoch(k, skip=n) re-enters epoch k at batch
+    n without replaying batches 0..n-1). Calling epoch() with no index keeps
+    an internal counter, so sequential use shuffles every pass as before
+    (Word2Vec.cpp:373).
     """
 
     def __init__(
@@ -114,28 +121,35 @@ class BatchIterator:
         self.corpus = corpus
         self.B = batch_rows
         self.L = max_len
-        self.rng = np.random.default_rng(seed)
+        self.seed = seed
         self.shuffle = shuffle
+        self._epoch_counter = 0
 
     def steps_per_epoch(self) -> int:
         return -(-self.corpus.num_rows // self.B)
 
-    def epoch(self) -> Iterator[Tuple[np.ndarray, int]]:
-        """Yield (tokens [B, L], words_in_batch) for one pass over the corpus.
+    def epoch(
+        self, epoch_index: Optional[int] = None, skip: int = 0
+    ) -> Iterator[Tuple[np.ndarray, int]]:
+        """Yield (tokens [B, L], words_in_batch) for one pass over the corpus,
+        starting `skip` batches in.
 
         Batch assembly goes through the native fill (native.fill_batch) when
         the C++ layer is available; the Python fallback is identical.
         """
         from .. import native
 
+        if epoch_index is None:
+            epoch_index = self._epoch_counter
+            self._epoch_counter += 1
         order = np.arange(self.corpus.num_rows, dtype=np.int64)
         if self.shuffle:
-            self.rng.shuffle(order)
+            np.random.default_rng((self.seed, epoch_index)).shuffle(order)
         flat = self.corpus.flat
         starts = self.corpus.row_starts
         lens = self.corpus.row_lens
         B, L = self.B, self.L
-        for i in range(0, len(order), B):
+        for i in range(skip * B, len(order), B):
             batch = np.empty((B, L), dtype=np.int32)
             words = native.fill_batch(flat, starts, lens, order, i, batch)
             yield batch, words

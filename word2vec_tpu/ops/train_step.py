@@ -159,8 +159,13 @@ def make_train_step(
             p, m = base(p, sub[i], ki, alpha)
             return p, loss + m["loss_sum"], pairs + m["pairs"]
 
+        # first sub-block peeled: under shard_map the metrics are varying
+        # over the mesh axes, and a jnp.float32(0.0) initial carry would be
+        # unvarying — a loop-carry type mismatch. Seeding the carry from a
+        # real step gives it the right varying-axes type on any mesh.
+        params, m0 = base(params, sub[0], jax.random.fold_in(key, 0), alpha)
         params, loss, pairs = jax.lax.fori_loop(
-            0, k, body, (params, jnp.float32(0.0), jnp.float32(0.0))
+            1, k, body, (params, m0["loss_sum"], m0["pairs"])
         )
         return params, {"loss_sum": loss, "pairs": pairs}
 

@@ -151,6 +151,39 @@ def make_sync(mesh: Mesh):
     return jax.jit(syncfn, donate_argnums=0)
 
 
+def make_delta_sync(mesh: Mesh):
+    """Delta-psum reconciliation (SURVEY §7(d); config.sync_mode="delta").
+
+    sync(params, base) -> new_params, with
+        new = base + pmean(bf16(params - base))
+    over the replica axes. `base` is the (replica-identical) state of the
+    last sync, so only the accumulated local UPDATES cross the wire — in
+    bf16, which halves the ICI bytes of a full-table pmean. In exact
+    arithmetic base + pmean(delta) == pmean(params); the bf16 rounding is
+    relative to the delta's magnitude (per-sync drift ~eps_bf16 * |delta|),
+    not the weights'. The caller keeps the next base as an explicit .copy()
+    of the result (ShardedTrainer._run_sync) so the step's donated in-place
+    updates never alias it.
+    """
+
+    def syncfn(params, base):
+        specs = {k: PARAM_SPEC for k in params}
+
+        def local(p, b):
+            out = {}
+            for k, v in p.items():
+                wire = (v - b[k]).astype(jnp.bfloat16)  # bf16 on the wire
+                mean_delta = jax.lax.pmean(wire, REPLICA_AXES)
+                out[k] = b[k] + mean_delta.astype(v.dtype)
+            return out
+
+        return jax.shard_map(
+            local, mesh=mesh, in_specs=(specs, specs), out_specs=specs
+        )(params, base)
+
+    return jax.jit(syncfn, donate_argnums=(0, 1))
+
+
 class ShardedTrainer(Trainer):
     """Data+sequence+tensor-parallel trainer; dp*sp*tp <= len(jax.devices())."""
 
@@ -220,14 +253,41 @@ class ShardedTrainer(Trainer):
     # ---------------------------------------------------------------- hooks
     def _build_step(self) -> None:
         self.step_fn = make_sharded_step(self.config, self.tables, self.mesh)
-        self.sync_fn = make_sync(self.mesh)
+        if self.config.sync_mode == "delta":
+            self.sync_fn = make_delta_sync(self.mesh)
+        else:
+            self.sync_fn = make_sync(self.mesh)
+        self._sync_base: Optional[Params] = None
 
     def _init_params(self, key: jax.Array) -> Params:
-        return replicate_params(
+        params = replicate_params(
             init_params(self.config, len(self.vocab), key), self.mesh
         )
+        self._reset_sync_base(params)
+        return params
 
-    def _batches(self, batcher: BatchIterator) -> Iterator[Tuple[jnp.ndarray, int]]:
+    def _reset_sync_base(self, params: Params) -> None:
+        """Delta sync tracks params-at-last-sync; (re)base whenever params
+        are (re)placed wholesale (init, checkpoint import)."""
+        if self.config.sync_mode == "delta":
+            self._sync_base = {k: v.copy() for k, v in params.items()}
+
+    def _run_sync(self, params: Params) -> Params:
+        if self.config.sync_mode == "delta":
+            if self._sync_base is None:
+                # externally supplied state (train(state=...) without
+                # init_state/import_params): base from the current params —
+                # replicas are assumed reconciled at hand-off
+                self._reset_sync_base(params)
+            params = self.sync_fn(params, self._sync_base)
+            # distinct buffer: the step updates params in place (donation)
+            self._sync_base = {k: v.copy() for k, v in params.items()}
+            return params
+        return self.sync_fn(params)
+
+    def _batches(
+        self, batcher: BatchIterator, epoch_index: int, skip: int = 0
+    ) -> Iterator[Tuple[jnp.ndarray, int]]:
         """Group consecutive [B, L] batches into one sharded [DP*B, L]
         (the seq axis splits L at placement; no host-side reshaping).
 
@@ -237,13 +297,16 @@ class ShardedTrainer(Trainer):
         make_array_from_process_local_data assembles the global array (data
         shard order follows process order, parallel/multihost.py). The word
         count is per-process; the alpha schedule stays consistent across
-        hosts when corpus shards are of similar size.
+        hosts when corpus shards are of similar size. `skip` counts GLOBAL
+        steps (the Trainer's resume unit); every process derives the same
+        value from the replicated step counter, so collective cadence stays
+        aligned across hosts.
         """
         local_dp = self.dp // self.procs
         limit = self._agreed_steps_per_epoch(batcher, local_dp)
-        emitted = 0
+        emitted = min(skip, limit)
         buf, words = [], 0
-        for tokens, w in batcher.epoch():
+        for tokens, w in batcher.epoch(epoch_index, skip * local_dp):
             buf.append(tokens)
             words += w
             if len(buf) == local_dp:
@@ -276,6 +339,15 @@ class ShardedTrainer(Trainer):
                 self._epoch_steps = global_agree_min(local)
         return self._epoch_steps
 
+    def _resume_skip(self, state: TrainState, batcher: BatchIterator) -> int:
+        """Resume position in GLOBAL steps (the sharded step counter's unit:
+        one global step consumes local_dp local batches per process)."""
+        local_dp = self.dp // self.procs
+        spe = self._agreed_steps_per_epoch(batcher, local_dp)
+        skip = state.step - state.epoch * spe
+        # skip == spe: boundary checkpoint -> empty epoch, roll to the next
+        return skip if 0 <= skip <= spe else 0
+
     def _place(self, local_rows: np.ndarray) -> jnp.ndarray:
         if self.procs == 1:
             return jax.device_put(local_rows, self.token_sharding)
@@ -291,19 +363,19 @@ class ShardedTrainer(Trainer):
         # the replica-averaging window by up to 64x)
         every = max(1, cfg.dp_sync_every // cfg.micro_steps)
         if self.dp * self.sp > 1 and cfg.dp_sync_every and state.step % every == 0:
-            state.params = self.sync_fn(state.params)
+            state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
 
     def _finalize(self, state: TrainState) -> None:
         if self.dp * self.sp > 1 and self._last_sync_step != state.step:
-            state.params = self.sync_fn(state.params)
+            state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
 
     # ----------------------------------------------------------------- api
     def export_params(self, state: TrainState) -> Params:
         """Synced, de-replicated [V, d] tables on host."""
         if self.dp * self.sp > 1 and self._last_sync_step != state.step:
-            state.params = self.sync_fn(state.params)
+            state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
         if self.procs == 1:
             return {k: np.asarray(v[0]) for k, v in state.params.items()}
@@ -318,4 +390,5 @@ class ShardedTrainer(Trainer):
         state.params = replicate_params(
             {k: np.asarray(v) for k, v in params.items()}, self.mesh
         )
+        self._reset_sync_base(state.params)
         self._last_sync_step = state.step

@@ -107,10 +107,26 @@ class Trainer:
     def _init_params(self, key: jax.Array) -> Params:
         return init_params(self.config, len(self.vocab), key)
 
-    def _batches(self, batcher: BatchIterator) -> Iterator[Tuple[jnp.ndarray, int]]:
-        """Yield (device-ready tokens, words) for one epoch."""
-        for tokens, words in batcher.epoch():
+    def _batches(
+        self, batcher: BatchIterator, epoch_index: int, skip: int = 0
+    ) -> Iterator[Tuple[jnp.ndarray, int]]:
+        """Yield (device-ready tokens, words) for one epoch, `skip` optimizer
+        steps in (mid-epoch checkpoint resume)."""
+        for tokens, words in batcher.epoch(epoch_index, skip):
             yield jnp.asarray(tokens), words
+
+    def _resume_skip(self, state: TrainState, batcher: BatchIterator) -> int:
+        """Steps of state.epoch already done per the checkpointed step
+        counter. Valid because epoch permutations are pure functions of
+        (seed, epoch) — see BatchIterator.epoch. Out-of-range values (a
+        checkpoint from different batch geometry; the CLI prevents this by
+        restoring the checkpoint's config) fall back to epoch restart.
+        skip == steps_per_epoch is valid: a checkpoint on the epoch boundary
+        (taken before the epoch counter advanced) resumes into an empty
+        epoch iterator and rolls straight into the next epoch."""
+        spe = batcher.steps_per_epoch()
+        skip = state.step - state.epoch * spe
+        return skip if 0 <= skip <= spe else 0
 
     def _post_step(self, state: TrainState) -> None:
         """Called after every optimizer step (sharded: periodic sync)."""
@@ -152,11 +168,12 @@ class Trainer:
                 state, batcher, base_key, chunk_len, t0, loss_hist,
                 log_every, checkpoint_cb, checkpoint_every,
             )
-        # state.epoch = next epoch to run; a mid-epoch checkpoint resumes from
-        # the start of its epoch (batch position within an epoch is not saved)
+        # state.epoch = epoch in progress; a mid-epoch checkpoint re-enters it
+        # at the first undone batch (_resume_skip)
+        skip = self._resume_skip(state, batcher)
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
-            for tokens, words in prefetch(self._batches(batcher)):
+            for tokens, words in prefetch(self._batches(batcher, epoch, skip)):
                 alpha = jnp.float32(self.alpha_at(state.words_done))
                 key = jax.random.fold_in(base_key, state.step)
                 state.params, metrics = self.step_fn(state.params, tokens, key, alpha)
@@ -196,6 +213,7 @@ class Trainer:
                 if checkpoint_every and checkpoint_cb and state.step % checkpoint_every == 0:
                     checkpoint_cb(state)
             state.epoch = epoch + 1  # epoch completed
+            skip = 0  # only the resumed epoch re-enters mid-way
 
         self._finalize(state)
         # ensure all device work is done before timing
@@ -267,9 +285,12 @@ class Trainer:
                 m, at_step, at_epoch, at_alpha, at_words, t0, loss_hist, do_log
             )
 
+        skip = self._resume_skip(state, batcher)
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
-            for np_chunk, words_list in prefetch(chunk_batches(batcher.epoch(), chunk_len)):
+            for np_chunk, words_list in prefetch(
+                chunk_batches(batcher.epoch(epoch, skip), chunk_len)
+            ):
                 alphas = np.empty(chunk_len, np.float32)
                 wd = state.words_done
                 for i in range(chunk_len):
@@ -298,10 +319,11 @@ class Trainer:
                     checkpoint_every
                     and checkpoint_cb
                     and state.step // checkpoint_every
-                    != (state.step - len(words_list)) // checkpoint_every
+                    != prev_step // checkpoint_every
                 ):
                     checkpoint_cb(state)
             state.epoch = epoch + 1
+            skip = 0  # only the resumed epoch re-enters mid-way
 
         self._finalize(state)
         jax.block_until_ready(state.params)

@@ -305,3 +305,33 @@ def test_sharded_trainer_delta_sync_end_to_end():
         arr = np.asarray(v)
         for r in range(1, arr.shape[0]):
             np.testing.assert_array_equal(arr[0], arr[r], err_msg=k)
+
+
+# ------------------------------------------------------- chunked (sharded)
+
+
+@pytest.mark.parametrize("sync_mode", ["mean", "delta"])
+def test_sharded_chunked_matches_per_step(sync_mode):
+    """The scan-over-shard_map chunk runner must reproduce the per-step
+    sharded trajectory exactly (same RNG stream, alphas, sync cadence)."""
+    def run(chunk_steps):
+        cfg = Word2VecConfig(
+            model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+            min_count=1, subsample_threshold=0, iters=2, batch_rows=4,
+            max_sentence_len=12, init_alpha=0.05, dp_sync_every=4,
+            sync_mode=sync_mode, chunk_steps=chunk_steps,
+        )
+        rng = np.random.default_rng(3)
+        sents = [[f"w{j}" for j in rng.integers(0, 20, size=10)]
+                 for _ in range(160)]
+        vocab = Vocab.build(sents, min_count=1)
+        corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+        tr = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2)
+        state, _ = tr.train(log_every=0)
+        return tr.export_params(state), state
+
+    p1, s1 = run(chunk_steps=1)
+    pc, sc = run(chunk_steps=0)  # auto (capped to divide the sync interval)
+    assert s1.step == sc.step and s1.words_done == sc.words_done
+    for k in p1:
+        np.testing.assert_allclose(p1[k], pc[k], rtol=0, atol=1e-6, err_msg=k)

@@ -87,7 +87,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="optimizer steps fused into one dispatched device "
                         "program (lax.scan); 0 = auto, 1 = per-step dispatch. "
                         "Identical trajectory either way — purely dispatch "
-                        "economics (single-chip trainer only)")
+                        "economics (sharded: capped to divide the sync "
+                        "interval)")
     p.add_argument("--batch-rows", type=int, default=0,
                    help="sentence rows per device step; 0 = auto-size so an "
                         "epoch has enough optimizer steps to learn (see "

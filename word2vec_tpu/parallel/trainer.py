@@ -134,6 +134,64 @@ def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
     return jax.jit(stepfn, donate_argnums=0)
 
 
+def make_sharded_chunk(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
+    """Chunked dispatch over the mesh: S global steps as one device program.
+
+    chunk(params, tokens[S, DP*B, L], base_key, step0, alphas[S]) — the
+    sharded analog of ops/train_step.make_chunk_runner: an inner lax.scan
+    over the per-step shard_map body, same fold_in(base_key, step0 + i) RNG
+    stream and per-step alphas as the per-step sharded driver, so the
+    trajectory is identical and only dispatch granularity changes. Per-step
+    metrics are psum'd inside the scan (replicated outputs, spec P()).
+
+    Replica sync stays OUTSIDE the chunk at chunk boundaries;
+    ShardedTrainer._resolve_chunk_len caps S at the sync dispatch interval
+    so chunking never coarsens the reconciliation cadence.
+    """
+    dp = mesh.shape[DATA_AXIS]
+    sp = mesh.shape[SEQ_AXIS]
+    tp = mesh.shape[MODEL_AXIS]
+    inner = make_train_step(
+        config,
+        tables,
+        tp_axis=MODEL_AXIS if tp > 1 else None,
+        dp_axis=DATA_AXIS if dp > 1 else None,
+        sp_axis=SEQ_AXIS if sp > 1 else None,
+    )
+
+    def local_chunk(params, tokens, base_key, step0, alphas):
+        p = {k: v[0] for k, v in params.items()}
+
+        def body(pp, xs):
+            toks, i, a = xs
+            key = jax.random.fold_in(base_key, step0 + i)
+            pp, m = inner(pp, toks, key, a)
+            m = {
+                k: jax.lax.psum(jax.lax.psum(v, MODEL_AXIS) / tp, REPLICA_AXES)
+                for k, v in m.items()
+            }
+            return pp, (m["loss_sum"], m["pairs"])
+
+        s = tokens.shape[0]
+        idx = jnp.arange(s, dtype=jnp.int32)
+        p, (loss, pairs) = jax.lax.scan(body, p, (tokens, idx, alphas))
+        return (
+            {k: v[None] for k, v in p.items()},
+            {"loss_sum": loss, "pairs": pairs},
+        )
+
+    def chunkfn(params, tokens, base_key, step0, alphas):
+        specs = {k: PARAM_SPEC for k in params}
+        return jax.shard_map(
+            local_chunk,
+            mesh=mesh,
+            in_specs=(specs, P(None, DATA_AXIS, SEQ_AXIS), P(), P(), P()),
+            out_specs=(specs, P()),
+        )(params, tokens, base_key, step0, alphas)
+
+    return jax.jit(chunkfn, donate_argnums=0)
+
+
 def make_sync(mesh: Mesh):
     """Jitted pmean of all replicas over the data and seq axes (ICI
     all-reduce)."""
@@ -187,9 +245,7 @@ def make_delta_sync(mesh: Mesh):
 class ShardedTrainer(Trainer):
     """Data+sequence+tensor-parallel trainer; dp*sp*tp <= len(jax.devices())."""
 
-    # chunked dispatch (config.chunk_steps) not yet wired through shard_map;
-    # the sharded driver dispatches per step (chunk_steps=0 resolves to 1)
-    supports_chunking = False
+    supports_chunking = True
 
     def __init__(
         self,
@@ -257,6 +313,7 @@ class ShardedTrainer(Trainer):
             self.sync_fn = make_delta_sync(self.mesh)
         else:
             self.sync_fn = make_sync(self.mesh)
+        self.chunk_fn = None  # built lazily (train._train_chunked)
         self._sync_base: Optional[Params] = None
 
     def _init_params(self, key: jax.Array) -> Params:
@@ -348,6 +405,70 @@ class ShardedTrainer(Trainer):
         # skip == spe: boundary checkpoint -> empty epoch, roll to the next
         return skip if 0 <= skip <= spe else 0
 
+    # ------------------------------------------------------ chunked hooks
+    def _resolve_chunk_len(self, batcher: BatchIterator) -> int:
+        """Sync runs at chunk boundaries, so the chunk length is capped at
+        the sync dispatch interval — chunking must not coarsen the replica
+        reconciliation cadence (config.dp_sync_every)."""
+        s = super()._resolve_chunk_len(batcher)
+        cfg = self.config
+        if self.dp * self.sp > 1 and cfg.dp_sync_every:
+            every = max(1, cfg.dp_sync_every // cfg.micro_steps)
+            s = min(s, every)
+            while every % s:  # syncs land exactly on per-step cadence
+                s -= 1
+        return s
+
+    def _build_chunk_fn(self):
+        return make_sharded_chunk(self.config, self.tables, self.mesh)
+
+    def _chunk_stream(self, batcher, epoch, skip, chunk_len):
+        """[S, DP*B, L] chunks: local_dp row blocks per global step, S global
+        steps per chunk; trailing partials padded with all-(-1) no-ops.
+        Mirrors _batches' grouping and the agreed per-epoch step limit."""
+        local_dp = self.dp // self.procs
+        limit = self._agreed_steps_per_epoch(batcher, local_dp)
+        emitted = min(skip, limit)
+        steps: list = []
+        words: list = []
+        buf: list = []
+        step_words = 0
+
+        def flush_chunk():
+            nonlocal steps, words
+            dead = np.full_like(steps[0], -1)
+            chunk = np.stack(steps + [dead] * (chunk_len - len(steps)))
+            out = (chunk, words)
+            steps, words = [], []
+            return out
+
+        for tokens, w in batcher.epoch(epoch, skip * local_dp):
+            buf.append(tokens)
+            step_words += w
+            if len(buf) == local_dp:
+                if emitted >= limit:
+                    break
+                steps.append(np.concatenate(buf, axis=0))
+                words.append(step_words)
+                emitted += 1
+                buf, step_words = [], 0
+                if len(steps) == chunk_len:
+                    yield flush_chunk()
+        if buf and emitted < limit:
+            pad = [np.full_like(buf[0], -1)] * (local_dp - len(buf))
+            steps.append(np.concatenate(buf + pad, axis=0))
+            words.append(step_words)
+        if steps:
+            yield flush_chunk()
+
+    def _place_chunk(self, np_chunk: np.ndarray, alphas: np.ndarray):
+        sharding = NamedSharding(self.mesh, P(None, DATA_AXIS, SEQ_AXIS))
+        if self.procs == 1:
+            tokens = jax.device_put(np_chunk, sharding)
+        else:
+            tokens = jax.make_array_from_process_local_data(sharding, np_chunk)
+        return tokens, jnp.asarray(alphas)
+
     def _place(self, local_rows: np.ndarray) -> jnp.ndarray:
         if self.procs == 1:
             return jax.device_put(local_rows, self.token_sharding)
@@ -360,9 +481,12 @@ class ShardedTrainer(Trainer):
         # dp_sync_every is calibrated in OPTIMIZER steps; with micro-stepping
         # one dispatch carries micro_steps of them, so the dispatch cadence
         # shrinks accordingly (else small-corpus auto geometry would stretch
-        # the replica-averaging window by up to 64x)
+        # the replica-averaging window by up to 64x). Distance-based rather
+        # than modulo so chunked dispatch (step += chunk_len) can't step
+        # over a boundary without syncing.
         every = max(1, cfg.dp_sync_every // cfg.micro_steps)
-        if self.dp * self.sp > 1 and cfg.dp_sync_every and state.step % every == 0:
+        since = state.step - (self._last_sync_step or 0)
+        if self.dp * self.sp > 1 and cfg.dp_sync_every and since >= every:
             state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
 

@@ -54,8 +54,8 @@ class TrainReport:
 class Trainer:
     """End-to-end single-chip trainer (multi-chip: parallel.ShardedTrainer)."""
 
-    #: chunked dispatch (config.chunk_steps) — ShardedTrainer overrides until
-    #: the scan-over-shard_map runner lands
+    #: chunked dispatch (config.chunk_steps) — subclasses without a chunk
+    #: runner set this False to force the per-step path
     supports_chunking = True
 
     def __init__(
@@ -267,10 +267,8 @@ class Trainer:
         at chunk boundaries.
         """
         cfg = self.config
-        from .ops.train_step import jit_chunk_runner
-
         if self.chunk_fn is None:
-            self.chunk_fn = jit_chunk_runner(cfg, self.tables)
+            self.chunk_fn = self._build_chunk_fn()
         self._last_chunk_loss = float("nan")
         pending: Optional[Tuple[Dict, int, int, float, int, bool]] = None
 
@@ -289,7 +287,7 @@ class Trainer:
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
             for np_chunk, words_list in prefetch(
-                chunk_batches(batcher.epoch(epoch, skip), chunk_len)
+                self._chunk_stream(batcher, epoch, skip, chunk_len)
             ):
                 alphas = np.empty(chunk_len, np.float32)
                 wd = state.words_done
@@ -337,6 +335,20 @@ class Trainer:
             final_loss=self._last_chunk_loss,
             loss_history=loss_hist,
         )
+
+    def _build_chunk_fn(self):
+        """The jitted chunk runner (sharded trainers build theirs over the
+        mesh)."""
+        from .ops.train_step import jit_chunk_runner
+
+        return jit_chunk_runner(self.config, self.tables)
+
+    def _chunk_stream(
+        self, batcher: BatchIterator, epoch: int, skip: int, chunk_len: int
+    ) -> Iterator[Tuple[np.ndarray, List[int]]]:
+        """Host-side [S, rows, L] chunk assembly for one epoch (sharded
+        trainers group dp row blocks per step before chunking)."""
+        return chunk_batches(batcher.epoch(epoch, skip), chunk_len)
 
     def _place_chunk(
         self, np_chunk: np.ndarray, alphas: np.ndarray

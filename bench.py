@@ -209,6 +209,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--text8", default="text8")
     ap.add_argument("--probe-timeout", type=float, default=90.0,
                     help="seconds to wait for backend init before CPU fallback")
+    ap.add_argument("--probe-retries", type=int, default=3,
+                    help="backend probe attempts (the tunnel flaps; a hang "
+                    "now does not mean a hang in two minutes)")
+    ap.add_argument("--probe-retry-wait", type=float, default=60.0,
+                    help="seconds between probe attempts")
     ap.add_argument("--run-timeout", type=float, default=3600.0,
                     help="watchdog for the measured run itself (the tunnel "
                     "can hang MID-run, after a successful probe)")
@@ -267,9 +272,15 @@ def main() -> None:
     platform_note = None
     force_cpu = args.cpu
     if not force_cpu:
-        ok, info = probe_backend(args.probe_timeout)
-        if not ok:
-            platform_note = info
+        for attempt in range(max(1, args.probe_retries)):
+            if attempt:
+                time.sleep(args.probe_retry_wait)
+            ok, info = probe_backend(args.probe_timeout)
+            if ok:
+                platform_note = None
+                break
+            platform_note = f"{info} (attempt {attempt + 1})"
+        else:
             force_cpu = True
 
     child_cmd = [sys.executable, os.path.abspath(__file__), "--inner"]

@@ -16,7 +16,6 @@ import jax.numpy as jnp
 
 from word2vec_tpu.config import Word2VecConfig
 from word2vec_tpu.data.batcher import PackedCorpus
-from word2vec_tpu.data.vocab import Vocab
 from word2vec_tpu.models.params import init_params
 from word2vec_tpu.ops.tables import DeviceTables
 from word2vec_tpu.ops.train_step import jit_chunk_runner, jit_train_step

@@ -15,7 +15,6 @@ jax = pytest.importorskip("jax")
 from word2vec_tpu.parallel import multihost
 from word2vec_tpu.parallel.mesh import make_mesh
 from word2vec_tpu.parallel.trainer import (
-    PARAM_SPEC,
     assemble_local_replica,
     replicate_params,
 )

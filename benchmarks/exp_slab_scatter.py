@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Experiment: eliminate the band kernel's overlap-add + layout copies by
+scattering context-side gradients directly from slab space.
+
+Today (ops/band_step.py) the context-side gradient path is
+    band_vs: [B,C,S,K] x [B,C,S,d] -> [B,C,K,d] -> _overlap_add -> [B,L,d]
+    -> reshape -> gather by shared sort order -> sorted scatter-add
+and the trace (benchmarks/trace_tools.py) shows the overlap-add chain drags
+~27% of step time in pure layout copies ({0,2,1} <-> {2,1,0} on [B,L,d]).
+
+Alternative: the scatter itself already sums duplicate indices, so the
+overlap-add is redundant — scatter the [B,C,K,d] slab gradients with the
+slab token ids [B,C,K] (built by the same _slabs shift that built the slab
+operands). Cost: (S+2W)/S more scatter rows and losing the shared sort;
+benefit: no overlap-add, no layout copies on the context path.
+
+This times both formulations in isolation on the current device. Run on TPU
+when the tunnel is up; if (b) wins, restructure band_step accordingly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=256)
+    ap.add_argument("--len", dest="length", type=int, default=192)
+    ap.add_argument("--dim", type=int, default=300)
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--vocab", type=int, default=71000)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from word2vec_tpu.ops import banded
+
+    B, L, d, W, V = args.rows, args.length, args.dim, args.window, args.vocab
+    S = banded.resolve_chunk(L, W, 0)
+    C, P = banded._geom(L, W, S)
+    K = S + 2 * W
+    rng = np.random.default_rng(0)
+    tok = jnp.asarray(rng.integers(0, V, size=(B, L), dtype=np.int32))
+    scores = jnp.asarray(rng.normal(size=(B, C, S, K)).astype(np.float32))
+    u = jnp.asarray(rng.normal(size=(B, L, d)).astype(np.float32))
+    table = jnp.zeros((V, d), jnp.float32)
+    cdt = jnp.bfloat16
+
+    @jax.jit
+    def path_overlap_sorted(table, scores, u, tok):
+        g = banded.band_vs(scores, u, W, S, cdt)  # [B, L, d] via overlap-add
+        flat = tok.reshape(-1)
+        order = jnp.argsort(flat)
+        vals = g.reshape(-1, d)[order]
+        return table.at[flat[order]].add(vals, indices_are_sorted=True)
+
+    @jax.jit
+    def path_slab_scatter(table, scores, u, tok):
+        # same contraction, no overlap-add: scatter straight from slab space
+        u_c = banded._pad_rows(u, C * S).reshape(B, C, S, d)
+        y = jnp.einsum(
+            "bcsk,bcsd->bckd", scores.astype(cdt), u_c.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )  # [B, C, K, d]
+        # slab ids: the same shifted view of the padded token row; invalid
+        # slab slots (halo beyond the row) get id 0 with zeroed values
+        tok_pad = jnp.pad(tok, ((0, 0), (W, P - L - W)), constant_values=-1)
+        ids = banded._slabs(tok_pad, C, S, 2 * W)  # [B, C, K]
+        ok = ids >= 0
+        vals = jnp.where(ok[..., None], y, 0.0).reshape(-1, d)
+        return table.at[jnp.where(ok, ids, 0).reshape(-1)].add(vals)
+
+    @jax.jit
+    def path_slab_sorted(table, scores, u, tok):
+        u_c = banded._pad_rows(u, C * S).reshape(B, C, S, d)
+        y = jnp.einsum(
+            "bcsk,bcsd->bckd", scores.astype(cdt), u_c.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+        tok_pad = jnp.pad(tok, ((0, 0), (W, P - L - W)), constant_values=-1)
+        ids = banded._slabs(tok_pad, C, S, 2 * W)
+        ok = ids >= 0
+        flat = jnp.where(ok, ids, 0).reshape(-1)
+        order = jnp.argsort(flat)
+        vals = jnp.where(ok[..., None], y, 0.0).reshape(-1, d)[order]
+        return table.at[flat[order]].add(vals, indices_are_sorted=True)
+
+    def bench(name, fn):
+        out = jax.block_until_ready(fn(table, scores, u, tok))
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = fn(table, scores, u, tok)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.steps * 1e3
+        print(f"  {name:<34s} {dt:8.3f} ms")
+        return out
+
+    print(f"B={B} L={L} d={d} W={W} S={S} C={C} slab_rows={B*C*K} "
+          f"dense_rows={B*L} device={jax.devices()[0].device_kind}")
+    a = bench("overlap-add + sorted scatter", path_overlap_sorted)
+    b = bench("slab scatter (unsorted)", path_slab_scatter)
+    c = bench("slab scatter (sorted)", path_slab_sorted)
+    for name, x in [("slab-unsorted", b), ("slab-sorted", c)]:
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(x), atol=2e-2,
+            err_msg=f"{name} result mismatch",
+        )
+    print("  results agree (atol 2e-2, bf16 matmul)")
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,16 @@ class Word2VecConfig:
     # device step time; see bench.py).
     chunk_steps: int = 1
 
+    # Band kernel, chunked representation only: scatter context-side
+    # gradients directly from slab space ([B, C, S+2W, d] with slab token
+    # ids) instead of overlap-adding back to [B, L, d] first. The scatter's
+    # duplicate-index summing performs the overlap-add implicitly, skipping
+    # the pad/add/slice chain whose layout copies cost ~27% of step time on
+    # TPU (benchmarks/trace_tools.py, exp_slab_scatter.py). Numerically
+    # identical in f32 (summation reassociation only; pinned by
+    # tests/test_band_step_golden.py). Trade: (S+2W)/S more scatter rows.
+    slab_scatter: bool = False
+
     # --- multi-chip (no reference counterpart; replaces OpenMP Hogwild) ---
     # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
     dp_sync_every: int = 64

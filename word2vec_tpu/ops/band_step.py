@@ -130,6 +130,7 @@ def make_band_train_step(
     is_cbow = config.model == "cbow"
     cbow_mean = config.cbow_mean
     scatter_mean = config.scatter_mean
+    slab_scatter = config.slab_scatter
     cdt = jnp.dtype(config.compute_dtype)
 
     def psum(x):
@@ -169,6 +170,10 @@ def make_band_train_step(
         S = banded.resolve_chunk(L, W, config.band_chunk)
         band_f = banded.band_mask(keep, valid, w_eff, W, S).astype(jnp.float32)
         n_ctx = banded.band_row_sum(band_f, L)  # [B, L] contexts per center
+        # context-side gradients can stay in slab space and let the scatter
+        # perform the overlap-add (config.slab_scatter; chunked repr only)
+        use_slab = slab_scatter and S > 0
+        d_ctx_slab = ctx_w_slab = None
 
         emb_in = params["emb_in"]
         emb_out = params["emb_out_ns"]
@@ -233,7 +238,13 @@ def make_band_train_step(
             gp = (1.0 - jax.nn.sigmoid(plog)) * band_f * alpha  # label 1
             d_h = d_h + banded.band_sv(gp, eout, W, S, cdt)
             # per-context-position grad (fans to the output matrix rows)
-            d_out_pos = banded.band_vs(gp, ein, W, S, cdt)
+            if use_slab:
+                d_ctx_slab = banded.band_vs_slab(gp, ein, W, S, cdt)
+                ctx_w_slab = banded.band_col_sum_slab(band_f)
+                d_out_pos = out_weight = None
+            else:
+                d_out_pos = banded.band_vs(gp, ein, W, S, cdt)
+                out_weight = banded.band_col_sum(band_f, L, W, S)
             d_in_pos = d_h  # accumulated on the center row (W.row += grad, :351)
             pos_loss = -banded.band_loss_sum(band_f * jax.nn.log_sigmoid(plog))
             pos_pairs = banded.band_loss_sum(band_f)
@@ -242,7 +253,6 @@ def make_band_train_step(
             # in the reference (no ns calls run), so it contributes 0; a
             # context position contributes one unit per center predicting it
             in_weight = (keep & (n_ctx > 0)).astype(jnp.float32)
-            out_weight = banded.band_col_sum(band_f, L, W, S)
         else:
             # positive target = the center word on the output matrix, :304-311
             plog = psum(
@@ -260,46 +270,82 @@ def make_band_train_step(
             # fan d_h back to contributing context rows (Word2Vec.cpp:313-315)
             if cbow_mean:
                 d_h = d_h / jnp.maximum(n_ctx, 1.0)[:, :, None]
-            d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
+            if use_slab:
+                d_ctx_slab = banded.band_vs_slab(band_f, d_h, W, S, cdt)
+                ctx_w_slab = banded.band_col_sum_slab(band_f)
+                d_in_pos = in_weight = None
+            else:
+                d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
+                in_weight = banded.band_col_sum(band_f, L, W, S)
             pos_loss = -jnp.sum(active * jax.nn.log_sigmoid(plog))
             pos_pairs = jnp.sum(active)
             # scatter_mean weights (pair-kernel counting): each context row of
             # emb_in contributes one unit per center it serves; each center
             # contributes one unit on emb_out
-            in_weight = banded.band_col_sum(band_f, L, W, S)
             out_weight = active
 
-        # ---- scatters: one shared sort of the row token ids
+        # ---- scatters: one shared sort of the row token ids; with
+        # use_slab the context-side table instead takes an unsorted scatter
+        # of slab-space values over slab token ids (whose duplicate-index
+        # summing is the overlap-add, banded.slab_token_ids)
         flat = tok.reshape(-1)
         order = jnp.argsort(flat)
         sorted_idx = flat[order]
-        d_in_flat = d_in_pos.reshape(-1, d_in_pos.shape[-1])[order]
-        d_out_flat = d_out_pos.reshape(-1, d_out_pos.shape[-1])[order]
         flat_negs = negs.reshape(-1)
         d_neg_flat = d_neg.reshape(-1, d_neg.shape[-1])
+        if use_slab:
+            slab_ids = banded.slab_token_ids(tok, W, S)  # [B, C, S+2W]
+            slab_ok = slab_ids >= 0
+            slab_flat = jnp.where(slab_ok, slab_ids, 0).reshape(-1)
+            d_ctx_flat = jnp.where(slab_ok[..., None], d_ctx_slab, 0.0).reshape(
+                -1, d_ctx_slab.shape[-1]
+            )
+            ctx_w_flat = jnp.where(slab_ok, ctx_w_slab, 0.0).reshape(-1)
+
+        # emb_in side: dense center rows (sg) or context rows (cbow, slab-able)
+        if d_in_pos is not None:
+            d_in_flat = d_in_pos.reshape(-1, d_in_pos.shape[-1])[order]
+            if scatter_mean:
+                # per-contribution counts, as in the pair kernel
+                d_in_flat = d_in_flat * _dup_mean_scale(
+                    emb_in.shape[0], sorted_idx,
+                    in_weight.reshape(-1)[order],
+                )[:, None]
+            new_in = emb_in.at[sorted_idx].add(
+                d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
+            )
+        else:  # cbow + slab: context grads scatter from slab space
+            vals = d_ctx_flat
+            if scatter_mean:
+                vals = vals * _dup_mean_scale(
+                    emb_in.shape[0], slab_flat, ctx_w_flat
+                )[:, None]
+            new_in = emb_in.at[slab_flat].add(vals.astype(emb_in.dtype))
+
+        # emb_out side: context rows (sg, slab-able) or center rows (cbow),
+        # plus the shared-negative rows; under scatter_mean both share ONE
+        # joint count so a word serving both roles is normalized by its total
+        # contribution count (a drawn negative counts its expected per-pair
+        # draws, w_neg summed over centers)
+        if d_out_pos is not None:
+            out_idx, out_sorted = sorted_idx, True
+            d_out_flat = d_out_pos.reshape(-1, d_out_pos.shape[-1])[order]
+            cnt_idx, cnt_w = flat, out_weight.reshape(-1)
+        else:  # sg + slab
+            out_idx, out_sorted = slab_flat, False
+            d_out_flat = d_ctx_flat
+            cnt_idx, cnt_w = slab_flat, ctx_w_flat
         if scatter_mean:
-            # emb_in: per-contribution counts, as in the pair kernel
-            d_in_flat = d_in_flat * _dup_mean_scale(
-                emb_in.shape[0], sorted_idx,
-                in_weight.reshape(-1)[order],
-            )[:, None]
-            # emb_out: ONE joint count over positive positions and shared
-            # negative draws, so a word serving both roles is normalized by
-            # its total contribution count (a drawn negative counts its
-            # expected per-pair draws, w_neg summed over centers)
             cnt = (
                 jnp.zeros((emb_out.shape[0],), jnp.float32)
-                .at[flat].add(out_weight.reshape(-1))
+                .at[cnt_idx].add(cnt_w)
                 .at[flat_negs].add(w_neg.sum(axis=1).reshape(-1))
             )
             inv = 1.0 / jnp.maximum(cnt, 1.0)
-            d_out_flat = d_out_flat * inv[sorted_idx][:, None]
+            d_out_flat = d_out_flat * inv[out_idx][:, None]
             d_neg_flat = d_neg_flat * inv[flat_negs][:, None]
-        new_in = emb_in.at[sorted_idx].add(
-            d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
-        )
-        new_out = emb_out.at[sorted_idx].add(
-            d_out_flat.astype(emb_out.dtype), indices_are_sorted=True
+        new_out = emb_out.at[out_idx].add(
+            d_out_flat.astype(emb_out.dtype), indices_are_sorted=out_sorted
         )
         # negative-row scatter (KP rows per batch row; duplicates sum)
         new_out = new_out.at[flat_negs].add(d_neg_flat.astype(emb_out.dtype))

@@ -233,6 +233,49 @@ def band_vs(
     return _overlap_add(y, S, 2 * W)[:, W : W + L]
 
 
+def band_vs_slab(
+    scores: jnp.ndarray, u: jnp.ndarray, W: int, S: int, cdt
+) -> jnp.ndarray:
+    """band_vs WITHOUT the overlap-add: returns slab-space [B, C, S+2W, d].
+
+    Intended for consumers that scatter-add by token id anyway — the
+    scatter's duplicate-index summing performs the overlap-add for free
+    (slab slots of adjacent chunks that alias the same position carry the
+    same token id, see slab_token_ids). Skips the pad/add/slice chain whose
+    layout copies dominate band_vs on TPU (benchmarks/exp_slab_scatter.py).
+    Chunked representation only (S > 0).
+    """
+    if S == 0:
+        raise ValueError("band_vs_slab requires the chunked representation")
+    L = u.shape[1]
+    C, _ = _geom(L, W, S)
+    u_c = _pad_rows(u, C * S).reshape(u.shape[0], C, S, u.shape[2])
+    return jnp.einsum(
+        "bcsk,bcsd->bckd",
+        scores.astype(cdt),
+        u_c.astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def slab_token_ids(tok: jnp.ndarray, W: int, S: int) -> jnp.ndarray:
+    """[B, L] token ids -> [B, C, S+2W] id per slab slot; -1 where the slot
+    falls outside the row (left halo of chunk 0, beyond-row tail). A padded
+    position aliased by two adjacent chunks' slabs gets the same id in both
+    slots — scatter-adds over these ids therefore sum exactly the slots
+    _overlap_add would have summed."""
+    L = tok.shape[1]
+    C, P = _geom(L, W, S)
+    tok_pad = jnp.pad(tok, ((0, 0), (W, P - L - W)), constant_values=-1)
+    return _slabs(tok_pad, C, S, 2 * W)
+
+
+def band_col_sum_slab(scores: jnp.ndarray) -> jnp.ndarray:
+    """Per-slab-slot column sum [B, C, S+2W] (the pre-overlap-add form of
+    band_col_sum; pairs with slab_token_ids for by-id accumulation)."""
+    return scores.sum(axis=2)
+
+
 def band_row_sum(scores: jnp.ndarray, L: int) -> jnp.ndarray:
     """sum_j scores[i, j] -> [B, L] (e.g. contexts per center)."""
     if scores.ndim == 3:

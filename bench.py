@@ -102,6 +102,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         subsample_threshold=1e-4,
         batch_rows=args.batch_rows,
         max_sentence_len=args.max_len,
+        slab_scatter=bool(args.slab_scatter),
     )
 
     if os.path.exists(args.text8):
@@ -204,6 +205,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--max-len", type=int, default=192)
     ap.add_argument("--chunk-cap", type=int, default=32,
                     help="max optimizer steps fused per dispatch")
+    ap.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
+                    help="band kernel slab-space context scatter (A/B knob)")
     ap.add_argument("--measure-steps", type=int, default=0,
                     help="0 = one full epoch (rounded up to whole chunks)")
     ap.add_argument("--text8", default="text8")
@@ -290,7 +293,7 @@ def main() -> None:
         ("--tokens", args.tokens), ("--dim", args.dim),
         ("--window", args.window), ("--negative", args.negative),
         ("--batch-rows", args.batch_rows), ("--max-len", args.max_len),
-        ("--chunk-cap", args.chunk_cap),
+        ("--chunk-cap", args.chunk_cap), ("--slab-scatter", args.slab_scatter),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:
         child_cmd += [flag, str(val)]

@@ -87,3 +87,45 @@ def test_slab_scatter_noop_on_dense_representation():
     out, m = step(params, tokens, jax.random.key(2), jnp.float32(0.025))
     assert np.all(np.isfinite(np.asarray(out["emb_in"])))
     assert float(m["pairs"]) > 0
+
+
+@pytest.mark.parametrize("scatter_mean", [False, True])
+def test_slab_scatter_matches_overlap_add_hs_cbow(scatter_mean):
+    """Same equivalence for the hs fast kernel's cbow context fan-out."""
+    from word2vec_tpu.data.huffman import build_huffman
+    from word2vec_tpu.ops.hs_step import make_hs_train_step
+
+    counts = np.arange(2 * V, V, -1).astype(np.int64)
+    hf = build_huffman(counts)
+
+    def build(slab):
+        cfg = Word2VecConfig(
+            model="cbow", train_method="hs", negative=0, word_dim=D,
+            window=3, min_count=1, subsample_threshold=0,
+            compute_dtype="float32", max_sentence_len=40, band_chunk=10,
+            slab_scatter=slab, scatter_mean=scatter_mean,
+        )
+        tables = DeviceTables(
+            jnp.ones(V, jnp.float32), None, None,
+            jnp.asarray(hf.codes), jnp.asarray(hf.points),
+            jnp.asarray(hf.code_len),
+        )
+        return cfg, jax.jit(make_hs_train_step(cfg, tables))
+
+    rng = np.random.default_rng(11)
+    tokens = jnp.asarray(rng.integers(0, V, size=(6, 40)).astype(np.int32))
+    tokens = tokens.at[1, 25:].set(-1)
+    key = jax.random.key(3)
+    alpha = jnp.float32(0.03)
+
+    cfg_a, step_a = build(slab=False)
+    _, step_b = build(slab=True)
+    params = init_params(cfg_a, V, jax.random.key(7))
+    out_a, m_a = step_a(dict(params), tokens, key, alpha)
+    out_b, m_b = step_b(dict(params), tokens, key, alpha)
+    for k in out_a:
+        np.testing.assert_allclose(
+            np.asarray(out_a[k]), np.asarray(out_b[k]), atol=1e-5, rtol=1e-5,
+            err_msg=k,
+        )
+    assert float(m_a["pairs"]) == float(m_b["pairs"])

@@ -104,6 +104,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="dot-product dtype; float32 for reference-exact scores")
     p.add_argument("--shared-negatives", type=int, default=64,
                    help="shared negative draws per batch row (band kernel)")
+    p.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
+                   help="band kernel: scatter context grads from slab space "
+                        "(skips the overlap-add; config.slab_scatter)")
     p.add_argument("--max-sentence-len", type=int, default=192)
     p.add_argument("--corpus-format", choices=["text8", "lines"], default="text8",
                    help="text8: 1000-word chunks (main.cpp:63-92); "
@@ -210,6 +213,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             compute_dtype=args.compute_dtype,
             shared_negatives=args.shared_negatives,
             scatter_mean=bool(args.scatter_mean),
+            slab_scatter=bool(args.slab_scatter),
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

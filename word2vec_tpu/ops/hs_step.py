@@ -218,18 +218,37 @@ def make_hs_train_step(
             # fan d_h to context rows (second /n under cbow_mean, :313-315)
             if cbow_mean:
                 d_h = d_h / jnp.maximum(n_ctx, 1.0)[:, :, None]
-            d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
-            flat_c = tok.reshape(-1)
-            order = jnp.argsort(flat_c)
-            d_in_flat = d_in_pos.reshape(-1, d_in_pos.shape[-1])[order]
-            if scatter_mean:
-                d_in_flat = d_in_flat * _dup_mean_scale(
-                    emb_in.shape[0], flat_c[order],
-                    banded.band_col_sum(band_f, L, W, S).reshape(-1)[order],
-                )[:, None]
-            new_in = emb_in.at[flat_c[order]].add(
-                d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
-            )
+            if config.slab_scatter and S > 0:
+                # slab-space scatter: the table scatter's duplicate-index
+                # summing performs the overlap-add (band_step.py, same knob)
+                d_in_slab = banded.band_vs_slab(band_f, d_h, W, S, cdt)
+                slab_ids = banded.slab_token_ids(tok, W, S)
+                ok = slab_ids >= 0
+                sflat = jnp.where(ok, slab_ids, 0).reshape(-1)
+                vals = jnp.where(ok[..., None], d_in_slab, 0.0).reshape(
+                    -1, d_in_slab.shape[-1]
+                )
+                if scatter_mean:
+                    w = jnp.where(
+                        ok, banded.band_col_sum_slab(band_f), 0.0
+                    ).reshape(-1)
+                    vals = vals * _dup_mean_scale(
+                        emb_in.shape[0], sflat, w
+                    )[:, None]
+                new_in = emb_in.at[sflat].add(vals.astype(emb_in.dtype))
+            else:
+                d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
+                flat_c = tok.reshape(-1)
+                order = jnp.argsort(flat_c)
+                d_in_flat = d_in_pos.reshape(-1, d_in_pos.shape[-1])[order]
+                if scatter_mean:
+                    d_in_flat = d_in_flat * _dup_mean_scale(
+                        emb_in.shape[0], flat_c[order],
+                        banded.band_col_sum(band_f, L, W, S).reshape(-1)[order],
+                    )[:, None]
+                new_in = emb_in.at[flat_c[order]].add(
+                    d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
+                )
 
             flat_p = paths.reshape(-1)
             porder = jnp.argsort(flat_p)

@@ -1,0 +1,33 @@
+#!/bin/bash
+# One-shot TPU measurement backlog (run when the tunnel is up).
+# Captures every pending on-chip number for round 2 into benchmarks/TPU_R2/.
+# Each step is independently time-boxed; a tunnel hang mid-run skips to the
+# next item rather than wedging the whole sweep.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/TPU_R2
+mkdir -p "$OUT"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name: $*" | tee -a "$OUT/log.txt"
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>&1
+  echo "rc=$? $(tail -1 "$OUT/$name.out")" | tee -a "$OUT/log.txt"
+}
+
+# 1. headline bench, chunked dispatch (overlap-add vs slab scatter A/B)
+run bench_default      900 python bench.py
+run bench_slab         900 python bench.py --slab-scatter 1
+# 2. geometry exploration (fixed-cost amortization)
+run bench_rows512      900 python bench.py --batch-rows 512
+run bench_len384       900 python bench.py --max-len 384
+run bench_slab_rows512 900 python bench.py --slab-scatter 1 --batch-rows 512
+# 3. isolated slab-scatter experiment + kernel ablation
+run exp_slab           600 python benchmarks/exp_slab_scatter.py
+run ablate             900 python benchmarks/ablate.py
+# 4. op-level traces for both scatter modes
+run trace_default      600 python benchmarks/trace_tools.py capture --out /tmp/tr_default
+run trace_report       300 python benchmarks/trace_tools.py report /tmp/tr_default
+# 5. scale rehearsal: sustained run at the BASELINE config-4 shape
+run bench_100m        1800 python bench.py --tokens 100000000 --window 10
+echo "backlog complete; results in $OUT/" | tee -a "$OUT/log.txt"

@@ -122,6 +122,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="google questions-words.txt for post-train eval")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=100)
+    p.add_argument("--log-jsonl", metavar="FILE",
+                   help="append machine-readable JSONL log records to FILE")
+    p.add_argument("--tensorboard", metavar="DIR",
+                   help="write TensorBoard scalar summaries to DIR "
+                        "(loss/alpha/words_per_sec/progress)")
     p.add_argument("--profile", metavar="DIR",
                    help="capture a jax.profiler trace of training into DIR "
                         "(view with tensorboard/xprof)")
@@ -318,6 +323,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
 
     log_fn = None if args.quiet else progress_logger()
+    if args.log_jsonl or args.tensorboard:
+        from .utils.logging import jsonl_logger, tee, tensorboard_logger
+
+        log_fn = tee(
+            log_fn,
+            jsonl_logger(args.log_jsonl) if args.log_jsonl else None,
+            tensorboard_logger(args.tensorboard) if args.tensorboard else None,
+        )
     if args.dp * args.tp * args.sp > 1:
         from .parallel import ShardedTrainer
 

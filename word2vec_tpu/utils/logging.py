@@ -37,6 +37,25 @@ def jsonl_logger(path: str) -> Callable[[Dict], None]:
     return log
 
 
+def tensorboard_logger(logdir: str) -> Callable[[Dict], None]:
+    """Scalar summaries (loss, alpha, words/sec, progress) per step for
+    TensorBoard — the SURVEY §5 "optional TensorBoard scalars" hook. Uses
+    tensorboardX, which writes standard event files without a TF dependency.
+    """
+    from tensorboardX import SummaryWriter
+
+    writer = SummaryWriter(logdir)
+
+    def log(m: Dict) -> None:
+        step = int(m.get("step", 0))
+        for key in ("loss", "alpha", "words_per_sec", "progress"):
+            if key in m:
+                writer.add_scalar(f"train/{key}", float(m[key]), step)
+        writer.flush()
+
+    return log
+
+
 def tee(*loggers: Optional[Callable[[Dict], None]]) -> Callable[[Dict], None]:
     active = [l for l in loggers if l is not None]
 

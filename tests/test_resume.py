@@ -132,3 +132,61 @@ def test_epoch_boundary_checkpoint_resume(tmp_path):
             np.asarray(resumed_state.params[k]),
             rtol=0, atol=1e-6, err_msg=k,
         )
+
+
+def test_sharded_mid_epoch_resume_matches(tmp_path):
+    """Mid-epoch resume on the dp x tp mesh (chunked dispatch) reproduces
+    the uninterrupted sharded run."""
+    import jax as _jax
+
+    if len(_jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    def make():
+        cfg = Word2VecConfig(
+            model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+            batch_rows=4, max_sentence_len=12, min_count=1, iters=3, seed=9,
+            dp_sync_every=4, chunk_steps=0,
+        )
+        vocab = zipf_vocab(40, 4000)
+        ids = zipf_corpus_ids(vocab, 2400, seed=5)
+        corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+        return cfg, vocab, corpus
+
+    cfg, vocab, corpus = make()
+    tr_full = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2)
+    full_state, _ = tr_full.train(log_every=0)
+    full = tr_full.export_params(full_state)
+
+    ck_dir = str(tmp_path / "ck")
+    captured = {}
+    tr_a = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2)
+
+    def cb(state):
+        if not captured and state.epoch >= 1:
+            # persist the UNREPLICATED tables like the CLI does
+            from word2vec_tpu.train import TrainState
+
+            host = TrainState(
+                params={k: np.asarray(v[0]) for k, v in state.params.items()},
+                step=state.step, words_done=state.words_done,
+                epoch=state.epoch,
+            )
+            save_checkpoint(ck_dir, host, cfg, vocab)
+            captured["step"] = state.step
+
+    tr_a.train(log_every=0, checkpoint_cb=cb, checkpoint_every=3)
+    assert captured
+
+    state, ck_cfg, ck_vocab = load_checkpoint(ck_dir)
+    tr_b = ShardedTrainer(ck_cfg, ck_vocab, corpus, dp=2, tp=2)
+    tr_b.import_params(state.params, state)
+    resumed_state, _ = tr_b.train(state=state, log_every=0)
+    resumed = tr_b.export_params(resumed_state)
+
+    assert resumed_state.step == full_state.step
+    for k in full:
+        np.testing.assert_allclose(
+            full[k], resumed[k], rtol=0, atol=1e-5, err_msg=k
+        )

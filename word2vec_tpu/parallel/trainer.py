@@ -407,17 +407,27 @@ class ShardedTrainer(Trainer):
 
     # ------------------------------------------------------ chunked hooks
     def _resolve_chunk_len(self, batcher: BatchIterator) -> int:
-        """Sync runs at chunk boundaries, so the chunk length is capped at
-        the sync dispatch interval — chunking must not coarsen the replica
-        reconciliation cadence (config.dp_sync_every)."""
-        s = super()._resolve_chunk_len(batcher)
+        """Chunk length in GLOBAL steps, from the cross-process AGREED epoch
+        length — deriving it from the local batch count (the base class's
+        unit) would let processes with different shard sizes pick different
+        chunk lengths and desynchronize the collective cadence. Sync runs at
+        chunk boundaries, so the length is additionally capped to a divisor
+        of the sync dispatch interval (reconciliation cadence unchanged)."""
         cfg = self.config
+        if not self.supports_chunking or cfg.chunk_steps == 1:
+            return 1
+        local_dp = self.dp // self.procs
+        steps = self._agreed_steps_per_epoch(batcher, local_dp)
+        if cfg.chunk_steps == 0:
+            s, _ = cfg.chunk_geometry(steps)
+        else:
+            s = min(cfg.chunk_steps, steps)
         if self.dp * self.sp > 1 and cfg.dp_sync_every:
             every = max(1, cfg.dp_sync_every // cfg.micro_steps)
             s = min(s, every)
             while every % s:  # syncs land exactly on per-step cadence
                 s -= 1
-        return s
+        return max(1, s)
 
     def _build_chunk_fn(self):
         return make_sharded_chunk(self.config, self.tables, self.mesh)

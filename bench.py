@@ -103,6 +103,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         batch_rows=args.batch_rows,
         max_sentence_len=args.max_len,
         slab_scatter=bool(args.slab_scatter),
+        shared_negatives=args.kp,
     )
 
     if os.path.exists(args.text8):
@@ -207,6 +208,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="max optimizer steps fused per dispatch")
     ap.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                     help="band kernel slab-space context scatter (A/B knob)")
+    ap.add_argument("--kp", type=int, default=64,
+                    help="shared negative draws per row (accuracy holds to "
+                    "KP=8 on the parity harness; PERF.md)")
     ap.add_argument("--measure-steps", type=int, default=0,
                     help="0 = one full epoch (rounded up to whole chunks)")
     ap.add_argument("--text8", default="text8")
@@ -294,6 +298,7 @@ def main() -> None:
         ("--window", args.window), ("--negative", args.negative),
         ("--batch-rows", args.batch_rows), ("--max-len", args.max_len),
         ("--chunk-cap", args.chunk_cap), ("--slab-scatter", args.slab_scatter),
+        ("--kp", args.kp),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:
         child_cmd += [flag, str(val)]

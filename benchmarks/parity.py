@@ -103,6 +103,8 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--kernel", choices=["auto", "band", "pair"], default="auto",
                     help="device kernel for OUR side (reference has no analog)")
+    ap.add_argument("--shared-negatives", type=int, default=64,
+                    help="band-kernel shared draws per row for OUR side")
     ap.add_argument("--skip-reference", action="store_true",
                     help="evaluate only this framework (no g++/reference)")
     args = ap.parse_args()
@@ -119,7 +121,8 @@ def main() -> None:
     result = {
         "config": f"{args.model}+{args.train_method} k={args.negative} "
         f"dim={args.dim} w={args.window} iter={args.iters} "
-        f"subsample={args.subsample} kernel={args.kernel}",
+        f"subsample={args.subsample} kernel={args.kernel} "
+        f"kp={args.shared_negatives}",
         "corpus": f"topic-synthetic-{args.tokens} tokens",
     }
     with tempfile.TemporaryDirectory() as tmp:
@@ -149,6 +152,7 @@ def main() -> None:
                 sys.executable, "-m", "word2vec_tpu.cli", *common,
                 "-output", "vec_ours.txt", "--backend", "cpu", "--quiet",
                 "--kernel", args.kernel,
+                "--shared-negatives", str(args.shared_negatives),
             ],
             cwd=tmp, check=True, capture_output=True,
             env={**os.environ, "PYTHONPATH": REPO + os.pathsep

@@ -22,6 +22,9 @@ run bench_slab         900 python bench.py --slab-scatter 1
 run bench_rows512      900 python bench.py --batch-rows 512
 run bench_len384       900 python bench.py --max-len 384
 run bench_slab_rows512 900 python bench.py --slab-scatter 1 --batch-rows 512
+# 2b. shared-negative width (parity holds to KP=8 on the harness)
+run bench_kp32         900 python bench.py --slab-scatter 1 --kp 32
+run bench_kp16         900 python bench.py --slab-scatter 1 --kp 16
 # 3. isolated slab-scatter experiment + kernel ablation
 run exp_slab           600 python benchmarks/exp_slab_scatter.py
 run ablate             900 python benchmarks/ablate.py

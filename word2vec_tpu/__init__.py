@@ -23,7 +23,12 @@ from .data.negative import AliasTable, build_alias_table
 from .data.vocab import Vocab
 from .models.params import export_matrix, init_params
 from .ops.tables import DeviceTables
-from .ops.train_step import jit_train_step, make_train_step
+from .ops.train_step import (
+    jit_chunk_runner,
+    jit_train_step,
+    make_chunk_runner,
+    make_train_step,
+)
 from .train import Trainer, TrainReport, TrainState
 
 __version__ = "0.1.0"
@@ -42,6 +47,8 @@ __all__ = [
     "export_matrix",
     "make_train_step",
     "jit_train_step",
+    "make_chunk_runner",
+    "jit_chunk_runner",
     "Trainer",
     "TrainState",
     "TrainReport",

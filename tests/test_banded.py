@@ -112,3 +112,39 @@ def test_band_dist_static():
     # row s=1, slab col k=3 -> global j = k - W + c*S; dist |s + W - k|
     assert dc[1, 3] == 0  # own position
     assert dc[1, 5] == 2
+
+
+def test_band_vs_slab_plus_overlap_equals_band_vs():
+    """band_vs == overlap_add(band_vs_slab): the slab form is exactly the
+    pre-overlap-add tensor."""
+    from word2vec_tpu.ops import banded
+
+    B, L, d, W, S = 3, 40, 8, 3, 10
+    rng = np.random.default_rng(0)
+    C, _ = banded._geom(L, W, S)
+    scores = jnp.asarray(rng.normal(size=(B, C, S, S + 2 * W)).astype(np.float32))
+    u = jnp.asarray(rng.normal(size=(B, L, d)).astype(np.float32))
+    dense = banded.band_vs(scores, u, W, S, jnp.float32)
+    slab = banded.band_vs_slab(scores, u, W, S, jnp.float32)
+    folded = banded._overlap_add(slab, S, 2 * W)[:, W : W + L]
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(folded), atol=1e-5)
+
+
+def test_slab_token_ids_alias_consistency():
+    """Every slab slot carries the token id of the padded position it
+    aliases; positions covered by two adjacent chunks agree; out-of-row
+    slots are -1."""
+    from word2vec_tpu.ops import banded
+
+    B, L, W, S = 2, 40, 3, 10
+    rng = np.random.default_rng(1)
+    tok = jnp.asarray(rng.integers(0, 50, size=(B, L)).astype(np.int32))
+    ids = np.asarray(banded.slab_token_ids(tok, W, S))  # [B, C, S+2W]
+    C = ids.shape[1]
+    tok_np = np.asarray(tok)
+    for b in range(B):
+        for c in range(C):
+            for k in range(S + 2 * W):
+                j = c * S + k - W  # unpadded position
+                expect = tok_np[b, j] if 0 <= j < L else -1
+                assert ids[b, c, k] == expect, (b, c, k, j)

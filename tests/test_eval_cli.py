@@ -1,0 +1,70 @@
+"""python -m word2vec_tpu.eval — the distance / compute-accuracy CLI the
+reference toolkit lacks (SURVEY §3.5)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.io.embeddings import save_embeddings_text
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args):
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"}
+    return subprocess.run(
+        [sys.executable, "-m", "word2vec_tpu.eval", *args],
+        env=env, capture_output=True, text=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def vec_file(tmp_path_factory):
+    rng = np.random.default_rng(0)
+    words = ["king", "queen", "man", "woman", "paris", "france",
+             "berlin", "germany"]
+    W = rng.normal(size=(8, 16)).astype(np.float32)
+    W[0] = W[2] + (W[1] - W[3]) + rng.normal(scale=0.01, size=16)
+    path = str(tmp_path_factory.mktemp("vec") / "v.txt")
+    save_embeddings_text(path, words, W)
+    return path
+
+
+def test_neighbors(vec_file):
+    r = _run(["neighbors", vec_file, "king", "-k", "3"])
+    assert r.returncode == 0, r.stderr
+    assert len(r.stdout.strip().splitlines()) == 3
+
+
+def test_neighbors_oov(vec_file):
+    r = _run(["neighbors", vec_file, "zebra"])
+    assert r.returncode == 1
+    assert "error" in r.stderr
+
+
+def test_analogy(vec_file):
+    r = _run(["analogy", vec_file, "man", "king", "woman"])
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.split()[0] == "queen"
+
+
+def test_ws353(vec_file, tmp_path):
+    pf = tmp_path / "pairs.csv"
+    pf.write_text("king,queen,9.0\nman,woman,8.5\nparis,germany,3.0\n")
+    r = _run(["ws353", vec_file, str(pf)])
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["pairs_used"] == 3
+
+
+def test_analogies(vec_file, tmp_path):
+    qf = tmp_path / "q.txt"
+    qf.write_text(": capital\nparis france berlin germany\n")
+    r = _run(["analogies", vec_file, str(qf)])
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["total"] == 1

@@ -1,0 +1,101 @@
+"""Eval CLI — the `distance` / `compute-accuracy` tools of the original
+word2vec toolkit, absent from the reference (SURVEY §3.5).
+
+    python -m word2vec_tpu.eval neighbors vec.txt france [-k 10]
+    python -m word2vec_tpu.eval analogy   vec.txt king man woman
+    python -m word2vec_tpu.eval ws353     vec.txt wordsim353.csv
+    python -m word2vec_tpu.eval analogies vec.txt questions-words.txt
+
+Vector files: the trainer's text or binary formats (io/embeddings —
+text is auto-detected; pass --binary/--binary-layout otherwise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+from ..data.vocab import Vocab
+from ..io.embeddings import load_embeddings_binary, load_embeddings_text
+from .analogy import evaluate_analogies
+from .neighbors import analogy_query, nearest_neighbors
+from .similarity import evaluate_pairs, load_word_pairs
+
+
+def _load(args) -> tuple:
+    if args.binary:
+        words, W = load_embeddings_binary(args.vectors, layout=args.binary_layout)
+    else:
+        words, W = load_embeddings_text(args.vectors)
+    vocab = Vocab(words, np.ones(len(words), dtype=np.int64))
+    return vocab, W
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="word2vec_tpu.eval")
+    ap.add_argument("--binary", action="store_true",
+                    help="vectors file is binary (default: text)")
+    ap.add_argument("--binary-layout", choices=["reference", "google"],
+                    default="reference")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("neighbors", help="top-k cosine neighbors (distance.c)")
+    p.add_argument("vectors")
+    p.add_argument("word")
+    p.add_argument("-k", type=int, default=10)
+
+    p = sub.add_parser("analogy", help="a:b :: c:? by 3CosAdd")
+    p.add_argument("vectors")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("c")
+    p.add_argument("-k", type=int, default=5)
+
+    p = sub.add_parser("ws353", help="Spearman vs a word-pair gold file")
+    p.add_argument("vectors")
+    p.add_argument("pairs_file")
+
+    p = sub.add_parser("analogies",
+                       help="google questions-words accuracy (compute-accuracy)")
+    p.add_argument("vectors")
+    p.add_argument("questions_file")
+
+    args = ap.parse_args(argv)
+    vocab, W = _load(args)
+
+    if args.cmd == "neighbors":
+        try:
+            for w, s in nearest_neighbors(W, vocab, args.word, k=args.k):
+                print(f"{w:<24s} {s:+.4f}")
+        except KeyError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    elif args.cmd == "analogy":
+        try:
+            for w, s in analogy_query(W, vocab, args.a, args.b, args.c, k=args.k):
+                print(f"{w:<24s} {s:+.4f}")
+        except KeyError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    elif args.cmd == "ws353":
+        res = evaluate_pairs(W, vocab, load_word_pairs(args.pairs_file))
+        print(json.dumps({
+            "spearman": res.spearman, "pearson": res.pearson,
+            "pairs_used": res.pairs_used, "pairs_total": res.pairs_total,
+        }))
+    elif args.cmd == "analogies":
+        res = evaluate_analogies(W, vocab, args.questions_file)
+        print(json.dumps({
+            "accuracy": res.accuracy,
+            "correct": res.correct,
+            "total": res.total,
+            "by_section": res.by_section,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,8 +81,16 @@ def eval_vectors(path: str, pairs, topic_of) -> dict:
             jj.append(idx[b])
             gold.append(s)
     cos = cosine_rows(W, np.asarray(ii), np.asarray(jj))
+    gold_arr = np.asarray(gold, np.float64)
+    hi = gold_arr >= np.median(gold_arr)
     return {
-        "spearman": round(spearman(cos, np.asarray(gold, np.float64)), 4),
+        "spearman": round(spearman(cos, gold_arr), 4),
+        # Spearman saturates at its tie-ceiling (~0.866 for the two-level
+        # gold) once the structure is fully recovered; the margin is the
+        # CONTINUOUS sensitivity metric — mean cosine separation between
+        # same-topic and cross-topic pairs — so small quality regressions
+        # remain visible after both sides hit the ceiling.
+        "cos_margin": round(float(cos[hi].mean() - cos[~hi].mean()), 4),
         "pairs_used": len(ii),
         "pairs_total": len(pairs),
         "neighbor_purity@10": round(neighbor_purity(words, W, topic_of), 4),
@@ -169,6 +177,9 @@ def main() -> None:
         result["delta_purity"] = round(
             result["ours"]["neighbor_purity@10"]
             - result["reference"]["neighbor_purity@10"], 4
+        )
+        result["delta_margin"] = round(
+            result["ours"]["cos_margin"] - result["reference"]["cos_margin"], 4
         )
     print(json.dumps(result))
 

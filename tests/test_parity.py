@@ -77,6 +77,14 @@ def test_eval_score_parity_with_reference(model, method, extra):
     # ...and agree with each other within small-corpus noise
     assert abs(result["delta_spearman"]) < 0.05, result
     assert abs(result["delta_purity"]) < 0.05, result
+    # The continuous metric (cos_margin, sensitive past the spearman
+    # tie-ceiling) must show clear structure separation. Its DELTA vs the
+    # reference is budget-dependent: at this reduced CI budget batched
+    # updates are still converging (cbow band measured -0.23 here yet
+    # +0.010 at the full 200k/dim64/5-iter budget — a convergence-speed
+    # artifact, not a kernel gap), so the absolute floor is the gate and
+    # full-budget deltas are tracked in benchmarks/PARITY_MATRIX_r2.txt.
+    assert result["ours"]["cos_margin"] > 0.3, result
 
 
 def test_cbow_hs_absolute_quality():

@@ -86,7 +86,9 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     import jax.numpy as jnp
 
     from word2vec_tpu.config import Word2VecConfig
-    from word2vec_tpu.data.batcher import BatchIterator, PackedCorpus, prefetch
+    from word2vec_tpu.data.batcher import (
+        BatchIterator, PackedCorpus, chunk_batches, prefetch,
+    )
     from word2vec_tpu.data.vocab import Vocab
     from word2vec_tpu.models.params import init_params
     from word2vec_tpu.ops.tables import DeviceTables
@@ -132,8 +134,6 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     S, _ = cfg.chunk_geometry(batcher.steps_per_epoch(), cap=args.chunk_cap)
     chunk_fn = jit_chunk_runner(cfg, tables)
     alphas = jnp.full((S,), cfg.init_alpha, jnp.float32)
-
-    from word2vec_tpu.data.batcher import chunk_batches
 
     # warmup / compile on a throwaway chunk
     warm = next(chunk_batches(batcher.epoch(), S))

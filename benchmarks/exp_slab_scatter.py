@@ -71,28 +71,17 @@ def main() -> None:
     @jax.jit
     def path_slab_scatter(table, scores, u, tok):
         # same contraction, no overlap-add: scatter straight from slab space
-        u_c = banded._pad_rows(u, C * S).reshape(B, C, S, d)
-        y = jnp.einsum(
-            "bcsk,bcsd->bckd", scores.astype(cdt), u_c.astype(cdt),
-            preferred_element_type=jnp.float32,
-        )  # [B, C, K, d]
-        # slab ids: the same shifted view of the padded token row; invalid
-        # slab slots (halo beyond the row) get id 0 with zeroed values
-        tok_pad = jnp.pad(tok, ((0, 0), (W, P - L - W)), constant_values=-1)
-        ids = banded._slabs(tok_pad, C, S, 2 * W)  # [B, C, K]
+        # (the production helpers, ops/banded.py)
+        y = banded.band_vs_slab(scores, u, W, S, cdt)  # [B, C, K, d]
+        ids = banded.slab_token_ids(tok, W, S)  # [B, C, K]
         ok = ids >= 0
         vals = jnp.where(ok[..., None], y, 0.0).reshape(-1, d)
         return table.at[jnp.where(ok, ids, 0).reshape(-1)].add(vals)
 
     @jax.jit
     def path_slab_sorted(table, scores, u, tok):
-        u_c = banded._pad_rows(u, C * S).reshape(B, C, S, d)
-        y = jnp.einsum(
-            "bcsk,bcsd->bckd", scores.astype(cdt), u_c.astype(cdt),
-            preferred_element_type=jnp.float32,
-        )
-        tok_pad = jnp.pad(tok, ((0, 0), (W, P - L - W)), constant_values=-1)
-        ids = banded._slabs(tok_pad, C, S, 2 * W)
+        y = banded.band_vs_slab(scores, u, W, S, cdt)
+        ids = banded.slab_token_ids(tok, W, S)
         ok = ids >= 0
         flat = jnp.where(ok, ids, 0).reshape(-1)
         order = jnp.argsort(flat)

@@ -57,6 +57,8 @@ class Trainer:
     #: chunked dispatch (config.chunk_steps) — subclasses without a chunk
     #: runner set this False to force the per-step path
     supports_chunking = True
+    #: loss of the most recently drained chunk (chunked driver's final_loss)
+    _last_chunk_loss: float = float("nan")
 
     def __init__(
         self,
@@ -355,8 +357,6 @@ class Trainer:
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Host chunk -> device arrays (sharded trainers override placement)."""
         return jnp.asarray(np_chunk), jnp.asarray(alphas)
-
-    _last_chunk_loss: float = float("nan")
 
     def _note_metrics(
         self,

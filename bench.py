@@ -106,6 +106,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         max_sentence_len=args.max_len,
         slab_scatter=bool(args.slab_scatter),
         shared_negatives=args.kp,
+        band_chunk=args.band_chunk,
     )
 
     if os.path.exists(args.text8):
@@ -211,6 +212,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--kp", type=int, default=64,
                     help="shared negative draws per row (accuracy holds to "
                     "KP=8 on the parity harness; PERF.md)")
+    ap.add_argument("--band-chunk", type=int, default=0,
+                    help="band slab row-chunk S (0 = auto; ops/banded.py)")
     ap.add_argument("--measure-steps", type=int, default=0,
                     help="0 = one full epoch (rounded up to whole chunks)")
     ap.add_argument("--text8", default="text8")
@@ -298,7 +301,7 @@ def main() -> None:
         ("--window", args.window), ("--negative", args.negative),
         ("--batch-rows", args.batch_rows), ("--max-len", args.max_len),
         ("--chunk-cap", args.chunk_cap), ("--slab-scatter", args.slab_scatter),
-        ("--kp", args.kp),
+        ("--kp", args.kp), ("--band-chunk", args.band_chunk),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:
         child_cmd += [flag, str(val)]

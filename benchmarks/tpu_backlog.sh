@@ -22,6 +22,9 @@ run bench_slab         900 python bench.py --slab-scatter 1
 run bench_rows512      900 python bench.py --batch-rows 512
 run bench_len384       900 python bench.py --max-len 384
 run bench_slab_rows512 900 python bench.py --slab-scatter 1 --batch-rows 512
+# 2a2. band slab geometry (auto S=118 vs row-aligned alternatives)
+run bench_bandS96      900 python bench.py --slab-scatter 1 --band-chunk 96
+run bench_bandS64      900 python bench.py --slab-scatter 1 --band-chunk 64
 # 2b. shared-negative width (parity holds to KP=8 on the harness)
 run bench_kp32         900 python bench.py --slab-scatter 1 --kp 32
 run bench_kp16         900 python bench.py --slab-scatter 1 --kp 16

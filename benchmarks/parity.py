@@ -113,6 +113,8 @@ def main() -> None:
                     help="device kernel for OUR side (reference has no analog)")
     ap.add_argument("--shared-negatives", type=int, default=64,
                     help="band-kernel shared draws per row for OUR side")
+    ap.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
+                    help="band-kernel slab-space context scatter for OUR side")
     ap.add_argument("--skip-reference", action="store_true",
                     help="evaluate only this framework (no g++/reference)")
     args = ap.parse_args()
@@ -161,6 +163,7 @@ def main() -> None:
                 "-output", "vec_ours.txt", "--backend", "cpu", "--quiet",
                 "--kernel", args.kernel,
                 "--shared-negatives", str(args.shared_negatives),
+                "--slab-scatter", str(args.slab_scatter),
             ],
             cwd=tmp, check=True, capture_output=True,
             env={**os.environ, "PYTHONPATH": REPO + os.pathsep

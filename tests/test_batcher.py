@@ -3,7 +3,9 @@
 import numpy as np
 import pytest
 
-from word2vec_tpu.data.batcher import PAD, BatchIterator, PackedCorpus, prefetch
+from word2vec_tpu.data.batcher import (
+    PAD, BatchIterator, PackedCorpus, placed_prefetch, prefetch,
+)
 
 
 def test_pack_and_wrap():
@@ -78,3 +80,29 @@ def test_prefetch_passthrough_and_errors():
     assert next(gen) == 1
     with pytest.raises(RuntimeError, match="boom"):
         list(gen)
+
+
+def test_placed_prefetch_places_first_element_in_producer():
+    import threading
+
+    main = threading.get_ident()
+    placed_on = []
+
+    def place(x):
+        placed_on.append(threading.get_ident())
+        return x * 10
+
+    stream = iter([(1, "a"), (2, "b"), (3, "c")])
+    out = list(placed_prefetch(stream, place))
+    # first element placed, rest of the tuple passed through untouched
+    assert out == [(10, "a"), (20, "b"), (30, "c")]
+    # placement ran in the producer thread, not the consumer
+    assert placed_on and all(t != main for t in placed_on)
+
+
+def test_placed_prefetch_propagates_place_errors():
+    def bad_place(x):
+        raise ValueError("no device")
+
+    with pytest.raises(ValueError, match="no device"):
+        list(placed_prefetch(iter([(1,)]), bad_place))

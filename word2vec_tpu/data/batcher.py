@@ -176,6 +176,24 @@ def chunk_batches(
         yield np.stack(buf + [dead] * (s - len(buf))), words
 
 
+def placed_prefetch(
+    stream: Iterator[Tuple], place, depth: int = 1
+) -> Iterator[Tuple]:
+    """prefetch() with device placement of each item's first element done in
+    the PRODUCER thread: the host->device copy of chunk i+1 (jax.device_put is
+    async, and the transfer releases the GIL) overlaps chunk i's dispatched
+    compute — through a remote-tunneled device that copy costs tens of ms.
+
+    depth defaults to 1 (not prefetch's 2): every in-flight item pins a device
+    buffer — the consumer's, the queued one, and the one the producer holds
+    while blocked on the full queue, so depth=1 already keeps up to two chunks
+    ahead alive — and one chunk of copy overlap is all the latency hiding
+    needs.
+    """
+    placed = ((place(item[0]), *item[1:]) for item in stream)
+    return prefetch(placed, depth=depth)
+
+
 def prefetch(iterator: Iterator, depth: int = 2) -> Iterator:
     """Background-thread prefetch so host batch assembly overlaps device compute.
 

@@ -471,13 +471,11 @@ class ShardedTrainer(Trainer):
         if steps:
             yield flush_chunk()
 
-    def _place_chunk(self, np_chunk: np.ndarray, alphas: np.ndarray):
+    def _place_tokens(self, np_chunk: np.ndarray) -> jnp.ndarray:
         sharding = NamedSharding(self.mesh, P(None, DATA_AXIS, SEQ_AXIS))
         if self.procs == 1:
-            tokens = jax.device_put(np_chunk, sharding)
-        else:
-            tokens = jax.make_array_from_process_local_data(sharding, np_chunk)
-        return tokens, jnp.asarray(alphas)
+            return jax.device_put(np_chunk, sharding)
+        return jax.make_array_from_process_local_data(sharding, np_chunk)
 
     def _place(self, local_rows: np.ndarray) -> jnp.ndarray:
         if self.procs == 1:

@@ -26,7 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .config import Word2VecConfig
-from .data.batcher import BatchIterator, PackedCorpus, chunk_batches, prefetch
+from .data.batcher import (
+    BatchIterator, PackedCorpus, chunk_batches, placed_prefetch, prefetch,
+)
 from .data.vocab import Vocab
 from .models.params import Params, init_params
 from .ops.tables import DeviceTables
@@ -288,15 +290,16 @@ class Trainer:
         skip = self._resume_skip(state, batcher)
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
-            for np_chunk, words_list in prefetch(
-                self._chunk_stream(batcher, epoch, skip, chunk_len)
+            for tokens, words_list in placed_prefetch(
+                self._chunk_stream(batcher, epoch, skip, chunk_len),
+                self._place_tokens,
             ):
                 alphas = np.empty(chunk_len, np.float32)
                 wd = state.words_done
                 for i in range(chunk_len):
                     alphas[i] = self.alpha_at(wd)
                     wd += words_list[i] if i < len(words_list) else 0
-                tokens, al = self._place_chunk(np_chunk, alphas)
+                al = jnp.asarray(alphas)
                 state.params, metrics = self.chunk_fn(
                     state.params, tokens, base_key, state.step, al
                 )
@@ -352,11 +355,13 @@ class Trainer:
         trainers group dp row blocks per step before chunking)."""
         return chunk_batches(batcher.epoch(epoch, skip), chunk_len)
 
-    def _place_chunk(
-        self, np_chunk: np.ndarray, alphas: np.ndarray
-    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        """Host chunk -> device arrays (sharded trainers override placement)."""
-        return jnp.asarray(np_chunk), jnp.asarray(alphas)
+    def _place_tokens(self, np_chunk: np.ndarray) -> jnp.ndarray:
+        """Host chunk -> device tokens (sharded trainers override placement).
+
+        Called from the prefetch PRODUCER thread so the transfer overlaps the
+        consumer's dispatched compute; must therefore be thread-safe (pure
+        jax.device_put / asarray calls are)."""
+        return jnp.asarray(np_chunk)
 
     def _note_metrics(
         self,

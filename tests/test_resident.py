@@ -1,0 +1,164 @@
+"""Device-resident corpus path (ops/resident.py).
+
+Pins the two claims the module makes:
+  1. assemble_batch is bit-identical to the host pipeline (native.fill_batch
+     via BatchIterator) on the same row order — partial final batch and
+     beyond-epoch no-op steps included.
+  2. A Trainer run with resident="on" produces exactly the same parameter
+     trajectory as resident="off" (same rows, key stream, alpha schedule).
+"""
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PAD, BatchIterator, PackedCorpus
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.ops import resident as res
+from word2vec_tpu.train import Trainer
+from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+
+def _toy_corpus(n_tokens=3000, vocab_size=50, sentence_len=37, seed=3):
+    vocab = zipf_vocab(vocab_size=vocab_size, total_words=n_tokens * 10)
+    sents = zipf_corpus_ids(
+        vocab, num_tokens=n_tokens, seed=seed, sentence_len=sentence_len
+    )
+    return vocab, sents
+
+
+def test_assemble_matches_host_batcher():
+    import jax.numpy as jnp
+
+    _, sents = _toy_corpus()
+    B, L = 4, 16
+    corpus = PackedCorpus.pack(sents, L)
+    seed, epoch = 11, 2
+    order = res.epoch_order(seed, epoch, corpus.num_rows)
+    corpus_dev = res.device_corpus(corpus)
+    order_dev = jnp.asarray(order.astype(np.int32))
+
+    it = BatchIterator(corpus, B, L, seed=seed)
+    host_batches = list(it.epoch(epoch))
+    spe = it.steps_per_epoch()
+    assert len(host_batches) == spe
+
+    for t, (host_tokens, host_words) in enumerate(host_batches):
+        dev_tokens = np.asarray(
+            res.assemble_batch(corpus_dev, order_dev, jnp.int32(t), B, L)
+        )
+        np.testing.assert_array_equal(dev_tokens, host_tokens)
+    # beyond-epoch steps are all-PAD (the chunk runner's no-op padding)
+    beyond = np.asarray(
+        res.assemble_batch(corpus_dev, order_dev, jnp.int32(spe), B, L)
+    )
+    assert np.all(beyond == PAD)
+
+
+def test_epoch_step_words_matches_host_batcher():
+    _, sents = _toy_corpus()
+    B, L = 4, 16
+    corpus = PackedCorpus.pack(sents, L)
+    order = res.epoch_order(5, 0, corpus.num_rows)
+    words = res.epoch_step_words(corpus, order, B)
+    it = BatchIterator(corpus, B, L, seed=5)
+    host_words = [w for _, w in it.epoch(0)]
+    assert words.tolist() == host_words
+
+
+@pytest.mark.parametrize("method", ["ns", "hs"])
+def test_resident_trainer_trajectory_identical(method):
+    vocab, sents = _toy_corpus(n_tokens=4000)
+    kw = dict(
+        model="sg",
+        train_method=method,
+        negative=5 if method == "ns" else 0,
+        word_dim=16,
+        window=2,
+        min_count=1,
+        subsample_threshold=1e-3,
+        iters=2,
+        batch_rows=4,
+        max_sentence_len=16,
+        chunk_steps=8,
+        seed=9,
+    )
+    corpus = PackedCorpus.pack(sents, 16)
+
+    def run(resident):
+        cfg = Word2VecConfig(resident=resident, **kw)
+        state, _ = Trainer(cfg, vocab, corpus).train(log_every=0)
+        return state
+
+    s_on, s_off = run("on"), run("off")
+    assert s_on.step == s_off.step
+    assert s_on.words_done == s_off.words_done
+    for k in s_off.params:
+        np.testing.assert_array_equal(
+            np.asarray(s_on.params[k]), np.asarray(s_off.params[k]), err_msg=k
+        )
+
+
+def test_resident_mid_epoch_resume_matches():
+    """Checkpoint mid-epoch on the resident path, resume, and land on the
+    same parameters as an uninterrupted run."""
+    vocab, sents = _toy_corpus(n_tokens=4000)
+    corpus = PackedCorpus.pack(sents, 16)
+    kw = dict(
+        model="sg", train_method="ns", negative=3, word_dim=8, window=2,
+        min_count=1, subsample_threshold=0.0, iters=2, batch_rows=4,
+        max_sentence_len=16, chunk_steps=4, seed=21, resident="on",
+    )
+    cfg = Word2VecConfig(**kw)
+    full_state, _ = Trainer(cfg, vocab, corpus).train(log_every=0)
+
+    saved = {}
+
+    def grab(state):
+        if not saved and state.epoch == 0 and state.step >= 8:
+            saved["state"] = type(state)(
+                params={k: v.copy() for k, v in state.params.items()},
+                step=state.step,
+                words_done=state.words_done,
+                epoch=state.epoch,
+            )
+
+    Trainer(cfg, vocab, corpus).train(
+        log_every=0, checkpoint_cb=grab, checkpoint_every=8
+    )
+    assert "state" in saved and 0 < saved["state"].step < full_state.step
+    resumed, _ = Trainer(cfg, vocab, corpus).train(
+        state=saved["state"], log_every=0
+    )
+    assert resumed.step == full_state.step
+    for k in full_state.params:
+        np.testing.assert_array_equal(
+            np.asarray(resumed.params[k]),
+            np.asarray(full_state.params[k]),
+            err_msg=k,
+        )
+
+
+def test_resident_on_too_big_raises(monkeypatch):
+    vocab, sents = _toy_corpus()
+    corpus = PackedCorpus.pack(sents, 16)
+    monkeypatch.setattr(res, "RESIDENT_MAX_BYTES", 16)
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=2, word_dim=8, window=2,
+        min_count=1, iters=1, batch_rows=4, max_sentence_len=16,
+        chunk_steps=4, resident="on",
+    )
+    with pytest.raises(ValueError, match="exceeds the HBM budget"):
+        Trainer(cfg, vocab, corpus).train(log_every=0)
+
+
+def test_resident_on_per_step_path_raises():
+    vocab, sents = _toy_corpus()
+    corpus = PackedCorpus.pack(sents, 16)
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=2, word_dim=8, window=2,
+        min_count=1, iters=1, batch_rows=4, max_sentence_len=16,
+        chunk_steps=1, resident="on",  # per-step dispatch cannot be resident
+    )
+    with pytest.raises(ValueError, match="chunked dispatch"):
+        Trainer(cfg, vocab, corpus).train(log_every=0)

@@ -107,6 +107,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                    help="band kernel: scatter context grads from slab space "
                         "(skips the overlap-add; config.slab_scatter)")
+    p.add_argument("--resident", choices=["auto", "on", "off"], default="auto",
+                   help="device-resident corpus: keep the packed corpus in "
+                        "HBM and assemble batches on device (single-chip "
+                        "chunked path; ops/resident.py)")
     p.add_argument("--max-sentence-len", type=int, default=192)
     p.add_argument("--corpus-format", choices=["text8", "lines"], default="text8",
                    help="text8: 1000-word chunks (main.cpp:63-92); "
@@ -219,6 +223,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             shared_negatives=args.shared_negatives,
             scatter_mean=bool(args.scatter_mean),
             slab_scatter=bool(args.slab_scatter),
+            resident=args.resident,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)

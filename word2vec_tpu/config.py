@@ -114,6 +114,15 @@ class Word2VecConfig:
     # device step time; see bench.py).
     chunk_steps: int = 1
 
+    # Device-resident corpus (ops/resident.py): keep the packed corpus in
+    # HBM and assemble every [B, L] batch on device inside the scanned chunk
+    # — a dispatch then carries only scalars plus one [R] row-order upload
+    # per epoch, no per-chunk token traffic. "auto" = on whenever the
+    # corpus fits the HBM budget (RESIDENT_MAX_BYTES) and the trainer is
+    # single-chip chunked; "on" forces it (errors if the corpus cannot fit);
+    # "off" always streams batches from the host.
+    resident: str = "auto"
+
     # Band kernel, chunked representation only: scatter context-side
     # gradients directly from slab space ([B, C, S+2W, d] with slab token
     # ids) instead of overlap-adding back to [B, L, d] first. The scatter's
@@ -170,6 +179,10 @@ class Word2VecConfig:
             raise ValueError("micro_steps must be >= 1")
         if self.chunk_steps < 0:
             raise ValueError("chunk_steps must be >= 0 (0 = auto)")
+        if self.resident not in ("auto", "on", "off"):
+            raise ValueError(
+                f"resident must be auto|on|off, got {self.resident!r}"
+            )
         if self.sync_mode not in ("mean", "delta"):
             raise ValueError(
                 f"sync_mode must be 'mean' or 'delta', got {self.sync_mode!r}"

@@ -1,0 +1,152 @@
+"""Device-resident corpus: batches assembled ON DEVICE inside the scanned chunk.
+
+The host batcher (data/batcher.py) streams [S, B, L] token chunks over
+host->device DMA every dispatch. For corpora that fit in HBM (text8 packed is
+~68 MB against 16 GB; the gate is bytes, not design), the corpus can instead
+live on device — the flat token stream plus the row table — and each step's
+[B, L] batch is assembled by gathers inside the compiled program. A dispatch
+then carries only scalars (key, step indices) plus one [R] int32 row-order
+upload per EPOCH (~350 KB for text8), eliminating per-chunk token traffic
+(6+ MB/chunk at the flagship geometry) and the host fill work with it.
+
+The assembled batch is bit-identical to the host pipeline's
+(native.fill_batch) on the same row order — pinned by tests/test_resident.py
+— so the training trajectory is exactly the streaming path's: same rows per
+step, same fold_in(key, step) stream, same alpha schedule.
+
+Reference mapping: the host<->device split of SURVEY §3.2 moves one level up.
+The per-epoch shuffle (Word2Vec.cpp:373) stays host-side as the [R]
+permutation (a pure function of (seed, epoch), which is what mid-epoch resume
+relies on); row fetch — the reference's `samples[idx]` read at
+Word2Vec.cpp:377-390 — joins everything below it on device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Word2VecConfig
+from ..data.batcher import PAD, PackedCorpus
+from .tables import DeviceTables
+from .train_step import make_train_step
+
+# Corpora above this many packed bytes stay on the streaming host path
+# (auto mode). 2 GiB leaves the [V, d] tables and step workspace ample HBM
+# on any current chip; int32 row addressing holds to 2^31 tokens anyway.
+RESIDENT_MAX_BYTES = 2 << 30
+
+DeviceCorpus = Dict[str, jnp.ndarray]  # {"flat": [N], "starts": [R], "lens": [R]} i32
+
+
+def corpus_fits(corpus: PackedCorpus, max_bytes: int | None = None) -> bool:
+    if max_bytes is None:  # read the module attr at call time (testable)
+        max_bytes = RESIDENT_MAX_BYTES
+    return (
+        corpus.flat.nbytes + 8 * corpus.num_rows <= max_bytes
+        and len(corpus.flat) < 2**31
+    )
+
+
+def device_corpus(corpus: PackedCorpus) -> DeviceCorpus:
+    """Place the packed corpus in HBM (one transfer, reused every dispatch)."""
+    if len(corpus.flat) >= 2**31:
+        raise ValueError("corpus too large for int32 row addressing")
+    return {
+        "flat": jnp.asarray(corpus.flat, jnp.int32),
+        "starts": jnp.asarray(corpus.row_starts.astype(np.int32)),
+        "lens": jnp.asarray(corpus.row_lens, jnp.int32),
+    }
+
+
+def assemble_batch(
+    corpus: DeviceCorpus,
+    order: jnp.ndarray,  # [R] int32 — this epoch's row permutation
+    t: jnp.ndarray,      # within-epoch step index
+    batch_rows: int,
+    max_len: int,
+) -> jnp.ndarray:
+    """[B, L] token batch for within-epoch step t; PAD(-1) outside rows.
+
+    Matches native.fill_batch semantics exactly: batch b takes rows
+    order[t*B : t*B+B]; positions past the end of the epoch (partial final
+    batch, or no-op pad steps of a chunk) come out as all-PAD rows, which
+    every kernel mask provably ignores.
+    """
+    n_rows = order.shape[0]
+    pos = t * batch_rows + jnp.arange(batch_rows, dtype=jnp.int32)
+    in_epoch = pos < n_rows
+    rows = jnp.where(in_epoch, order[jnp.minimum(pos, n_rows - 1)], -1)
+    ok = rows >= 0
+    r = jnp.where(ok, rows, 0)
+    starts = corpus["starts"][r]
+    lens = jnp.where(ok, corpus["lens"][r], 0)
+    cols = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    within = cols < lens[:, None]
+    idx = jnp.minimum(starts[:, None] + cols, corpus["flat"].shape[0] - 1)
+    return jnp.where(within, corpus["flat"][idx], PAD)
+
+
+def make_resident_chunk_runner(
+    config: Word2VecConfig, tables: DeviceTables
+):
+    """S sequential optimizer steps as ONE device program, batches assembled
+    on device (single-chip; sharded training keeps the streaming host path).
+
+    chunk(params, corpus, order, base_key, step0, epoch_t0, alphas[S])
+        -> (params, {"loss_sum": [S], "pairs": [S]})
+
+    Identical trajectory contract to make_chunk_runner (step i uses
+    fold_in(base_key, step0 + i) and alphas[i]); epoch_t0 is the within-epoch
+    step index of the chunk's first step (skip + chunk offset on resume).
+    Both step indices are traced scalars, so one compiled program serves
+    every chunk of every epoch.
+    """
+    step = make_train_step(config, tables)
+    B, L = config.batch_rows, config.max_sentence_len
+
+    def chunk(params, corpus, order, base_key, step0, epoch_t0, alphas):
+        def body(p, xs):
+            i, a = xs
+            tokens = assemble_batch(corpus, order, epoch_t0 + i, B, L)
+            key = jax.random.fold_in(base_key, step0 + i)
+            p, m = step(p, tokens, key, a)
+            return p, (m["loss_sum"], m["pairs"])
+
+        s = alphas.shape[0]
+        idx = jnp.arange(s, dtype=jnp.int32)
+        params, (loss, pairs) = jax.lax.scan(body, params, (idx, alphas))
+        return params, {"loss_sum": loss, "pairs": pairs}
+
+    return chunk
+
+
+def jit_resident_chunk_runner(config: Word2VecConfig, tables: DeviceTables):
+    """The resident runner jitted with params-buffer donation (the corpus and
+    order arrays are NOT donated — they are reused across dispatches)."""
+    return jax.jit(make_resident_chunk_runner(config, tables), donate_argnums=0)
+
+
+def epoch_order(seed: int, epoch_index: int, num_rows: int) -> np.ndarray:
+    """The host-side row permutation for one epoch — the same pure function
+    of (seed, epoch) as BatchIterator.epoch, so resident and streaming paths
+    visit identical rows in identical order."""
+    order = np.arange(num_rows, dtype=np.int64)
+    np.random.default_rng((seed, epoch_index)).shuffle(order)
+    return order
+
+
+def epoch_step_words(
+    corpus: PackedCorpus, order: np.ndarray, batch_rows: int
+) -> np.ndarray:
+    """[steps_per_epoch] words consumed by each optimizer step (host-side
+    alpha schedule + progress accounting; the device only needs tokens)."""
+    lens = corpus.row_lens[order].astype(np.int64)
+    n = len(lens)
+    steps = -(-n // batch_rows)
+    padded = np.zeros(steps * batch_rows, np.int64)
+    padded[:n] = lens
+    return padded.reshape(steps, batch_rows).sum(axis=1)

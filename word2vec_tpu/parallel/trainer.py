@@ -246,6 +246,9 @@ class ShardedTrainer(Trainer):
     """Data+sequence+tensor-parallel trainer; dp*sp*tp <= len(jax.devices())."""
 
     supports_chunking = True
+    # row blocks are sharded across replicas at placement time, so the
+    # sharded path streams from host (config.resident is a single-chip knob)
+    supports_resident = False
 
     def __init__(
         self,

@@ -59,8 +59,14 @@ class Trainer:
     #: chunked dispatch (config.chunk_steps) — subclasses without a chunk
     #: runner set this False to force the per-step path
     supports_chunking = True
+    #: device-resident corpus (config.resident, ops/resident.py) — sharded
+    #: trainers keep the streaming host path (row blocks are sharded across
+    #: replicas at placement time)
+    supports_resident = True
     #: loss of the most recently drained chunk (chunked driver's final_loss)
     _last_chunk_loss: float = float("nan")
+    #: active resident-corpus state, set per train() run (_setup_resident)
+    _resident = None
 
     def __init__(
         self,
@@ -75,6 +81,9 @@ class Trainer:
         self.tables = DeviceTables.build(vocab, config)
         self.log_fn = log_fn
         self.total_words = corpus.num_tokens
+        # resident-corpus runner + HBM corpus, built once per instance
+        self._resident_cache = None
+        self._resident_ready = False
         self._warn_batch_geometry()
         self._build_step()
 
@@ -171,6 +180,14 @@ class Trainer:
             return self._train_chunked(
                 state, batcher, base_key, chunk_len, t0, loss_hist,
                 log_every, checkpoint_cb, checkpoint_every,
+            )
+        if cfg.resident == "on":
+            # the config contract is force-or-error; the per-step loop
+            # streams from host by construction
+            raise ValueError(
+                "config.resident='on' requires chunked dispatch "
+                "(chunk_steps=0 for auto, or >1), but this run resolved to "
+                "per-step dispatch"
             )
         # state.epoch = epoch in progress; a mid-epoch checkpoint re-enters it
         # at the first undone batch (_resume_skip)
@@ -271,7 +288,8 @@ class Trainer:
         at chunk boundaries.
         """
         cfg = self.config
-        if self.chunk_fn is None:
+        self._resident = self._setup_resident()
+        if self._resident is None and self.chunk_fn is None:
             self.chunk_fn = self._build_chunk_fn()
         self._last_chunk_loss = float("nan")
         pending: Optional[Tuple[Dict, int, int, float, int, bool]] = None
@@ -290,19 +308,15 @@ class Trainer:
         skip = self._resume_skip(state, batcher)
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
-            for tokens, words_list in placed_prefetch(
-                self._chunk_stream(batcher, epoch, skip, chunk_len),
-                self._place_tokens,
+            for words_list, dispatch in self._chunk_dispatches(
+                state, batcher, base_key, epoch, skip, chunk_len
             ):
                 alphas = np.empty(chunk_len, np.float32)
                 wd = state.words_done
                 for i in range(chunk_len):
                     alphas[i] = self.alpha_at(wd)
                     wd += words_list[i] if i < len(words_list) else 0
-                al = jnp.asarray(alphas)
-                state.params, metrics = self.chunk_fn(
-                    state.params, tokens, base_key, state.step, al
-                )
+                state.params, metrics = dispatch(jnp.asarray(alphas))
                 prev_step = state.step
                 state.step += len(words_list)
                 state.words_done = wd
@@ -347,6 +361,96 @@ class Trainer:
         from .ops.train_step import jit_chunk_runner
 
         return jit_chunk_runner(self.config, self.tables)
+
+    def _setup_resident(self):
+        """(chunk_fn, device_corpus) when the resident-corpus path is active
+        for this run, else None (see config.resident; ops/resident.py).
+        Cached on the instance: repeated train() calls reuse the compiled
+        runner and the already-placed corpus."""
+        if self._resident_ready:
+            return self._resident_cache
+        self._resident_cache = self._build_resident()
+        self._resident_ready = True
+        return self._resident_cache
+
+    def _build_resident(self):
+        from .ops import resident as res
+
+        cfg = self.config
+        if cfg.resident == "off":
+            return None
+        if not self.supports_resident:
+            if cfg.resident == "on":
+                import warnings
+
+                warnings.warn(
+                    "config.resident='on' but this trainer streams from host "
+                    "(sharded training shards row blocks at placement time); "
+                    "falling back to the streaming path.",
+                    stacklevel=2,
+                )
+            return None
+        if not res.corpus_fits(self.corpus):
+            if cfg.resident == "on":
+                raise ValueError(
+                    f"config.resident='on' but the packed corpus "
+                    f"({self.corpus.flat.nbytes >> 20} MiB) exceeds the HBM "
+                    f"budget (ops/resident.RESIDENT_MAX_BYTES)"
+                )
+            return None
+        return (
+            res.jit_resident_chunk_runner(cfg, self.tables),
+            res.device_corpus(self.corpus),
+        )
+
+    def _chunk_dispatches(
+        self,
+        state: TrainState,
+        batcher: BatchIterator,
+        base_key: jax.Array,
+        epoch: int,
+        skip: int,
+        chunk_len: int,
+    ) -> Iterator[Tuple[List[int], Callable]]:
+        """One epoch's dispatches: yields (words per optimizer step,
+        dispatch(alphas) -> (params, metrics)).
+
+        Streaming path: host-assembled [S, B, L] chunks, device-placed in the
+        prefetch producer thread. Resident path: the corpus already lives in
+        HBM, so only this epoch's [R] row order goes up (once), and each
+        dispatch carries scalars.
+        """
+        if self._resident is not None:
+            from .ops import resident as res
+
+            chunk_fn, corpus_dev = self._resident
+            cfg = self.config
+            order = res.epoch_order(cfg.seed, epoch, self.corpus.num_rows)
+            step_words = res.epoch_step_words(self.corpus, order, cfg.batch_rows)
+            order_dev = jnp.asarray(order.astype(np.int32))
+            spe = batcher.steps_per_epoch()
+            for t0 in range(skip, spe, chunk_len):
+                words_list = [int(w) for w in step_words[t0:t0 + chunk_len]]
+
+                def dispatch(al, t0=t0):
+                    return chunk_fn(
+                        state.params, corpus_dev, order_dev,
+                        base_key, state.step, t0, al,
+                    )
+
+                yield words_list, dispatch
+            return
+        for tokens, words_list in placed_prefetch(
+            self._chunk_stream(batcher, epoch, skip, chunk_len),
+            self._place_tokens,
+        ):
+
+            def dispatch(al, tokens=tokens):
+                return self.chunk_fn(
+                    state.params, tokens, base_key, state.step, al
+                )
+
+            yield words_list, dispatch
 
     def _chunk_stream(
         self, batcher: BatchIterator, epoch: int, skip: int, chunk_len: int

@@ -144,7 +144,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         chunk_fn = res.jit_resident_chunk_runner(cfg, tables)
         order = res.epoch_order(1, 0, corpus.num_rows)
         step_words = res.epoch_step_words(corpus, order, cfg.batch_rows)
-        corpus_dev = jax.device_put(res.device_corpus(corpus))
+        corpus_dev = res.device_corpus(corpus)
         order_dev = jnp.asarray(order.astype(np.int32))
         spe = len(step_words)
 
@@ -153,19 +153,13 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         )
         jax.block_until_ready(params)
 
-        words = 0
-        steps = 0
-        chunk_metrics = []
-        t0 = time.perf_counter()
-        for c in range(0, spe, S):
-            params, m = chunk_fn(
-                params, corpus_dev, order_dev, base_key, steps, c, alphas
-            )
-            chunk_metrics.append(m["pairs"])
-            words += int(step_words[c:c + S].sum())
-            steps += S
-            if args.measure_steps and steps >= args.measure_steps:
-                break
+        def dispatches():
+            for c in range(0, spe, S):
+                yield int(step_words[c:c + S].sum()), (
+                    lambda p, s, c=c: chunk_fn(
+                        p, corpus_dev, order_dev, base_key, s, c, alphas
+                    )
+                )
     else:
         chunk_fn = jit_chunk_runner(cfg, tables)
 
@@ -174,24 +168,28 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         params, m = chunk_fn(params, jnp.asarray(warm[0]), base_key, 0, alphas)
         jax.block_until_ready(params)
 
-        # timed steady-state over one full epoch; metrics stay on device until
-        # the end (no per-chunk sync); chunk transfers overlap compute
-        # (batcher.placed_prefetch)
-        words = 0
-        steps = 0
-        chunk_metrics = []
-        t0 = time.perf_counter()
-        for dev_chunk, wlist in placed_prefetch(
-            chunk_batches(batcher.epoch(), S), jax.device_put
-        ):
-            params, m = chunk_fn(
-                params, dev_chunk, base_key, steps, alphas
-            )
-            chunk_metrics.append(m["pairs"])
-            words += sum(wlist)
-            steps += S
-            if args.measure_steps and steps >= args.measure_steps:
-                break
+        def dispatches():
+            # chunk transfers overlap compute (batcher.placed_prefetch)
+            for dev_chunk, wlist in placed_prefetch(
+                chunk_batches(batcher.epoch(), S), jax.device_put
+            ):
+                yield sum(wlist), (
+                    lambda p, s, t=dev_chunk: chunk_fn(p, t, base_key, s, alphas)
+                )
+
+    # timed steady-state over one full epoch; metrics stay on device until
+    # the end (no per-chunk sync)
+    words = 0
+    steps = 0
+    chunk_metrics = []
+    t0 = time.perf_counter()
+    for chunk_words, dispatch in dispatches():
+        params, m = dispatch(params, steps)
+        chunk_metrics.append(m["pairs"])
+        words += chunk_words
+        steps += S
+        if args.measure_steps and steps >= args.measure_steps:
+            break
     jax.block_until_ready(params)
     dt = time.perf_counter() - t0
     wps = words / dt

@@ -162,3 +162,40 @@ def test_resident_on_per_step_path_raises():
     )
     with pytest.raises(ValueError, match="chunked dispatch"):
         Trainer(cfg, vocab, corpus).train(log_every=0)
+
+
+@pytest.mark.parametrize("mesh_shape", [(4, 1, 1), (2, 2, 2)])
+def test_sharded_resident_matches_streaming(mesh_shape):
+    """dp/sp/tp mesh: the resident path (mesh-replicated corpus, per-shard
+    on-device assembly) must reproduce the streaming path's trajectory."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from word2vec_tpu.parallel import ShardedTrainer, make_mesh
+
+    dp, sp, tp = mesh_shape
+    vocab, sents = _toy_corpus(n_tokens=6000)
+    L = 16
+    corpus = PackedCorpus.pack(sents, L)
+    kw = dict(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        min_count=1, subsample_threshold=1e-3, iters=2, batch_rows=4,
+        max_sentence_len=L, chunk_steps=4, seed=13, dp_sync_every=8,
+    )
+
+    def run(resident):
+        cfg = Word2VecConfig(resident=resident, **kw)
+        mesh = make_mesh(dp, tp, sp)
+        trainer = ShardedTrainer(cfg, vocab, corpus, mesh=mesh)
+        state, _ = trainer.train(log_every=0)
+        return trainer.export_params(state), state
+
+    p_on, s_on = run("on")
+    p_off, s_off = run("off")
+    assert s_on.step == s_off.step
+    assert s_on.words_done == s_off.words_done
+    for k in p_off:
+        np.testing.assert_array_equal(
+            np.asarray(p_on[k]), np.asarray(p_off[k]), err_msg=k
+        )

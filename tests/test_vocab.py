@@ -74,3 +74,17 @@ def test_save_load_roundtrip(tmp_path):
     assert v2.words == v.words
     assert v2.counts.tolist() == v.counts.tolist()
     assert v2.word2id == v.word2id
+
+
+def test_max_vocab_caps_to_top_n():
+    sents = [["a"] * 9 + ["b"] * 7 + ["c"] * 5 + ["d"] * 3 + ["e"]]
+    v = Vocab.build(sents, min_count=1, max_vocab=3)
+    assert v.words == ["a", "b", "c"]
+    assert v.counts.tolist() == [9, 7, 5]
+    # capped-out words are OOV and drop from encoding (Word2Vec.cpp:223)
+    assert v.encode(["a", "d", "c", "e"]).tolist() == [0, 2]
+
+
+def test_max_vocab_zero_is_unlimited():
+    sents = [["a", "b", "a"]]
+    assert len(Vocab.build(sents, min_count=1, max_vocab=0)) == 2

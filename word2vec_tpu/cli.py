@@ -53,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="accepted for compatibility; ignored (use --dp/--sp/--tp)")
     p.add_argument("-iter", dest="iter", type=int, default=1)
     p.add_argument("-min-count", dest="min_count", type=int, default=5)
+    p.add_argument("--max-vocab", type=int, default=0,
+                   help="cap the vocabulary to the top-N words by count "
+                        "(0 = unlimited); the working version of the "
+                        "reference's declared-but-undefined reduce_vocab "
+                        "(Word2Vec.h:69)")
     p.add_argument("-alpha", dest="alpha", type=float, default=None)
     p.add_argument("-model", dest="model", default="sg", choices=["sg", "cbow"])
     p.add_argument("-save-vocab", dest="save_vocab", metavar="FILE")
@@ -271,6 +276,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     t0 = time.perf_counter()
     mode = native.MODE_STREAM if args.corpus_format == "text8" else native.MODE_LINES
+    if args.max_vocab and (ck_vocab is not None or args.read_vocab):
+        print(
+            "warning: --max-vocab applies only when the vocabulary is built "
+            "from the corpus; the loaded vocabulary (checkpoint/-read-vocab) "
+            "is used as-is", file=sys.stderr,
+        )
     if ck_vocab is not None:
         vocab = ck_vocab
         flat = native.encode_file(args.train, vocab, mode)
@@ -279,7 +290,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         flat = native.encode_file(args.train, vocab, mode)
     else:
         vocab, flat = load_corpus(
-            args.train, fmt=args.corpus_format, min_count=cfg.min_count
+            args.train, fmt=args.corpus_format, min_count=cfg.min_count,
+            max_vocab=args.max_vocab,
         )
     if not args.quiet:
         impl = "native" if native.available() else "python"

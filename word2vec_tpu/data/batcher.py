@@ -96,6 +96,16 @@ class PackedCorpus:
         return cls(flat, np.asarray(starts, dtype=np.int64), np.asarray(lens, dtype=np.int32))
 
 
+def epoch_order(seed: int, epoch_index: int, num_rows: int) -> np.ndarray:
+    """The per-epoch row permutation — a pure function of (seed, epoch), the
+    property mid-epoch resume and the device-resident path both rely on
+    (reference shuffle: Word2Vec.cpp:373). Single source of truth for
+    BatchIterator and ops/resident.py."""
+    order = np.arange(num_rows, dtype=np.int64)
+    np.random.default_rng((seed, epoch_index)).shuffle(order)
+    return order
+
+
 class BatchIterator:
     """Yields [B, L] int32 batches (pad = -1) in per-epoch shuffled row order.
 
@@ -142,9 +152,10 @@ class BatchIterator:
         if epoch_index is None:
             epoch_index = self._epoch_counter
             self._epoch_counter += 1
-        order = np.arange(self.corpus.num_rows, dtype=np.int64)
         if self.shuffle:
-            np.random.default_rng((self.seed, epoch_index)).shuffle(order)
+            order = epoch_order(self.seed, epoch_index, self.corpus.num_rows)
+        else:
+            order = np.arange(self.corpus.num_rows, dtype=np.int64)
         flat = self.corpus.flat
         starts = self.corpus.row_starts
         lens = self.corpus.row_lens

@@ -64,7 +64,9 @@ def line_docs(path: str) -> Iterator[List[str]]:
                 yield toks
 
 
-def load_corpus(path: str, fmt: str = "text8", min_count: int = 5):
+def load_corpus(
+    path: str, fmt: str = "text8", min_count: int = 5, max_vocab: int = 0
+):
     """One-shot corpus load: (Vocab, flat int32 id stream).
 
     Uses the native C++ layer (word2vec_tpu.native) for the two host-side
@@ -72,6 +74,9 @@ def load_corpus(path: str, fmt: str = "text8", min_count: int = 5):
     transparently. `fmt` selects the reference reader semantics: "text8" is a
     whitespace stream (main.cpp:63-92), "lines" treats each line as a sentence
     (Word2Vec.cpp:19-30; sentence breaks become -1 separators in the stream).
+    max_vocab > 0 caps the vocabulary to the top-N by count (the working
+    replacement for the reference's declared-but-undefined reduce_vocab,
+    Word2Vec.h:69); capped-out words encode as OOV and are dropped.
 
     Pack the result with PackedCorpus.from_flat(flat, max_sentence_len).
     """
@@ -80,6 +85,6 @@ def load_corpus(path: str, fmt: str = "text8", min_count: int = 5):
 
     mode = native.MODE_STREAM if fmt == "text8" else native.MODE_LINES
     counts, total = native.count_file(path)
-    vocab = Vocab.from_counter(counts, min_count=min_count)
+    vocab = Vocab.from_counter(counts, min_count=min_count, max_vocab=max_vocab)
     flat = native.encode_file(path, vocab, mode, max_tokens=total)
     return vocab, flat

@@ -34,7 +34,12 @@ class Vocab:
 
     # ------------------------------------------------------------------ build
     @classmethod
-    def build(cls, sentences: Iterable[Sequence[str]], min_count: int = 5) -> "Vocab":
+    def build(
+        cls,
+        sentences: Iterable[Sequence[str]],
+        min_count: int = 5,
+        max_vocab: int = 0,
+    ) -> "Vocab":
         """Count tokens, drop count < min_count, sort by descending count.
 
         Reference: Word2Vec.cpp:134-160 (count loop, min_count filter at :145,
@@ -43,15 +48,25 @@ class Vocab:
         counter: Counter = Counter()
         for sentence in sentences:
             counter.update(sentence)
-        return cls.from_counter(counter, min_count)
+        return cls.from_counter(counter, min_count, max_vocab)
 
     @classmethod
-    def from_counter(cls, counter: Dict[str, int], min_count: int = 5) -> "Vocab":
+    def from_counter(
+        cls, counter: Dict[str, int], min_count: int = 5, max_vocab: int = 0
+    ) -> "Vocab":
+        """max_vocab > 0 caps the vocabulary to the top-N words by count
+        (ties lexicographic, same order as the sort). This supplies the
+        intent of the reference's `reduce_vocab` — declared at Word2Vec.h:69
+        to bound vocab memory on huge corpora, but never defined (SURVEY §2
+        dead code) — as a post-count cap rather than word2vec.c's lossy
+        mid-count eviction, so the kept words' counts stay exact."""
         items = [(w, c) for w, c in counter.items() if c >= min_count]
         # descending count, ties lexicographic: deterministic regardless of
         # counter iteration order (dict vs the native C++ hash table), where
         # the reference inherits unordered_map's arbitrary tie order
         items.sort(key=lambda wc: (-wc[1], wc[0]))
+        if max_vocab > 0:
+            items = items[:max_vocab]
         words = [w for w, _ in items]
         counts = np.array([c for _, c in items], dtype=np.int64)
         return cls(words, counts)

@@ -30,7 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Word2VecConfig
-from ..data.batcher import PAD, PackedCorpus
+# epoch_order re-exported: the row permutation is shared with BatchIterator
+# so resident and streaming paths visit identical rows in identical order
+from ..data.batcher import PAD, PackedCorpus, epoch_order  # noqa: F401
 from .tables import DeviceTables
 from .train_step import make_train_step
 
@@ -51,30 +53,40 @@ def corpus_fits(corpus: PackedCorpus, max_bytes: int | None = None) -> bool:
     )
 
 
-def device_corpus(corpus: PackedCorpus) -> DeviceCorpus:
-    """Place the packed corpus in HBM (one transfer, reused every dispatch)."""
+def corpus_arrays(corpus: PackedCorpus) -> Dict[str, np.ndarray]:
+    """The packed corpus as int32 host arrays, ready for device placement."""
     if len(corpus.flat) >= 2**31:
         raise ValueError("corpus too large for int32 row addressing")
     return {
-        "flat": jnp.asarray(corpus.flat, jnp.int32),
-        "starts": jnp.asarray(corpus.row_starts.astype(np.int32)),
-        "lens": jnp.asarray(corpus.row_lens, jnp.int32),
+        "flat": np.asarray(corpus.flat, np.int32),
+        "starts": corpus.row_starts.astype(np.int32),
+        "lens": np.asarray(corpus.row_lens, np.int32),
     }
+
+
+def device_corpus(corpus: PackedCorpus) -> DeviceCorpus:
+    """Place the packed corpus in HBM (one transfer, reused every dispatch)."""
+    return {k: jnp.asarray(v) for k, v in corpus_arrays(corpus).items()}
 
 
 def assemble_batch(
     corpus: DeviceCorpus,
     order: jnp.ndarray,  # [R] int32 — this epoch's row permutation
-    t: jnp.ndarray,      # within-epoch step index
+    t: jnp.ndarray,      # batch index into the permuted row sequence
     batch_rows: int,
     max_len: int,
+    col0: int | jnp.ndarray = 0,
 ) -> jnp.ndarray:
-    """[B, L] token batch for within-epoch step t; PAD(-1) outside rows.
+    """[B, max_len] token batch for batch index t; PAD(-1) outside rows.
 
-    Matches native.fill_batch semantics exactly: batch b takes rows
+    Matches native.fill_batch semantics exactly: batch t takes rows
     order[t*B : t*B+B]; positions past the end of the epoch (partial final
     batch, or no-op pad steps of a chunk) come out as all-PAD rows, which
     every kernel mask provably ignores.
+
+    col0 selects a column window [col0, col0 + max_len) of each row — the
+    sequence-parallel shard's position slice (a shard assembles only its own
+    columns of the conceptual [B, L] batch).
     """
     n_rows = order.shape[0]
     pos = t * batch_rows + jnp.arange(batch_rows, dtype=jnp.int32)
@@ -84,7 +96,7 @@ def assemble_batch(
     r = jnp.where(ok, rows, 0)
     starts = corpus["starts"][r]
     lens = jnp.where(ok, corpus["lens"][r], 0)
-    cols = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    cols = col0 + jnp.arange(max_len, dtype=jnp.int32)[None, :]
     within = cols < lens[:, None]
     idx = jnp.minimum(starts[:, None] + cols, corpus["flat"].shape[0] - 1)
     return jnp.where(within, corpus["flat"][idx], PAD)
@@ -130,13 +142,6 @@ def jit_resident_chunk_runner(config: Word2VecConfig, tables: DeviceTables):
     return jax.jit(make_resident_chunk_runner(config, tables), donate_argnums=0)
 
 
-def epoch_order(seed: int, epoch_index: int, num_rows: int) -> np.ndarray:
-    """The host-side row permutation for one epoch — the same pure function
-    of (seed, epoch) as BatchIterator.epoch, so resident and streaming paths
-    visit identical rows in identical order."""
-    order = np.arange(num_rows, dtype=np.int64)
-    np.random.default_rng((seed, epoch_index)).shuffle(order)
-    return order
 
 
 def epoch_step_words(

@@ -192,6 +192,77 @@ def make_sharded_chunk(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh)
     return jax.jit(chunkfn, donate_argnums=0)
 
 
+def make_sharded_resident_chunk(
+    config: Word2VecConfig, tables: DeviceTables, mesh: Mesh
+):
+    """Resident-corpus chunked dispatch over the mesh (ops/resident.py).
+
+    chunk(params, corpus, order, base_key, step0, epoch_t0, alphas[S]) — the
+    sharded analog of ops/resident.make_resident_chunk_runner: the packed
+    corpus and the epoch's row order are replicated over the mesh (spec P();
+    text8 is ~68 MB/chip), and each (data, seq) shard assembles ITS OWN
+    [B, L/sp] token block inside the scan — data shard j takes permuted row
+    block t*dp + j, seq shard q takes column window [q*Lloc, (q+1)*Lloc).
+    That reproduces exactly the global [dp*B, L] batch the streaming path
+    builds on host and shards at placement time (TOKEN_SPEC), so the
+    trajectory is identical (tests/test_resident.py) — with zero per-chunk
+    token traffic. Single-process meshes only: multi-host runs feed
+    per-process corpus SHARDS, which have no shared global row order.
+    """
+    from ..ops.resident import assemble_batch
+
+    dp = mesh.shape[DATA_AXIS]
+    sp = mesh.shape[SEQ_AXIS]
+    tp = mesh.shape[MODEL_AXIS]
+    inner = make_train_step(
+        config,
+        tables,
+        tp_axis=MODEL_AXIS if tp > 1 else None,
+        dp_axis=DATA_AXIS if dp > 1 else None,
+        sp_axis=SEQ_AXIS if sp > 1 else None,
+    )
+    B = config.batch_rows
+    Lloc = config.max_sentence_len // sp
+
+    def local_chunk(params, corpus, order, base_key, step0, epoch_t0, alphas):
+        p = {k: v[0] for k, v in params.items()}
+        dpi = jax.lax.axis_index(DATA_AXIS)
+        col0 = jax.lax.axis_index(SEQ_AXIS) * Lloc
+
+        def body(pp, xs):
+            i, a = xs
+            toks = assemble_batch(
+                corpus, order, (epoch_t0 + i) * dp + dpi, B, Lloc, col0
+            )
+            key = jax.random.fold_in(base_key, step0 + i)
+            pp, m = inner(pp, toks, key, a)
+            m = {
+                k: jax.lax.psum(jax.lax.psum(v, MODEL_AXIS) / tp, REPLICA_AXES)
+                for k, v in m.items()
+            }
+            return pp, (m["loss_sum"], m["pairs"])
+
+        s = alphas.shape[0]
+        idx = jnp.arange(s, dtype=jnp.int32)
+        p, (loss, pairs) = jax.lax.scan(body, p, (idx, alphas))
+        return (
+            {k: v[None] for k, v in p.items()},
+            {"loss_sum": loss, "pairs": pairs},
+        )
+
+    def chunkfn(params, corpus, order, base_key, step0, epoch_t0, alphas):
+        specs = {k: PARAM_SPEC for k in params}
+        corpus_specs = {k: P() for k in corpus}
+        return jax.shard_map(
+            local_chunk,
+            mesh=mesh,
+            in_specs=(specs, corpus_specs, P(), P(), P(), P(), P()),
+            out_specs=(specs, P()),
+        )(params, corpus, order, base_key, step0, epoch_t0, alphas)
+
+    return jax.jit(chunkfn, donate_argnums=0)
+
+
 def make_sync(mesh: Mesh):
     """Jitted pmean of all replicas over the data and seq axes (ICI
     all-reduce)."""
@@ -246,9 +317,10 @@ class ShardedTrainer(Trainer):
     """Data+sequence+tensor-parallel trainer; dp*sp*tp <= len(jax.devices())."""
 
     supports_chunking = True
-    # row blocks are sharded across replicas at placement time, so the
-    # sharded path streams from host (config.resident is a single-chip knob)
-    supports_resident = False
+    # resident corpus: each (data, seq) shard assembles its own token block
+    # from a mesh-replicated corpus (make_sharded_resident_chunk);
+    # multi-host runs stream (per-process corpus shards share no row order)
+    supports_resident = True
 
     def __init__(
         self,
@@ -479,6 +551,45 @@ class ShardedTrainer(Trainer):
         if self.procs == 1:
             return jax.device_put(np_chunk, sharding)
         return jax.make_array_from_process_local_data(sharding, np_chunk)
+
+    # ------------------------------------------------- resident-corpus hooks
+    def _build_resident(self):
+        if self.procs > 1:
+            if self.config.resident == "on":
+                import warnings
+
+                warnings.warn(
+                    "config.resident='on' is single-process only (multi-host "
+                    "feeds per-process corpus shards with no shared row "
+                    "order); streaming from host.",
+                    stacklevel=2,
+                )
+            return None
+        return super()._build_resident()
+
+    def _make_resident_runtime(self):
+        from ..ops import resident as res
+
+        rep = NamedSharding(self.mesh, P())
+        corpus_dev = {
+            k: jax.device_put(v, rep)
+            for k, v in res.corpus_arrays(self.corpus).items()
+        }
+        return (
+            make_sharded_resident_chunk(self.config, self.tables, self.mesh),
+            corpus_dev,
+        )
+
+    def _resident_rows_per_step(self) -> int:
+        # one global step consumes dp row blocks of batch_rows each; with
+        # procs == 1 (guaranteed by _build_resident) this matches the agreed
+        # steps/epoch: ceil(ceil(R/B)/dp) == ceil(R/(B*dp))
+        return self.config.batch_rows * self.dp
+
+    def _place_resident_order(self, order: np.ndarray) -> jnp.ndarray:
+        return jax.device_put(
+            order.astype(np.int32), NamedSharding(self.mesh, P())
+        )
 
     def _place(self, local_rows: np.ndarray) -> jnp.ndarray:
         if self.procs == 1:

@@ -59,9 +59,8 @@ class Trainer:
     #: chunked dispatch (config.chunk_steps) — subclasses without a chunk
     #: runner set this False to force the per-step path
     supports_chunking = True
-    #: device-resident corpus (config.resident, ops/resident.py) — sharded
-    #: trainers keep the streaming host path (row blocks are sharded across
-    #: replicas at placement time)
+    #: device-resident corpus (config.resident, ops/resident.py); subclasses
+    #: that cannot host the corpus on device set this False
     supports_resident = True
     #: loss of the most recently drained chunk (chunked driver's final_loss)
     _last_chunk_loss: float = float("nan")
@@ -384,9 +383,8 @@ class Trainer:
                 import warnings
 
                 warnings.warn(
-                    "config.resident='on' but this trainer streams from host "
-                    "(sharded training shards row blocks at placement time); "
-                    "falling back to the streaming path.",
+                    "config.resident='on' but this trainer cannot host the "
+                    "corpus on device; falling back to the streaming path.",
                     stacklevel=2,
                 )
             return None
@@ -398,10 +396,24 @@ class Trainer:
                     f"budget (ops/resident.RESIDENT_MAX_BYTES)"
                 )
             return None
+        return self._make_resident_runtime()
+
+    def _make_resident_runtime(self):
+        """(chunk_fn, device_corpus) — sharded trainers override placement
+        and the runner (replicated corpus over the mesh)."""
+        from .ops import resident as res
+
         return (
-            res.jit_resident_chunk_runner(cfg, self.tables),
+            res.jit_resident_chunk_runner(self.config, self.tables),
             res.device_corpus(self.corpus),
         )
+
+    def _resident_rows_per_step(self) -> int:
+        """Corpus rows one optimizer step consumes (sharded: dp row blocks)."""
+        return self.config.batch_rows
+
+    def _place_resident_order(self, order: np.ndarray) -> jnp.ndarray:
+        return jnp.asarray(order.astype(np.int32))
 
     def _chunk_dispatches(
         self,
@@ -426,9 +438,11 @@ class Trainer:
             chunk_fn, corpus_dev = self._resident
             cfg = self.config
             order = res.epoch_order(cfg.seed, epoch, self.corpus.num_rows)
-            step_words = res.epoch_step_words(self.corpus, order, cfg.batch_rows)
-            order_dev = jnp.asarray(order.astype(np.int32))
-            spe = batcher.steps_per_epoch()
+            step_words = res.epoch_step_words(
+                self.corpus, order, self._resident_rows_per_step()
+            )
+            order_dev = self._place_resident_order(order)
+            spe = len(step_words)
             for t0 in range(skip, spe, chunk_len):
                 words_list = [int(w) for w in step_words[t0:t0 + chunk_len]]
 

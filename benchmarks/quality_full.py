@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Quality at the FLAGSHIP configuration: dim=300, w=5, k=5, band kernel,
+chunked + resident dispatch — the exact shipped fast path bench.py times.
+
+The parity matrix (benchmarks/parity.py, PARITY_MATRIX_r2.txt) gates quality
+at a CI-sized budget (200k tokens, dim=64). This harness closes the gap to
+the headline performance claim: it trains the SAME code path the throughput
+bench measures, at full dim and batch geometry, on a topic corpus large
+enough that the auto geometry picks production-sized dispatches, then scores
+structure recovery with the parity metrics (Spearman vs planted golds, cosine
+margin, neighbor purity).
+
+Runs on whatever device JAX resolves (TPU when the tunnel is up). One JSON
+line to stdout, e.g.:
+  python benchmarks/quality_full.py --tokens 4000000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+from parity import eval_vectors  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=4_000_000)
+    ap.add_argument("--dim", type=int, default=300)
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--negative", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--model", choices=["sg", "cbow"], default="sg")
+    ap.add_argument("--train-method", choices=["ns", "hs"], default="ns")
+    ap.add_argument("--n-topics", type=int, default=32)
+    ap.add_argument("--words-per-topic", type=int, default=80)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--backend", default=None,
+                    help="forwarded to the CLI (default: device auto)")
+    ap.add_argument("--run-timeout", type=float, default=1800.0,
+                    help="watchdog for the training child (a tunnel hang "
+                    "post-probe would otherwise wedge with no output, the "
+                    "BENCH_r01 failure mode)")
+    args = ap.parse_args()
+
+    from word2vec_tpu.utils.synthetic import topic_corpus, topic_similarity_pairs
+
+    tokens, topic_of = topic_corpus(
+        n_topics=args.n_topics,
+        words_per_topic=args.words_per_topic,
+        shared_words=args.n_topics * 5,
+        n_tokens=args.tokens,
+        seed=args.seed,
+    )
+    pairs = topic_similarity_pairs(topic_of, seed=args.seed + 1)
+    if args.train_method == "hs":
+        args.negative = 0
+
+    import subprocess
+
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(os.path.join(tmp, "text8"), "w") as f:
+            f.write(" ".join(tokens))
+        cmd = [
+            sys.executable, "-m", "word2vec_tpu.cli",
+            "-train", "text8", "-output", "vec.txt", "--quiet",
+            "-model", args.model, "-train_method", args.train_method,
+            "-negative", str(args.negative), "-size", str(args.dim),
+            "-window", str(args.window), "-iter", str(args.iters),
+            "-min-count", "5", "-subsample", "1e-4",
+            "--chunk-steps", "0",
+        ]
+        if args.backend:
+            cmd += ["--backend", args.backend]
+        env = {
+            **os.environ,
+            "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        }
+        t0 = time.perf_counter()
+        try:
+            run = subprocess.run(
+                cmd, cwd=tmp, env=env, capture_output=True, text=True,
+                timeout=args.run_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps(
+                {"error": f"train hang (> {args.run_timeout:.0f}s)"}
+            ))
+            return
+        wall = time.perf_counter() - t0
+        if run.returncode != 0:
+            print(json.dumps({
+                "error": f"train rc={run.returncode}",
+                "stderr_tail": run.stderr.strip().splitlines()[-6:],
+            }))
+            return
+        scores = eval_vectors(os.path.join(tmp, "vec.txt"), pairs, topic_of)
+
+    # what the CLI's auto-selection actually routes this config through
+    kernel = "band" if args.train_method == "ns" else "hs-positional"
+    print(json.dumps({
+        "config": f"{args.model}+{args.train_method} k={args.negative} "
+        f"dim={args.dim} w={args.window} iter={args.iters} "
+        f"(shipped path: {kernel} kernel, resident, chunked, auto geometry)",
+        "corpus": f"topic-synthetic-{args.tokens} tokens "
+        f"({args.n_topics} topics)",
+        "train_wall_s": round(wall, 1),
+        **scores,
+    }))
+
+
+if __name__ == "__main__":
+    main()

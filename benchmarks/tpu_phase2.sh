@@ -1,0 +1,22 @@
+#!/bin/bash
+# Phase-2 TPU measurements (run after tpu_watch2.sh's core sweep):
+# full-path quality at flagship dim, BASELINE config-4 shape at scale,
+# and the kernel ablation.
+cd "$(dirname "$0")/.."
+OUT=benchmarks/TPU_R2
+probe() { timeout 60 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; }
+# the chip is shared: wait for tpu_watch2.sh's core sweep to finish first
+# (concurrent benches would corrupt both sets of numbers), then for the tunnel
+until grep -q DONE $OUT/sweep2.txt 2>/dev/null; do sleep 110; done
+until probe; do sleep 110; done
+echo "phase2 start $(date)" >> $OUT/phase2.txt
+
+echo "=== quality_full flagship (dim=300, band+resident+chunked)" >> $OUT/phase2.txt
+timeout 1800 python benchmarks/quality_full.py --tokens 4000000 2>/dev/null | tail -1 >> $OUT/phase2.txt
+
+echo "=== bench enwik9-shape (100M tokens, w=10)" >> $OUT/phase2.txt
+timeout 1800 python bench.py --tokens 100000000 --window 10 --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
+
+echo "=== ablate" >> $OUT/phase2.txt
+timeout 900 python benchmarks/ablate.py 2>/dev/null | tail -40 >> $OUT/phase2.txt
+echo DONE >> $OUT/phase2.txt

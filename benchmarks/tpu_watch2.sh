@@ -1,0 +1,26 @@
+#!/bin/bash
+# Wait for the TPU tunnel, then run the round-2 measurement sweep.
+cd "$(dirname "$0")/.."
+OUT=benchmarks/TPU_R2
+probe() { timeout 60 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; }
+echo "watch2 start $(date)" >> $OUT/sweep2.txt
+n=0
+until probe; do
+  n=$((n+1)); sleep 110
+done
+echo "tunnel up after $n waits $(date)" >> $OUT/sweep2.txt
+for args in \
+  "" \
+  "--resident 0" \
+  "--chunk-cap 96" \
+  "--batch-rows 512" \
+  "--kp 32" \
+  "--batch-rows 512 --kp 32" \
+  ; do
+  echo "=== bench $args" >> $OUT/sweep2.txt
+  timeout 900 python bench.py $args --probe-retries 1 2>/dev/null | tail -1 >> $OUT/sweep2.txt
+done
+echo "=== trace capture" >> $OUT/sweep2.txt
+timeout 600 python benchmarks/trace_tools.py capture --out /tmp/tr_r2 >> $OUT/trace_capture.out 2>&1
+timeout 300 python benchmarks/trace_tools.py report /tmp/tr_r2 > $OUT/trace_report.txt 2>&1
+echo DONE >> $OUT/sweep2.txt

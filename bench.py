@@ -105,6 +105,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         batch_rows=args.batch_rows,
         max_sentence_len=args.max_len,
         slab_scatter=bool(args.slab_scatter),
+        fused_tables=bool(args.fused),
         shared_negatives=args.kp,
         band_chunk=args.band_chunk,
     )
@@ -252,6 +253,9 @@ def build_parser() -> argparse.ArgumentParser:
                     "KP=8 on the parity harness; PERF.md)")
     ap.add_argument("--band-chunk", type=int, default=0,
                     help="band slab row-chunk S (0 = auto; ops/banded.py)")
+    ap.add_argument("--fused", type=int, default=0, choices=[0, 1],
+                    help="fused-table scatter inside chunks "
+                    "(config.fused_tables; band ns only)")
     ap.add_argument("--resident", type=int, default=1, choices=[0, 1],
                     help="device-resident corpus (ops/resident.py); falls "
                     "back to host streaming when the corpus exceeds HBM "
@@ -344,7 +348,7 @@ def main() -> None:
         ("--batch-rows", args.batch_rows), ("--max-len", args.max_len),
         ("--chunk-cap", args.chunk_cap), ("--slab-scatter", args.slab_scatter),
         ("--kp", args.kp), ("--band-chunk", args.band_chunk),
-        ("--resident", args.resident),
+        ("--resident", args.resident), ("--fused", args.fused),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:
         child_cmd += [flag, str(val)]

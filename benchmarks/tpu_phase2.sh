@@ -11,6 +11,10 @@ until grep -q DONE $OUT/sweep2.txt 2>/dev/null; do sleep 110; done
 until probe; do sleep 110; done
 echo "phase2 start $(date)" >> $OUT/phase2.txt
 
+echo "=== bench fused-table A/B" >> $OUT/phase2.txt
+timeout 900 python bench.py --fused 1 --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
+timeout 900 python bench.py --fused 1 --batch-rows 512 --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
+
 echo "=== quality_full flagship (dim=300, band+resident+chunked)" >> $OUT/phase2.txt
 timeout 1800 python benchmarks/quality_full.py --tokens 4000000 2>/dev/null | tail -1 >> $OUT/phase2.txt
 

@@ -27,8 +27,10 @@ def make_inputs(L, W, seed=0):
     return a, b, v, keep, valid, w_eff
 
 
-# (L, W, S): ragged chunks, exact multiples, minimum S = 2W
-GEOMS = [(12, 2, 4), (13, 2, 4), (16, 3, 6), (21, 1, 5), (9, 2, 8)]
+# (L, W, S): ragged chunks, exact multiples, minimum S = 2W, plus the
+# BASELINE config-4 window (w=10) at a production-like slab (S = 128 - 2W)
+GEOMS = [(12, 2, 4), (13, 2, 4), (16, 3, 6), (21, 1, 5), (9, 2, 8),
+         (192, 10, 108)]
 
 
 @pytest.mark.parametrize("L,W,S", GEOMS)
@@ -60,7 +62,10 @@ def test_chunked_matches_dense(L, W, S):
     np.testing.assert_allclose(
         float(banded.band_loss_sum(qk_d)),
         float(banded.band_loss_sum(qk_c)),
-        atol=1e-4,
+        # relative: the global sum aggregates O(B*L*W) f32 terms, so the
+        # reassociation noise floor scales with the geometry; atol floor for
+        # the signed sum landing near zero
+        rtol=1e-4, atol=1e-3,
     )
 
     # contractions against context values and center values

@@ -1,0 +1,73 @@
+"""Fused-table chunked dispatch (config.fused_tables; ops/band_step.py).
+
+The fused layout stacks {emb_in, emb_out_ns} into one [V, 2, d] array inside
+a dispatched chunk so gathers and scatters hit both tables in one indexed op.
+Claims pinned here:
+  1. identical trajectory: fused vs unfused chunked training produce the
+     same parameters (sg and cbow, scatter_mean on/off, resident and
+     streaming dispatch);
+  2. fuse/unfuse round-trips;
+  3. the config guards reject the unsupported combinations.
+"""
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.ops.band_step import fuse_tables, unfuse_tables
+from word2vec_tpu.train import Trainer
+from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+
+def _toy(n_tokens=4000, vocab_size=60, seed=5):
+    vocab = zipf_vocab(vocab_size=vocab_size, total_words=n_tokens * 10)
+    sents = zipf_corpus_ids(vocab, num_tokens=n_tokens, seed=seed,
+                            sentence_len=41)
+    return vocab, PackedCorpus.pack(sents, 16)
+
+
+def test_fuse_roundtrip():
+    rng = np.random.default_rng(0)
+    params = {
+        "emb_in": rng.normal(size=(10, 4)).astype(np.float32),
+        "emb_out_ns": rng.normal(size=(10, 4)).astype(np.float32),
+    }
+    back = unfuse_tables(fuse_tables(params))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(back[k]), params[k])
+
+
+@pytest.mark.parametrize("resident", ["on", "off"])
+@pytest.mark.parametrize("model,scatter_mean", [
+    ("sg", False), ("sg", True), ("cbow", False), ("cbow", True),
+])
+def test_fused_trajectory_identical(model, scatter_mean, resident):
+    vocab, corpus = _toy()
+    kw = dict(
+        model=model, train_method="ns", negative=4, word_dim=16, window=2,
+        min_count=1, subsample_threshold=1e-3, iters=2, batch_rows=4,
+        max_sentence_len=16, chunk_steps=8, seed=3,
+        scatter_mean=scatter_mean, resident=resident,
+    )
+
+    def run(fused):
+        cfg = Word2VecConfig(fused_tables=fused, **kw)
+        state, _ = Trainer(cfg, vocab, corpus).train(log_every=0)
+        return state
+
+    s_f, s_u = run(True), run(False)
+    assert s_f.step == s_u.step
+    for k in s_u.params:
+        np.testing.assert_array_equal(
+            np.asarray(s_f.params[k]), np.asarray(s_u.params[k]), err_msg=k
+        )
+
+
+def test_fused_guards():
+    with pytest.raises(ValueError, match="slab_scatter"):
+        Word2VecConfig(fused_tables=True, slab_scatter=True)
+    with pytest.raises(ValueError, match="band kernel"):
+        Word2VecConfig(fused_tables=True, train_method="hs", negative=0)
+    with pytest.raises(ValueError, match="band kernel"):
+        Word2VecConfig(fused_tables=True, kernel="pair")

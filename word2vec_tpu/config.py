@@ -133,6 +133,16 @@ class Word2VecConfig:
     # tests/test_band_step_golden.py). Trade: (S+2W)/S more scatter rows.
     slab_scatter: bool = False
 
+    # Band kernel, chunked dispatch only: carry {emb_in, emb_out_ns} as one
+    # [V, 2, d] array inside each dispatched chunk so the two sorted table
+    # scatters (and gathers) become one indexed op each — the scatter cost
+    # is per-row machinery, not bytes (PERF.md), so this halves it. Fusion
+    # happens at chunk boundaries (ops/band_step.fuse_tables); params keep
+    # their public {emb_in, emb_out_ns} layout everywhere else, and the
+    # trajectory is bitwise identical (tests/test_fused.py). Incompatible
+    # with slab_scatter (different index set per table).
+    fused_tables: bool = False
+
     # --- multi-chip (no reference counterpart; replaces OpenMP Hogwild) ---
     # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
     dp_sync_every: int = 64
@@ -179,6 +189,17 @@ class Word2VecConfig:
             raise ValueError("micro_steps must be >= 1")
         if self.chunk_steps < 0:
             raise ValueError("chunk_steps must be >= 0 (0 = auto)")
+        if self.fused_tables:
+            if self.slab_scatter:
+                raise ValueError(
+                    "fused_tables and slab_scatter are incompatible (the "
+                    "slab context scatter uses a different index set per "
+                    "table; see ops/band_step.py)"
+                )
+            if self.train_method == "hs" or self.kernel == "pair":
+                raise ValueError(
+                    "fused_tables applies to the ns band kernel only"
+                )
         if self.resident not in ("auto", "on", "off"):
             raise ValueError(
                 f"resident must be auto|on|off, got {self.resident!r}"

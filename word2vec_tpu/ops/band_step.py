@@ -109,21 +109,54 @@ def _halo_exchange(tok: jnp.ndarray, w: int, axis: str) -> jnp.ndarray:
     return jnp.concatenate([left, tok, right], axis=1)
 
 
+FUSED_KEY = "emb_ns_fused"
+
+
+def fuse_tables(params: Params) -> Params:
+    """{emb_in [V,d], emb_out_ns [V,d]} -> {emb_ns_fused [V,2,d]} (other keys
+    pass through). The fused layout lets the band step gather and scatter
+    both tables' rows in ONE indexed op each — the sorted table scatters are
+    row-machinery-bound (~21 ns/row regardless of width, PERF.md), so one
+    [N, 2, d] scatter costs about half of two [N, d] scatters. Applied at
+    chunk boundaries (make_chunk_runner), so the [V, 2, d] restack amortizes
+    over S steps and params keep their public layout everywhere else."""
+    p = dict(params)
+    p[FUSED_KEY] = jnp.stack([p.pop("emb_in"), p.pop("emb_out_ns")], axis=1)
+    return p
+
+
+def unfuse_tables(params: Params) -> Params:
+    p = dict(params)
+    f = p.pop(FUSED_KEY)
+    p["emb_in"] = f[:, 0]
+    p["emb_out_ns"] = f[:, 1]
+    return p
+
+
 def make_band_train_step(
     config: Word2VecConfig,
     tables: DeviceTables,
     tp_axis: str | None = None,
     dp_axis: str | None = None,
     sp_axis: str | None = None,
+    fused: bool = False,
 ) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
     """step(params, tokens[B,L], key, alpha) -> (params, metrics).
 
     Same contract as train_step.make_train_step; negative sampling only.
     With sp_axis, tokens is this shard's [B, Lloc] position slice of a longer
-    row (see module docstring).
+    row (see module docstring). With fused=True, params carry the two tables
+    as one [V, 2, d] array under FUSED_KEY (fuse_tables above) and the
+    update runs as a single fused scatter; bitwise-identical trajectory
+    (tests/test_fused.py).
     """
     if not config.use_ns or config.use_hs:
         raise ValueError("band kernel supports negative sampling only (use pair for hs)")
+    if fused and config.slab_scatter:
+        raise ValueError(
+            "fused_tables requires the sorted shared-index scatter "
+            "(slab_scatter uses a different index set per table)"
+        )
     W = config.window
     K = config.negative
     KP = config.shared_negatives
@@ -175,17 +208,23 @@ def make_band_train_step(
         use_slab = slab_scatter and S > 0
         d_ctx_slab = ctx_w_slab = None
 
-        emb_in = params["emb_in"]
-        emb_out = params["emb_out_ns"]
-        ein = emb_in[tok]   # [B, L, d]
-        eout = emb_out[tok]  # [B, L, d]
+        if fused:
+            emb = params[FUSED_KEY]  # [V, 2, d]
+            emb_in, emb_out = emb[:, 0], emb[:, 1]  # shape/dtype refs only
+            g2 = emb[tok]  # one gather for both tables: [B, L, 2, d]
+            ein, eout = g2[:, :, 0], g2[:, :, 1]
+        else:
+            emb_in = params["emb_in"]
+            emb_out = params["emb_out_ns"]
+            ein = emb_in[tok]   # [B, L, d]
+            eout = emb_out[tok]  # [B, L, d]
 
         # Shared negatives per row + collision mask vs the row's centers and
         # active contexts (see module docstring).
         negs = _draw_negatives(
             k_neg, (B, KP), tables.alias_accept, tables.alias_idx
         )  # [B, KP]
-        en = emb_out[negs]  # [B, KP, d]
+        en = emb[negs, 1] if fused else emb_out[negs]  # [B, KP, d]
         center_hit = tok[:, :, None] == negs[:, None, :]  # [B, L, KP]
         # context collision: neg n hits center i if any active context j of i
         # carries the same token id
@@ -304,6 +343,7 @@ def make_band_train_step(
 
         # emb_in side: dense center rows (sg) or context rows (cbow, slab-able)
         if d_in_pos is not None:
+            in_idx, in_sorted = sorted_idx, True
             d_in_flat = d_in_pos.reshape(-1, d_in_pos.shape[-1])[order]
             if scatter_mean:
                 # per-contribution counts, as in the pair kernel
@@ -311,16 +351,13 @@ def make_band_train_step(
                     emb_in.shape[0], sorted_idx,
                     in_weight.reshape(-1)[order],
                 )[:, None]
-            new_in = emb_in.at[sorted_idx].add(
-                d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
-            )
         else:  # cbow + slab: context grads scatter from slab space
-            vals = d_ctx_flat
+            in_idx, in_sorted = slab_flat, False
+            d_in_flat = d_ctx_flat
             if scatter_mean:
-                vals = vals * _dup_mean_scale(
+                d_in_flat = d_in_flat * _dup_mean_scale(
                     emb_in.shape[0], slab_flat, ctx_w_flat
                 )[:, None]
-            new_in = emb_in.at[slab_flat].add(vals.astype(emb_in.dtype))
 
         # emb_out side: context rows (sg, slab-able) or center rows (cbow),
         # plus the shared-negative rows; under scatter_mean both share ONE
@@ -344,15 +381,28 @@ def make_band_train_step(
             inv = 1.0 / jnp.maximum(cnt, 1.0)
             d_out_flat = d_out_flat * inv[out_idx][:, None]
             d_neg_flat = d_neg_flat * inv[flat_negs][:, None]
-        new_out = emb_out.at[out_idx].add(
-            d_out_flat.astype(emb_out.dtype), indices_are_sorted=out_sorted
-        )
-        # negative-row scatter (KP rows per batch row; duplicates sum)
-        new_out = new_out.at[flat_negs].add(d_neg_flat.astype(emb_out.dtype))
 
         new_params = dict(params)
-        new_params["emb_in"] = new_in
-        new_params["emb_out_ns"] = new_out
+        if fused:
+            # one [N, 2, d] scatter covers both tables (same sorted ids);
+            # negative rows land on the out plane of the fused array
+            vals2 = jnp.stack([d_in_flat, d_out_flat], axis=1)
+            new_emb = emb.at[sorted_idx].add(
+                vals2.astype(emb.dtype), indices_are_sorted=True
+            )
+            new_emb = new_emb.at[flat_negs, 1].add(d_neg_flat.astype(emb.dtype))
+            new_params[FUSED_KEY] = new_emb
+        else:
+            new_in = emb_in.at[in_idx].add(
+                d_in_flat.astype(emb_in.dtype), indices_are_sorted=in_sorted
+            )
+            new_out = emb_out.at[out_idx].add(
+                d_out_flat.astype(emb_out.dtype), indices_are_sorted=out_sorted
+            )
+            # negative-row scatter (KP rows per batch row; duplicates sum)
+            new_out = new_out.at[flat_negs].add(d_neg_flat.astype(emb_out.dtype))
+            new_params["emb_in"] = new_in
+            new_params["emb_out_ns"] = new_out
 
         # masked BCE for metrics, matching the pair kernel's convention:
         # negatives contribute with their expectation weights

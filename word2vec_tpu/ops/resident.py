@@ -117,10 +117,16 @@ def make_resident_chunk_runner(
     Both step indices are traced scalars, so one compiled program serves
     every chunk of every epoch.
     """
-    step = make_train_step(config, tables)
+    fused = config.fused_tables
+    step = make_train_step(config, tables, fused=fused)
     B, L = config.batch_rows, config.max_sentence_len
 
     def chunk(params, corpus, order, base_key, step0, epoch_t0, alphas):
+        if fused:
+            from .band_step import fuse_tables, unfuse_tables
+
+            params = fuse_tables(params)
+
         def body(p, xs):
             i, a = xs
             tokens = assemble_batch(corpus, order, epoch_t0 + i, B, L)
@@ -131,6 +137,8 @@ def make_resident_chunk_runner(
         s = alphas.shape[0]
         idx = jnp.arange(s, dtype=jnp.int32)
         params, (loss, pairs) = jax.lax.scan(body, params, (idx, alphas))
+        if fused:
+            params = unfuse_tables(params)
         return params, {"loss_sum": loss, "pairs": pairs}
 
     return chunk

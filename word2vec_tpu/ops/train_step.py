@@ -124,8 +124,13 @@ def make_train_step(
     tp_axis: str | None = None,
     dp_axis: str | None = None,
     sp_axis: str | None = None,
+    fused: bool = False,
 ) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
     """Build the jittable step, dispatching on config.kernel.
+
+    fused=True (chunk runners, config.fused_tables): params carry the two ns
+    tables as one [V, 2, d] array (ops/band_step.fuse_tables) and the band
+    step updates them with a single scatter; band+ns only.
 
     "band" selects the objective's fast path — banded-matmul ns
     (ops/band_step.py) or positional hs (ops/hs_step.py); "pair" is the
@@ -140,7 +145,7 @@ def make_train_step(
     what decouples device batch geometry from the ~70-optimizer-steps/epoch
     convergence threshold (config.auto_geometry).
     """
-    base = _make_base_step(config, tables, tp_axis, dp_axis, sp_axis)
+    base = _make_base_step(config, tables, tp_axis, dp_axis, sp_axis, fused)
     k = config.micro_steps
     if k <= 1:
         return base
@@ -178,6 +183,7 @@ def _make_base_step(
     tp_axis: str | None = None,
     dp_axis: str | None = None,
     sp_axis: str | None = None,
+    fused: bool = False,
 ):
     if config.resolved_kernel == "band":
         if config.use_hs:
@@ -185,14 +191,20 @@ def _make_base_step(
                 raise ValueError(
                     "sequence parallelism requires the ns band kernel"
                 )
+            if fused:
+                raise ValueError("fused_tables applies to the ns band kernel only")
             from .hs_step import make_hs_train_step
 
             return make_hs_train_step(config, tables, tp_axis, dp_axis)
         from .band_step import make_band_train_step
 
-        return make_band_train_step(config, tables, tp_axis, dp_axis, sp_axis)
+        return make_band_train_step(
+            config, tables, tp_axis, dp_axis, sp_axis, fused
+        )
     if sp_axis is not None:
         raise ValueError("sequence parallelism requires the ns band kernel")
+    if fused:
+        raise ValueError("fused_tables applies to the ns band kernel only")
     return make_pair_train_step(config, tables, tp_axis, dp_axis)
 
 
@@ -442,10 +454,20 @@ def make_chunk_runner(
     A batch whose rows are all padding (-1) is a provable no-op (every mask
     derives from token validity), which is how the trailing partial chunk of
     an epoch is padded to the compiled shape without a second XLA program.
+
+    With config.fused_tables the ns tables are restacked to [V, 2, d] for
+    the chunk's lifetime (band_step.fuse_tables) — the restack amortizes
+    over the S steps, and the public params layout is untouched outside.
     """
-    step = make_train_step(config, tables, tp_axis, dp_axis, sp_axis)
+    fused = config.fused_tables
+    step = make_train_step(config, tables, tp_axis, dp_axis, sp_axis, fused)
 
     def chunk(params, tokens, base_key, step0, alphas):
+        if fused:
+            from .band_step import fuse_tables, unfuse_tables
+
+            params = fuse_tables(params)
+
         def body(p, xs):
             toks, i, a = xs
             key = jax.random.fold_in(base_key, step0 + i)
@@ -455,6 +477,8 @@ def make_chunk_runner(
         s = tokens.shape[0]
         idx = jnp.arange(s, dtype=jnp.int32)
         params, (loss, pairs) = jax.lax.scan(body, params, (tokens, idx, alphas))
+        if fused:
+            params = unfuse_tables(params)
         return params, {"loss_sum": loss, "pairs": pairs}
 
     return chunk

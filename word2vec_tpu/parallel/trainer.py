@@ -370,6 +370,15 @@ class ShardedTrainer(Trainer):
                 "default sum semantics with sequence parallelism"
             )
         self.token_sharding = NamedSharding(self.mesh, TOKEN_SPEC)
+        if config.fused_tables:
+            import warnings
+
+            warnings.warn(
+                "config.fused_tables is single-chip only for now; the "
+                "sharded chunk runners use the unfused step (the flag is a "
+                "no-op on a mesh).",
+                stacklevel=3,
+            )
         self.procs = jax.process_count()
         if self.procs > 1 and self.dp % self.procs != 0:
             raise ValueError(

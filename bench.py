@@ -253,6 +253,10 @@ def build_parser() -> argparse.ArgumentParser:
                     "KP=8 on the parity harness; PERF.md)")
     ap.add_argument("--band-chunk", type=int, default=0,
                     help="band slab row-chunk S (0 = auto; ops/banded.py)")
+    ap.add_argument("--prng", choices=["threefry", "rbg"], default="threefry",
+                    help="jax PRNG impl for the device draw streams; rbg is "
+                    "cheaper on TPU (different stream, statistically "
+                    "equivalent draws)")
     ap.add_argument("--fused", type=int, default=0, choices=[0, 1],
                     help="fused-table scatter inside chunks "
                     "(config.fused_tables; band ns only)")
@@ -300,6 +304,8 @@ def inner_main(args: argparse.Namespace) -> None:
             # JAX_PLATFORMS env is overridden by the axon sitecustomize's
             # jax.config call; config.update after import wins over both.
             jax.config.update("jax_platforms", "cpu")
+        if args.prng != "threefry":
+            jax.config.update("jax_default_prng_impl", args.prng)
         emit(run(args, args.fallback_reason))
     except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
         import traceback
@@ -349,6 +355,7 @@ def main() -> None:
         ("--chunk-cap", args.chunk_cap), ("--slab-scatter", args.slab_scatter),
         ("--kp", args.kp), ("--band-chunk", args.band_chunk),
         ("--resident", args.resident), ("--fused", args.fused),
+        ("--prng", args.prng),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:
         child_cmd += [flag, str(val)]

@@ -15,6 +15,10 @@ echo "=== bench fused-table A/B" >> $OUT/phase2.txt
 timeout 900 python bench.py --fused 1 --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
 timeout 900 python bench.py --fused 1 --batch-rows 512 --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
 
+echo "=== bench prng A/B (rbg)" >> $OUT/phase2.txt
+timeout 900 python bench.py --prng rbg --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
+timeout 900 python bench.py --prng rbg --fused 1 --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
+
 echo "=== quality_full flagship (dim=300, band+resident+chunked)" >> $OUT/phase2.txt
 timeout 1800 python benchmarks/quality_full.py --tokens 4000000 2>/dev/null | tail -1 >> $OUT/phase2.txt
 

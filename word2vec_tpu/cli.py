@@ -68,6 +68,13 @@ def build_parser() -> argparse.ArgumentParser:
     # TPU-native extensions
     p.add_argument("--backend", choices=["tpu", "cpu"], default="tpu",
                    help="device backend (BASELINE.json north star)")
+    p.add_argument("--prng", choices=["threefry", "rbg"], default="threefry",
+                   help="jax PRNG impl for the device draw streams "
+                        "(subsample gate / window shrink / negatives); rbg "
+                        "is cheaper on TPU, statistically equivalent, but a "
+                        "different stream - the impl is not part of the "
+                        "checkpoint, so pass the same --prng when resuming "
+                        "to keep one consistent stream")
     p.add_argument("--dp", type=int, default=1, help="data-parallel mesh axis")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
     p.add_argument("--sp", type=int, default=1,
@@ -172,6 +179,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
+    if args.prng != "threefry":
+        import jax
+
+        jax.config.update("jax_default_prng_impl", args.prng)
 
     import jax
 

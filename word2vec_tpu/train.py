@@ -188,6 +188,15 @@ class Trainer:
                 "(chunk_steps=0 for auto, or >1), but this run resolved to "
                 "per-step dispatch"
             )
+        if cfg.fused_tables:
+            import warnings
+
+            warnings.warn(
+                "config.fused_tables applies to chunked dispatch only "
+                "(chunk_steps=0 or >1); the per-step path uses the unfused "
+                "step.",
+                stacklevel=2,
+            )
         # state.epoch = epoch in progress; a mid-epoch checkpoint re-enters it
         # at the first undone batch (_resume_skip)
         skip = self._resume_skip(state, batcher)

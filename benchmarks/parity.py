@@ -115,6 +115,8 @@ def main() -> None:
                     help="band-kernel shared draws per row for OUR side")
     ap.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                     help="band-kernel slab-space context scatter for OUR side")
+    ap.add_argument("--prng", choices=["threefry", "rbg"], default="threefry",
+                    help="jax PRNG impl for OUR side (CLI --prng)")
     ap.add_argument("--skip-reference", action="store_true",
                     help="evaluate only this framework (no g++/reference)")
     args = ap.parse_args()
@@ -132,7 +134,7 @@ def main() -> None:
         "config": f"{args.model}+{args.train_method} k={args.negative} "
         f"dim={args.dim} w={args.window} iter={args.iters} "
         f"subsample={args.subsample} kernel={args.kernel} "
-        f"kp={args.shared_negatives}",
+        f"kp={args.shared_negatives} prng={args.prng}",
         "corpus": f"topic-synthetic-{args.tokens} tokens",
     }
     with tempfile.TemporaryDirectory() as tmp:
@@ -164,6 +166,7 @@ def main() -> None:
                 "--kernel", args.kernel,
                 "--shared-negatives", str(args.shared_negatives),
                 "--slab-scatter", str(args.slab_scatter),
+                "--prng", args.prng,
             ],
             cwd=tmp, check=True, capture_output=True,
             env={**os.environ, "PYTHONPATH": REPO + os.pathsep

@@ -64,6 +64,39 @@ def test_fused_trajectory_identical(model, scatter_mean, resident):
         )
 
 
+@pytest.mark.parametrize("resident", ["on", "off"])
+@pytest.mark.parametrize("mesh_shape", [(4, 1, 1), (2, 2, 2)])
+def test_fused_sharded_trajectory_identical(mesh_shape, resident):
+    """Fused tables inside the sharded chunk runners (per-shard restack;
+    with tp the stacked [V, 2, d/TP] keeps the dim sharding)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from word2vec_tpu.parallel import ShardedTrainer, make_mesh
+
+    dp, sp, tp = mesh_shape
+    vocab, corpus = _toy(n_tokens=6000)
+    kw = dict(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        min_count=1, subsample_threshold=1e-3, iters=2, batch_rows=4,
+        max_sentence_len=16, chunk_steps=4, seed=11, dp_sync_every=8,
+        resident=resident,  # on = resident runner, off = streaming runner
+    )
+
+    def run(fused):
+        cfg = Word2VecConfig(fused_tables=fused, **kw)
+        trainer = ShardedTrainer(cfg, vocab, corpus, mesh=make_mesh(dp, tp, sp))
+        state, _ = trainer.train(log_every=0)
+        return trainer.export_params(state)
+
+    p_f, p_u = run(True), run(False)
+    for k in p_u:
+        np.testing.assert_array_equal(
+            np.asarray(p_f[k]), np.asarray(p_u[k]), err_msg=k
+        )
+
+
 def test_fused_guards():
     with pytest.raises(ValueError, match="slab_scatter"):
         Word2VecConfig(fused_tables=True, slab_scatter=True)

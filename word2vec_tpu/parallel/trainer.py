@@ -151,16 +151,24 @@ def make_sharded_chunk(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh)
     dp = mesh.shape[DATA_AXIS]
     sp = mesh.shape[SEQ_AXIS]
     tp = mesh.shape[MODEL_AXIS]
+    fused = config.fused_tables
     inner = make_train_step(
         config,
         tables,
         tp_axis=MODEL_AXIS if tp > 1 else None,
         dp_axis=DATA_AXIS if dp > 1 else None,
         sp_axis=SEQ_AXIS if sp > 1 else None,
+        fused=fused,
     )
 
     def local_chunk(params, tokens, base_key, step0, alphas):
         p = {k: v[0] for k, v in params.items()}
+        if fused:
+            # per-shard restack: with tp the stacked [V, 2, d/TP] keeps the
+            # dim sharding (stack axis 1 is local); amortizes over the chunk
+            from ..ops.band_step import fuse_tables, unfuse_tables
+
+            p = fuse_tables(p)
 
         def body(pp, xs):
             toks, i, a = xs
@@ -175,6 +183,8 @@ def make_sharded_chunk(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh)
         s = tokens.shape[0]
         idx = jnp.arange(s, dtype=jnp.int32)
         p, (loss, pairs) = jax.lax.scan(body, p, (tokens, idx, alphas))
+        if fused:
+            p = unfuse_tables(p)
         return (
             {k: v[None] for k, v in p.items()},
             {"loss_sum": loss, "pairs": pairs},
@@ -214,18 +224,24 @@ def make_sharded_resident_chunk(
     dp = mesh.shape[DATA_AXIS]
     sp = mesh.shape[SEQ_AXIS]
     tp = mesh.shape[MODEL_AXIS]
+    fused = config.fused_tables
     inner = make_train_step(
         config,
         tables,
         tp_axis=MODEL_AXIS if tp > 1 else None,
         dp_axis=DATA_AXIS if dp > 1 else None,
         sp_axis=SEQ_AXIS if sp > 1 else None,
+        fused=fused,
     )
     B = config.batch_rows
     Lloc = config.max_sentence_len // sp
 
     def local_chunk(params, corpus, order, base_key, step0, epoch_t0, alphas):
         p = {k: v[0] for k, v in params.items()}
+        if fused:
+            from ..ops.band_step import fuse_tables, unfuse_tables
+
+            p = fuse_tables(p)
         dpi = jax.lax.axis_index(DATA_AXIS)
         col0 = jax.lax.axis_index(SEQ_AXIS) * Lloc
 
@@ -245,6 +261,8 @@ def make_sharded_resident_chunk(
         s = alphas.shape[0]
         idx = jnp.arange(s, dtype=jnp.int32)
         p, (loss, pairs) = jax.lax.scan(body, p, (idx, alphas))
+        if fused:
+            p = unfuse_tables(p)
         return (
             {k: v[None] for k, v in p.items()},
             {"loss_sum": loss, "pairs": pairs},
@@ -370,15 +388,6 @@ class ShardedTrainer(Trainer):
                 "default sum semantics with sequence parallelism"
             )
         self.token_sharding = NamedSharding(self.mesh, TOKEN_SPEC)
-        if config.fused_tables:
-            import warnings
-
-            warnings.warn(
-                "config.fused_tables is single-chip only for now; the "
-                "sharded chunk runners use the unfused step (the flag is a "
-                "no-op on a mesh).",
-                stacklevel=3,
-            )
         self.procs = jax.process_count()
         if self.procs > 1 and self.dp % self.procs != 0:
             raise ValueError(

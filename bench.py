@@ -96,16 +96,16 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
 
     cfg = Word2VecConfig(
-        model="sg",
-        train_method="ns",
-        negative=args.negative,
+        model=args.model,
+        train_method=args.train_method,
+        negative=args.negative if args.train_method == "ns" else 0,
         word_dim=args.dim,
         window=args.window,
         subsample_threshold=1e-4,
         batch_rows=args.batch_rows,
         max_sentence_len=args.max_len,
         slab_scatter=bool(args.slab_scatter),
-        fused_tables=bool(args.fused),
+        fused_tables=bool(args.fused) and args.train_method == "ns",
         shared_negatives=args.kp,
         band_chunk=args.band_chunk,
     )
@@ -201,8 +201,14 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "benchmarks",
         "reference_baseline.json",
     )
+    # the recorded reference baseline is the FLAGSHIP config (sg+ns dim=300
+    # w=5 k=5); the ratio is only meaningful on that shape
+    flagship = (
+        args.model == "sg" and args.train_method == "ns"
+        and args.dim == 300 and args.window == 5 and args.negative == 5
+    )
     vs = None
-    if os.path.exists(baseline_path):
+    if flagship and os.path.exists(baseline_path):
         with open(baseline_path) as f:
             ref = json.load(f)
         if ref.get("words_per_sec"):
@@ -215,7 +221,8 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         None,
     )
     record = {
-        "metric": f"sgns-dim{args.dim}-w{args.window}-k{args.negative} "
+        "metric": f"{args.model}+{args.train_method}-dim{args.dim}"
+        f"-w{args.window}-k{cfg.negative} "
         f"words/sec ({corpus_name}, {dev.platform})",
         "value": round(wps, 1),
         "unit": "words/sec",
@@ -240,6 +247,10 @@ def build_parser() -> argparse.ArgumentParser:
     # adjacent fixed costs dominate: 1.5M w/s there vs 3.6M at 20M, measured)
     ap.add_argument("--tokens", type=int, default=17_000_000)
     ap.add_argument("--dim", type=int, default=300)
+    ap.add_argument("--model", choices=["sg", "cbow"], default="sg")
+    ap.add_argument("--train-method", choices=["ns", "hs"], default="ns",
+                    help="hs benches the positional Huffman kernel "
+                    "(BASELINE config 3)")
     ap.add_argument("--window", type=int, default=5)
     ap.add_argument("--negative", type=int, default=5)
     ap.add_argument("--batch-rows", type=int, default=256)
@@ -285,7 +296,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def error_record(args: argparse.Namespace, err: str, note: str | None) -> dict:
     return {
-        "metric": f"sgns-dim{args.dim}-w{args.window}-k{args.negative} words/sec",
+        "metric": f"{args.model}+{args.train_method}-dim{args.dim}"
+        f"-w{args.window}"
+        f"-k{args.negative if args.train_method == 'ns' else 0} words/sec",
         "value": None,
         "unit": "words/sec",
         "vs_baseline": None,
@@ -350,6 +363,7 @@ def main() -> None:
     child_cmd += ["--fallback-reason", platform_note] if platform_note else []
     for flag, val in [
         ("--tokens", args.tokens), ("--dim", args.dim),
+        ("--model", args.model), ("--train-method", args.train_method),
         ("--window", args.window), ("--negative", args.negative),
         ("--batch-rows", args.batch_rows), ("--max-len", args.max_len),
         ("--chunk-cap", args.chunk_cap), ("--slab-scatter", args.slab_scatter),

@@ -105,6 +105,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sentence rows per device step; 0 = auto-size so an "
                         "epoch has enough optimizer steps to learn (see "
                         "config.scatter_mean notes)")
+    p.add_argument("--clip-row-update", type=float, default=1.0,
+                   help="per-row trust region: max L2 norm of one row's "
+                        "summed update per optimizer step (0 = off). "
+                        "Prevents hot-row divergence of batched-sum updates "
+                        "at scale; a no-op below the cap "
+                        "(config.clip_row_update)")
     p.add_argument("--scatter-mean", type=int, default=0, choices=[0, 1],
                    help="normalize duplicate-row updates by count (hot-row "
                         "stabilizer; 0 = reference-faithful sum)")
@@ -240,6 +246,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             scatter_mean=bool(args.scatter_mean),
             slab_scatter=bool(args.slab_scatter),
             resident=args.resident,
+            clip_row_update=args.clip_row_update,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -323,11 +330,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             auto_tokens = global_agree_sum(auto_tokens)
         auto_rows, auto_micro = Word2VecConfig.auto_geometry(
-            auto_tokens, cfg.max_sentence_len, dp=args.dp
+            auto_tokens, cfg.max_sentence_len, dp=args.dp,
+            vocab_size=len(vocab),
         )
-        if args.micro_steps:  # explicit micro with auto rows: keep divisible
+        if args.micro_steps:
+            # explicit micro with auto rows: keep the auto-sized OPTIMIZER
+            # block (the convergence/hot-row unit) and scale the dispatch to
+            # block * micro — carrying auto_rows over would silently multiply
+            # the per-block token count past the hot-row cap
+            block = max(1, auto_rows // auto_micro)
             auto_micro = args.micro_steps
-            auto_rows = max(1, auto_rows // auto_micro) * auto_micro
+            auto_rows = block * auto_micro
         cfg = _dc.replace(cfg, batch_rows=auto_rows, micro_steps=auto_micro)
         if not args.quiet:
             steps = max(

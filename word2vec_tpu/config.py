@@ -114,6 +114,18 @@ class Word2VecConfig:
     # device step time; see bench.py).
     chunk_steps: int = 1
 
+    # Per-row trust region for batched duplicate-summed updates
+    # (ops/train_step._row_clip_scale): cap the L2 norm of any single row's
+    # summed update per optimizer step at this value; 0 disables. Without
+    # it, text8-scale optimizer blocks (~40k tokens) accumulate thousands
+    # of aligned per-pair gradients into frequent words' rows in ONE
+    # scatter and training diverges to NaN (the reference's sequential
+    # updates self-correct; a sum at stale weights cannot —
+    # benchmarks/quality_full.py). Healthy rows sit orders of magnitude
+    # below the default cap, so small-geometry trajectories (golden tests,
+    # parity) are bitwise unaffected.
+    clip_row_update: float = 1.0
+
     # Device-resident corpus (ops/resident.py): keep the packed corpus in
     # HBM and assemble every [B, L] batch on device inside the scanned chunk
     # — a dispatch then carries only scalars plus one [R] row-order upload
@@ -189,6 +201,8 @@ class Word2VecConfig:
             raise ValueError("micro_steps must be >= 1")
         if self.chunk_steps < 0:
             raise ValueError("chunk_steps must be >= 0 (0 = auto)")
+        if self.clip_row_update < 0:
+            raise ValueError("clip_row_update must be >= 0 (0 = off)")
         if self.fused_tables:
             if self.slab_scatter:
                 raise ValueError(
@@ -222,6 +236,13 @@ class Word2VecConfig:
             return self.kernel
         return "band"
 
+    # Batched-sum stability cap: tokens per optimizer block should not
+    # exceed ~this many times the vocabulary size, or frequent rows get
+    # duplicate-summed updates large enough to overshoot (measured on the
+    # topic corpus: ~4x converges, ~15x diverges to NaN —
+    # benchmarks/quality_full.py).
+    MAX_BLOCK_TOKENS_PER_VOCAB = 4
+
     @staticmethod
     def auto_geometry(
         corpus_tokens: int,
@@ -229,6 +250,7 @@ class Word2VecConfig:
         dp: int = 1,
         cap: int = 256,
         max_micro: int = 64,
+        vocab_size: int = 0,
     ) -> Tuple[int, int]:
         """(batch_rows, micro_steps) giving ~100 OPTIMIZER steps per epoch
         with the largest device-efficient dispatch.
@@ -242,8 +264,23 @@ class Word2VecConfig:
         steps/epoch and up to max_micro of them are packed per dispatch
         (bounded by cap rows). `dp` is the data-parallel width: replicas
         consume dp dispatches per global step.
+
+        vocab_size (when known) additionally caps the optimizer block so
+        one block carries at most MAX_BLOCK_TOKENS_PER_VOCAB tokens per
+        vocabulary word — on small-vocab corpora an unconstrained block
+        duplicate-sums hot rows enough to diverge (NaN), something the
+        reference's sequential updates never see. The micro-step packing
+        keeps the dispatch large either way.
         """
         block = max(1, min(cap, corpus_tokens // (100 * max_sentence_len * dp)))
+        if vocab_size:
+            hot_cap = max(
+                1,
+                Word2VecConfig.MAX_BLOCK_TOKENS_PER_VOCAB
+                * vocab_size
+                // max_sentence_len,
+            )
+            block = min(block, hot_cap)
         micro = max(1, min(max_micro, cap // block))
         return block * micro, micro
 

@@ -77,7 +77,7 @@ from ..config import Word2VecConfig
 from ..models.params import Params
 from . import banded
 from .tables import DeviceTables
-from .train_step import _draw_negatives, _dup_mean_scale
+from .train_step import _draw_negatives, _dup_mean_scale, _row_clip_scale
 
 Metrics = Dict[str, jnp.ndarray]
 
@@ -163,6 +163,7 @@ def make_band_train_step(
     is_cbow = config.model == "cbow"
     cbow_mean = config.cbow_mean
     scatter_mean = config.scatter_mean
+    clip_tau = config.clip_row_update
     slab_scatter = config.slab_scatter
     cdt = jnp.dtype(config.compute_dtype)
 
@@ -381,6 +382,23 @@ def make_band_train_step(
             inv = 1.0 / jnp.maximum(cnt, 1.0)
             d_out_flat = d_out_flat * inv[out_idx][:, None]
             d_neg_flat = d_neg_flat * inv[flat_negs][:, None]
+
+        if clip_tau > 0.0:
+            # per-row trust region (train_step._row_clip_scale): the out
+            # table's positive-context and negative-draw contributions share
+            # rows, so they share one budget
+            in_scale = _row_clip_scale(
+                emb_in.shape[0], clip_tau, (in_idx, d_in_flat),
+                tp_axis=tp_axis,
+            )
+            out_scale = _row_clip_scale(
+                emb_out.shape[0], clip_tau,
+                (out_idx, d_out_flat), (flat_negs, d_neg_flat),
+                tp_axis=tp_axis,
+            )
+            d_in_flat = d_in_flat * in_scale[in_idx][:, None]
+            d_out_flat = d_out_flat * out_scale[out_idx][:, None]
+            d_neg_flat = d_neg_flat * out_scale[flat_negs][:, None]
 
         new_params = dict(params)
         if fused:

@@ -45,7 +45,7 @@ from ..config import Word2VecConfig
 from ..models.params import Params
 from . import banded
 from .tables import DeviceTables
-from .train_step import _dup_mean_scale
+from .train_step import _dup_mean_scale, _row_clip_scale
 
 Metrics = Dict[str, jnp.ndarray]
 
@@ -66,6 +66,10 @@ def make_hs_train_step(
     is_cbow = config.model == "cbow"
     cbow_mean = config.cbow_mean
     scatter_mean = config.scatter_mean
+    # per-row trust region (train_step._row_clip_scale). hs needs it even
+    # more than ns: the Huffman ROOT node sits on EVERY word's path, so its
+    # syn1 row accumulates the entire batch's path gradients in one scatter
+    clip_tau = config.clip_row_update
     cdt = jnp.dtype(config.compute_dtype)
 
     def psum(x):
@@ -153,6 +157,11 @@ def make_hs_train_step(
                     emb_in.shape[0], flat_c,
                     ctx_hit.reshape(-1).astype(jnp.float32),
                 )[:, None]
+            if clip_tau > 0.0:
+                vals = vals * _row_clip_scale(
+                    emb_in.shape[0], clip_tau, (flat_c, vals),
+                    tp_axis=tp_axis,
+                )[flat_c][:, None]
             new_in = emb_in.at[flat_c].add(vals.astype(emb_in.dtype))
 
             # path rows: one aggregated scatter over the padded positions
@@ -163,6 +172,11 @@ def make_hs_train_step(
                 d_rows_flat = d_rows_flat * _dup_mean_scale(
                     syn1.shape[0], flat_p[order], out_touch.reshape(-1)[order]
                 )[:, None]
+            if clip_tau > 0.0:
+                d_rows_flat = d_rows_flat * _row_clip_scale(
+                    syn1.shape[0], clip_tau, (flat_p[order], d_rows_flat),
+                    tp_axis=tp_axis,
+                )[flat_p[order]][:, None]
             new_out = syn1.at[flat_p[order]].add(
                 d_rows_flat.astype(syn1.dtype), indices_are_sorted=True
             )
@@ -235,6 +249,11 @@ def make_hs_train_step(
                     vals = vals * _dup_mean_scale(
                         emb_in.shape[0], sflat, w
                     )[:, None]
+                if clip_tau > 0.0:
+                    vals = vals * _row_clip_scale(
+                        emb_in.shape[0], clip_tau, (sflat, vals),
+                        tp_axis=tp_axis,
+                    )[sflat][:, None]
                 new_in = emb_in.at[sflat].add(vals.astype(emb_in.dtype))
             else:
                 d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
@@ -246,6 +265,11 @@ def make_hs_train_step(
                         emb_in.shape[0], flat_c[order],
                         banded.band_col_sum(band_f, L, W, S).reshape(-1)[order],
                     )[:, None]
+                if clip_tau > 0.0:
+                    d_in_flat = d_in_flat * _row_clip_scale(
+                        emb_in.shape[0], clip_tau, (flat_c[order], d_in_flat),
+                        tp_axis=tp_axis,
+                    )[flat_c[order]][:, None]
                 new_in = emb_in.at[flat_c[order]].add(
                     d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
                 )
@@ -257,6 +281,11 @@ def make_hs_train_step(
                 d_rows_flat = d_rows_flat * _dup_mean_scale(
                     syn1.shape[0], flat_p[porder], m.reshape(-1)[porder]
                 )[:, None]
+            if clip_tau > 0.0:
+                d_rows_flat = d_rows_flat * _row_clip_scale(
+                    syn1.shape[0], clip_tau, (flat_p[porder], d_rows_flat),
+                    tp_axis=tp_axis,
+                )[flat_p[porder]][:, None]
             new_out = syn1.at[flat_p[porder]].add(
                 d_rows_flat.astype(syn1.dtype), indices_are_sorted=True
             )

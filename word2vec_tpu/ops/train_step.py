@@ -50,6 +50,46 @@ def _draw_negatives(
     return jnp.where(u < accept[j], j, alias[j])
 
 
+def _row_clip_scale(
+    num_rows: int,
+    tau: float,
+    *contribs: Tuple[jnp.ndarray, jnp.ndarray],
+    tp_axis: str | None = None,
+) -> jnp.ndarray:
+    """Per-row trust-region scale for batched duplicate-summed updates.
+
+    contribs are (flat_idx, flat_vals[N, d]) pairs that all land in the same
+    [num_rows, d] table this step. Returns scale[num_rows] in (0, 1]:
+        scale_r = tau / max(S_r, tau),  S_r = sum_j ||vals_j||  over the
+    row's contributions — the triangle-inequality bound on ||sum_j vals_j||,
+    tight exactly in the dangerous case (aligned contributions on a hot row).
+
+    Why: one batched scatter sums O(batch_tokens * word_freq) per-pair
+    gradients into a frequent word's row with NO sequential feedback — the
+    reference's one-at-a-time updates self-correct (sigmoid saturates, g->0,
+    Word2Vec.cpp:239-268), a sum at stale weights cannot. At text8-scale
+    geometry (~40k-token optimizer blocks) the hottest rows accumulate
+    thousands of aligned updates and training diverges to NaN (measured:
+    benchmarks/quality_full.py). Capping each row's summed step to L2 <= tau
+    restores stability while leaving every row below the cap bitwise
+    untouched — healthy updates are orders of magnitude under tau.
+
+    Tensor parallelism: vals hold the local d/TP slice, so per-contribution
+    squared norms are psum'd over tp_axis BEFORE the sqrt — every dim shard
+    then applies the same scale computed from the row's GLOBAL norm (a [N]
+    psum, same order as the logit psum the kernels already pay).
+    """
+    s = jnp.zeros((num_rows,), jnp.float32)
+    for idx, vals in contribs:
+        sq = jnp.sum(
+            vals.astype(jnp.float32) * vals.astype(jnp.float32), axis=-1
+        )
+        if tp_axis is not None:
+            sq = jax.lax.psum(sq, tp_axis)
+        s = s.at[idx].add(jnp.sqrt(sq))
+    return tau / jnp.maximum(s, tau)
+
+
 def _dup_mean_scale(
     num_rows: int, flat_idx: jnp.ndarray, flat_weight: jnp.ndarray
 ) -> jnp.ndarray:
@@ -73,6 +113,7 @@ def _score_and_update(
     compute_dtype: jnp.dtype,
     scatter_mean: bool,
     tp_axis: str | None = None,
+    clip_tau: float = 0.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One sigmoid-SGD objective: returns (grad_h, new_out, loss_sum, pair_count).
 
@@ -110,6 +151,10 @@ def _score_and_update(
     vals = grad_t.reshape(-1, d)
     if scatter_mean:
         vals = vals * _dup_mean_scale(out.shape[0], flat_t, tmask.reshape(-1))[:, None]
+    if clip_tau > 0.0:
+        vals = vals * _row_clip_scale(
+            out.shape[0], clip_tau, (flat_t, vals), tp_axis=tp_axis
+        )[flat_t][:, None]
     new_out = out.at[flat_t].add(vals.astype(out.dtype))
     # masked binary cross-entropy, for metrics only:
     # -[y log s(x) + (1-y) log s(-x)], with log s(-x) = log s(x) - x
@@ -235,6 +280,7 @@ def make_pair_train_step(
     is_cbow = config.model == "cbow"
     cbow_mean = config.cbow_mean
     scatter_mean = config.scatter_mean
+    clip_tau = config.clip_row_update
     cdt = jnp.dtype(config.compute_dtype)
     # Static offset vector o in {-W..-1, 1..W} — the unrolled j-loop of
     # Word2Vec.cpp:339 (j != i excluded by construction).
@@ -302,7 +348,7 @@ def make_pair_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc = _score_and_update(
                     h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean, tp_axis,
+                    scatter_mean, tp_axis, clip_tau,
                 )
                 grad_h += gh
                 new_params["emb_out_ns"] = new_out
@@ -319,7 +365,7 @@ def make_pair_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc = _score_and_update(
                     h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean, tp_axis,
+                    scatter_mean, tp_axis, clip_tau,
                 )
                 grad_h += gh
                 new_params["emb_out_hs"] = new_out
@@ -343,6 +389,11 @@ def make_pair_train_step(
                     flat_c,
                     pair_mask.any(axis=2).reshape(-1).astype(jnp.float32),
                 )[:, None]
+            if clip_tau > 0.0:
+                vals = vals * _row_clip_scale(
+                    params["emb_in"].shape[0], clip_tau, (flat_c, vals),
+                    tp_axis=tp_axis,
+                )[flat_c][:, None]
             new_params["emb_in"] = params["emb_in"].at[flat_c].add(
                 vals.astype(params["emb_in"].dtype)
             )
@@ -379,7 +430,7 @@ def make_pair_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc = _score_and_update(
                     h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean, tp_axis,
+                    scatter_mean, tp_axis, clip_tau,
                 )
                 grad_h += gh
                 new_params["emb_out_ns"] = new_out
@@ -396,7 +447,7 @@ def make_pair_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc = _score_and_update(
                     h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean, tp_axis,
+                    scatter_mean, tp_axis, clip_tau,
                 )
                 grad_h += gh
                 new_params["emb_out_hs"] = new_out
@@ -416,6 +467,11 @@ def make_pair_train_step(
                     flat_ctx,
                     pair_mask.reshape(-1).astype(jnp.float32),
                 )[:, None]
+            if clip_tau > 0.0:
+                g_ctx = g_ctx * _row_clip_scale(
+                    params["emb_in"].shape[0], clip_tau, (flat_ctx, g_ctx),
+                    tp_axis=tp_axis,
+                )[flat_ctx][:, None]
             new_params["emb_in"] = params["emb_in"].at[flat_ctx].add(
                 g_ctx.astype(params["emb_in"].dtype)
             )

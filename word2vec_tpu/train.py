@@ -95,11 +95,26 @@ class Trainer:
 
         cfg = self.config
         tokens_per_step = cfg.batch_rows * cfg.max_sentence_len
+        block_tokens = tokens_per_step // cfg.micro_steps
+        if len(self.vocab) and block_tokens > 8 * len(self.vocab):
+            warnings.warn(
+                f"optimizer block carries ~{block_tokens // len(self.vocab)}x "
+                f"tokens per vocabulary word ({block_tokens} tokens, "
+                f"{len(self.vocab)} words) — duplicate-row summed updates at "
+                "this ratio overshoot and can diverge (measured NaN at ~15x; "
+                "config.MAX_BLOCK_TOKENS_PER_VOCAB). Raise micro_steps or "
+                "shrink batch_rows; Word2VecConfig.auto_geometry(..., "
+                "vocab_size=len(vocab)) sizes this automatically.",
+                stacklevel=3,
+            )
         steps_per_epoch = max(
             1, self.total_words * cfg.micro_steps // max(1, tokens_per_step)
         )
         if self.total_words and steps_per_epoch < 70:
-            rows, micro = cfg.auto_geometry(self.total_words, cfg.max_sentence_len)
+            rows, micro = cfg.auto_geometry(
+                self.total_words, cfg.max_sentence_len,
+                vocab_size=len(self.vocab),
+            )
             warnings.warn(
                 f"batch geometry ({cfg.batch_rows} rows x "
                 f"{cfg.max_sentence_len} x {cfg.micro_steps} micro-steps) "

@@ -72,6 +72,14 @@ def probe_backend(timeout_s: float) -> tuple[bool, str]:
     return True, out.stdout.strip()
 
 
+def config_key(model: str, method: str, dim: int, window: int, k: int) -> str:
+    """The shape key shared by the baseline writer
+    (benchmarks/reference_harness/measure_baseline.py --multi) and every
+    vs_baseline lookup/metric label here — one definition so a key-format
+    change cannot silently break the match."""
+    return f"{model}+{method}-dim{dim}-w{window}-k{k}"
+
+
 def model_flops_per_target(dim: int) -> float:
     """Algorithmic FLOPs for one sigmoid target: a d-dot logit + d-axpy
     hidden-grad + d-axpy row update (Word2Vec.cpp:262-268) ~= 3 * 2d FLOPs.
@@ -201,18 +209,30 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "benchmarks",
         "reference_baseline.json",
     )
-    # the recorded reference baseline is the FLAGSHIP config (sg+ns dim=300
-    # w=5 k=5); the ratio is only meaningful on that shape
+    # vs_baseline compares against the measured reference on the SAME config:
+    # the flagship single-record file, or the multi-config table keyed by
+    # shape (benchmarks/reference_harness/measure_baseline.py --multi)
     flagship = (
         args.model == "sg" and args.train_method == "ns"
         and args.dim == 300 and args.window == 5 and args.negative == 5
     )
+    key = config_key(
+        args.model, args.train_method, args.dim, args.window, cfg.negative
+    )
     vs = None
+    ref_wps = None
     if flagship and os.path.exists(baseline_path):
         with open(baseline_path) as f:
-            ref = json.load(f)
-        if ref.get("words_per_sec"):
-            vs = wps / float(ref["words_per_sec"])
+            ref_wps = json.load(f).get("words_per_sec")
+    if ref_wps is None:
+        multi_path = os.path.join(
+            os.path.dirname(baseline_path), "reference_baselines.json"
+        )
+        if os.path.exists(multi_path):
+            with open(multi_path) as f:
+                ref_wps = json.load(f).get(key, {}).get("words_per_sec")
+    if ref_wps:
+        vs = wps / float(ref_wps)
 
     dev = jax.devices()[0]
     model_fps = pairs * model_flops_per_target(args.dim) / dt
@@ -221,9 +241,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         None,
     )
     record = {
-        "metric": f"{args.model}+{args.train_method}-dim{args.dim}"
-        f"-w{args.window}-k{cfg.negative} "
-        f"words/sec ({corpus_name}, {dev.platform})",
+        "metric": f"{key} words/sec ({corpus_name}, {dev.platform})",
         "value": round(wps, 1),
         "unit": "words/sec",
         "vs_baseline": round(vs, 2) if vs is not None else None,
@@ -296,9 +314,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 def error_record(args: argparse.Namespace, err: str, note: str | None) -> dict:
     return {
-        "metric": f"{args.model}+{args.train_method}-dim{args.dim}"
-        f"-w{args.window}"
-        f"-k{args.negative if args.train_method == 'ns' else 0} words/sec",
+        "metric": config_key(
+            args.model, args.train_method, args.dim, args.window,
+            args.negative if args.train_method == "ns" else 0,
+        ) + " words/sec",
         "value": None,
         "unit": "words/sec",
         "vs_baseline": None,

@@ -60,13 +60,18 @@ def write_corpus(tmp: str, num_tokens: int) -> int:
     return num_tokens
 
 
-def run_ref(exe: str, tmp: str, iters: int, threads: int, dim: int) -> float:
+def run_ref(
+    exe: str, tmp: str, iters: int, threads: int, dim: int,
+    model: str = "sg", method: str = "ns", negative: int = 5, window: int = 5,
+) -> float:
     t0 = time.perf_counter()
     subprocess.run(
         [
-            exe, "-train", "text8", "-output", "", "-model", "sg",
-            "-train_method", "ns", "-negative", "5", "-size", str(dim),
-            "-window", "5", "-subsample", "1e-4", "-iter", str(iters),
+            exe, "-train", "text8", "-output", "", "-model", model,
+            "-train_method", method,
+            "-negative", str(negative if method == "ns" else 0),
+            "-size", str(dim),
+            "-window", str(window), "-subsample", "1e-4", "-iter", str(iters),
             "-threads", str(threads), "-min-count", "5",
         ],
         cwd=tmp, check=True, capture_output=True,
@@ -81,30 +86,52 @@ def main() -> None:
     ap.add_argument("--tokens", type=int, default=2_000_000)
     ap.add_argument("--dim", type=int, default=300)
     ap.add_argument("--threads", type=int, default=os.cpu_count() or 1)
+    ap.add_argument("--model", choices=["sg", "cbow"], default="sg")
+    ap.add_argument("--train-method", choices=["ns", "hs"], default="ns")
+    ap.add_argument("--negative", type=int, default=5)
+    ap.add_argument("--window", type=int, default=5)
+    ap.add_argument("--multi", action="store_true",
+                    help="record into benchmarks/reference_baselines.json "
+                    "keyed by config (the flagship single-file record is "
+                    "left untouched)")
     args = ap.parse_args()
 
+    k = args.negative if args.train_method == "ns" else 0
     with tempfile.TemporaryDirectory() as tmp:
         exe = build(tmp)
         tokens = write_corpus(tmp, args.tokens)
-        t1 = run_ref(exe, tmp, 1, args.threads, args.dim)
-        t3 = run_ref(exe, tmp, 3, args.threads, args.dim)
+        t1 = run_ref(exe, tmp, 1, args.threads, args.dim,
+                     args.model, args.train_method, args.negative, args.window)
+        t3 = run_ref(exe, tmp, 3, args.threads, args.dim,
+                     args.model, args.train_method, args.negative, args.window)
         train_time_2_iters = t3 - t1
         wps = 2 * tokens / train_time_2_iters
 
+    key = f"{args.model}+{args.train_method}-dim{args.dim}-w{args.window}-k{k}"
     out = {
         "words_per_sec": round(wps, 1),
-        "config": f"sg+ns k=5 dim={args.dim} w=5, subsample 1e-4, "
-        f"threads={args.threads}",
+        "config": f"{args.model}+{args.train_method} k={k} dim={args.dim} "
+        f"w={args.window}, subsample 1e-4, threads={args.threads}",
         "corpus": f"zipf-synthetic-{args.tokens} tokens (V=71k text8-like)",
         "method": "(t_iter3 - t_iter1) / 2 epochs; eigen-lite shim; "
         "-Ofast -march=native -funroll-loops -fopenmp",
         "host_cpus": os.cpu_count(),
         "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
     }
-    path = os.path.join(REPO, "benchmarks", "reference_baseline.json")
-    with open(path, "w") as f:
-        json.dump(out, f, indent=2)
-    print(json.dumps(out))
+    if args.multi:
+        path = os.path.join(REPO, "benchmarks", "reference_baselines.json")
+        table = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                table = json.load(f)
+        table[key] = out
+        with open(path, "w") as f:
+            json.dump(table, f, indent=2)
+    else:
+        path = os.path.join(REPO, "benchmarks", "reference_baseline.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+    print(json.dumps({key: out}))
 
 
 if __name__ == "__main__":

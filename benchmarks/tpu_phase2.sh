@@ -23,6 +23,10 @@ echo "=== quality_full flagship (dim=300, band+resident+chunked)" >> $OUT/phase2
 timeout 1800 python benchmarks/quality_full.py --tokens 4000000 2>/dev/null | tail -1 >> $OUT/phase2.txt
 timeout 1800 python benchmarks/quality_full.py --tokens 4000000 --train-method hs 2>/dev/null | tail -1 >> $OUT/phase2.txt
 
+echo "=== bench BASELINE configs 2/3 (cbow+ns dim=100, sg+hs dim=200)" >> $OUT/phase2.txt
+timeout 900 python bench.py --model cbow --dim 100 --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
+timeout 900 python bench.py --train-method hs --dim 200 --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
+
 echo "=== bench enwik9-shape (100M tokens, w=10)" >> $OUT/phase2.txt
 timeout 1800 python bench.py --tokens 100000000 --window 10 --probe-retries 1 2>/dev/null | tail -1 >> $OUT/phase2.txt
 

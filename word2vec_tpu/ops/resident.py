@@ -23,7 +23,7 @@ Word2Vec.cpp:377-390 — joins everything below it on device.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Collect benchmarks/TPU_R2/ sweep + phase2 results into one markdown table
+(stdout) for PERF.md — run after tpu_watch2.sh / tpu_phase2.sh complete."""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "TPU_R2")
+
+
+def rows_from(path):
+    if not os.path.exists(path):
+        return
+    label = None
+    for line in open(path):
+        line = line.strip()
+        if line.startswith("==="):
+            label = line.lstrip("= ").strip()
+        elif line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            yield label or "?", rec
+
+
+def main() -> None:
+    print("| run | value | vs_baseline | platform | notes |")
+    print("|---|---|---|---|---|")
+    for fname in ("sweep2.txt", "phase2.txt"):
+        for label, rec in rows_from(os.path.join(OUT, fname)):
+            if "value" in rec:
+                val = rec.get("value")
+                val = f"{val:,.0f} w/s" if isinstance(val, (int, float)) else "-"
+                notes = rec.get("tpu_fallback_reason") or rec.get("error") or ""
+                print(
+                    f"| {label} | {val} | {rec.get('vs_baseline')} "
+                    f"| {rec.get('platform', '?')} | {notes} |"
+                )
+            elif "spearman" in rec:
+                print(
+                    f"| {label} | spearman {rec['spearman']} "
+                    f"purity {rec.get('neighbor_purity@10')} | - | - | "
+                    f"{rec.get('config', '')[:60]} |"
+                )
+    rep = os.path.join(OUT, "trace_report.txt")
+    if os.path.exists(rep):
+        print("\ntrace report header:")
+        for line in open(rep).read().splitlines()[:12]:
+            print("    " + line)
+
+
+if __name__ == "__main__":
+    main()

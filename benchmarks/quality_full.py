@@ -46,6 +46,8 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--backend", default=None,
                     help="forwarded to the CLI (default: device auto)")
+    ap.add_argument("--shared-negatives", type=int, default=0,
+                    help="band-kernel KP override (0 = config default)")
     ap.add_argument("--run-timeout", type=float, default=1800.0,
                     help="watchdog for the training child (a tunnel hang "
                     "post-probe would otherwise wedge with no output, the "
@@ -81,6 +83,8 @@ def main() -> None:
         ]
         if args.backend:
             cmd += ["--backend", args.backend]
+        if args.shared_negatives:
+            cmd += ["--shared-negatives", str(args.shared_negatives)]
         env = {
             **os.environ,
             "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),

@@ -97,6 +97,65 @@ def topic_corpus(
     return tokens, topic_of
 
 
+def analogy_corpus(
+    n_pairs: int = 16,
+    words_per_topic: int = 20,
+    marker_words: int = 20,
+    n_tokens: int = 300_000,
+    span_len: int = 20,
+    p_pairword: float = 0.3,
+    p_marker: float = 0.25,
+    seed: int = 0,
+) -> Tuple[List[str], List[Tuple[str, str, str, str]]]:
+    """A token stream with planted RELATION structure for analogy parity.
+
+    Word pairs (base_i, marked_i), one per topic i: both draw their contexts
+    from topic i's pool, but marked_i's spans additionally mix in words from
+    one SHARED marker pool. Distributionally, marked_i - base_i then points
+    along the same marker direction for every i — the mechanism 3CosAdd
+    (b - a + c -> d) exploits in real corpora (king-queen etc.), so a
+    correct word2vec recovers the planted analogies and two implementations
+    can be compared on the SAME questions (the Google-analogy half of the
+    BASELINE parity gate, eval/analogy.py protocol).
+
+    Returns (tokens, questions) with questions = all ordered pairs
+    (base_i, marked_i, base_j, marked_j), i != j.
+    """
+    rng = np.random.default_rng(seed)
+    topics = [
+        [f"r{i}c{k}" for k in range(words_per_topic)] for i in range(n_pairs)
+    ]
+    markers = [f"mk{k}" for k in range(marker_words)]
+    zipf_t = 1.0 / np.arange(1, words_per_topic + 1)
+    zipf_t /= zipf_t.sum()
+    zipf_m = 1.0 / np.arange(1, marker_words + 1)
+    zipf_m /= zipf_m.sum()
+
+    tokens: List[str] = []
+    n_spans = n_tokens // span_len
+    for s in range(n_spans):
+        i = int(rng.integers(n_pairs))
+        marked = bool(rng.integers(2))
+        pairword = f"b{i}m" if marked else f"b{i}"
+        r = rng.random(span_len)
+        ctx_t = rng.choice(words_per_topic, size=span_len, p=zipf_t)
+        ctx_m = rng.choice(marker_words, size=span_len, p=zipf_m)
+        for k in range(span_len):
+            if r[k] < p_pairword:
+                tokens.append(pairword)
+            elif marked and r[k] < p_pairword + p_marker:
+                tokens.append(markers[ctx_m[k]])
+            else:
+                tokens.append(topics[i][ctx_t[k]])
+    questions = [
+        (f"b{i}", f"b{i}m", f"b{j}", f"b{j}m")
+        for i in range(n_pairs)
+        for j in range(n_pairs)
+        if i != j
+    ]
+    return tokens, questions
+
+
 def topic_similarity_pairs(
     topic_of: Dict[str, int],
     n_pairs: int = 400,

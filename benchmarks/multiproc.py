@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""True multi-process training on one host (SURVEY §5 distributed backend).
+
+The multi-host wiring (parallel/multihost.py: jax.distributed.initialize,
+hybrid DCN x ICI mesh, cross-process agreement, per-process corpus shards
+assembled into global arrays) had only ever been unit-tested in factored
+form. This harness EXECUTES it: it spawns N real processes on this host,
+each with its own corpus shard and its own set of virtual CPU devices,
+coordinated through jax.distributed over localhost — exercising
+initialize_from_env, make_global_mesh (create_hybrid_device_mesh),
+global_agree_sum (batch auto-sizing), global_agree_min (steps/epoch
+agreement), make_array_from_process_local_data (global batch assembly),
+and assemble_local_replica (process-0-only save) end to end.
+
+Then it trains the IDENTICAL config single-process on the same global
+device count and corpus, and compares eval scores (planted-topic Spearman /
+neighbor purity / cosine margin) between the two runs. The trajectories
+are not bitwise comparable — the multi-process row order interleaves shards
+by process rank — so the gate is statistical, like benchmarks/parity.py.
+
+One JSON line to stdout:
+    python benchmarks/multiproc.py [--procs 2] [--devices-per-proc 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+from parity import eval_vectors  # noqa: E402
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def cli_cmd(train: str, vocab: str, out: str, dp: int, extra=()) -> list:
+    return [
+        sys.executable, "-m", "word2vec_tpu.cli",
+        "-train", train, "-read-vocab", vocab, "-output", out,
+        "-model", "sg", "-train_method", "ns", "-negative", "5",
+        "-size", "64", "-window", "5", "-iter", "3",
+        "-min-count", "5", "-subsample", "1e-4",
+        "--backend", "cpu", "--dp", str(dp), "--quiet",
+        *extra,
+    ]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--procs", type=int, default=2)
+    ap.add_argument("--devices-per-proc", type=int, default=4)
+    ap.add_argument("--tokens", type=int, default=200_000)
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--sync-mode", choices=["mean", "delta"], default="mean")
+    args = ap.parse_args()
+
+    from word2vec_tpu.utils.synthetic import topic_corpus, topic_similarity_pairs
+
+    tokens, topic_of = topic_corpus(n_tokens=args.tokens, seed=0)
+    pairs = topic_similarity_pairs(topic_of, seed=1)
+    dp = args.procs * args.devices_per_proc  # pure-dp global mesh
+
+    result = {
+        "config": f"sg+ns dim=64 dp={dp} over {args.procs} processes x "
+        f"{args.devices_per_proc} virtual cpu devices, sync={args.sync_mode}",
+        "corpus": f"topic-synthetic-{args.tokens} tokens, "
+        f"{args.procs} round-robin shards",
+    }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # full corpus + per-process shards (round-robin over the reference's
+        # 1000-token chunking unit so shard sizes stay balanced)
+        chunks = [tokens[i:i + 1000] for i in range(0, len(tokens), 1000)]
+        with open(os.path.join(tmp, "full"), "w") as f:
+            f.write(" ".join(tokens))
+        for r in range(args.procs):
+            with open(os.path.join(tmp, f"shard{r}"), "w") as f:
+                f.write(" ".join(
+                    w for c in chunks[r::args.procs] for w in c
+                ))
+
+        # one shared vocabulary: every process must agree on the word->row
+        # mapping, exactly as a real multi-host run ships one vocab file
+        from word2vec_tpu.data.vocab import Vocab
+
+        Vocab.build([c for c in chunks], min_count=5).save(
+            os.path.join(tmp, "vocab.txt")
+        )
+
+        env_base = {
+            **os.environ,
+            "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            "XLA_FLAGS": (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={args.devices_per_proc}"
+            ).strip(),
+        }
+
+        # --- multi-process run -------------------------------------------
+        port = free_port()
+        t0 = time.perf_counter()
+        procs = []
+        logs = []
+        for r in range(args.procs):
+            env = {
+                **env_base,
+                "W2V_COORDINATOR": f"127.0.0.1:{port}",
+                "W2V_NUM_PROCS": str(args.procs),
+                "W2V_PROC_ID": str(r),
+            }
+            # child output goes to FILES, not pipes: an undrained pipe fills
+            # at ~64 KiB and deadlocks the child against our wait()
+            log = open(os.path.join(tmp, f"rank{r}.log"), "w+")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                cli_cmd(f"shard{r}", "vocab.txt", "vec_mp.txt", dp,
+                        ("--multihost", "--sync-mode", args.sync_mode)),
+                cwd=tmp, env=env,
+                stdout=log, stderr=subprocess.STDOUT, text=True,
+            ))
+        deadline = time.time() + args.timeout
+        rcs = []
+        for p in procs:
+            try:
+                p.wait(timeout=max(1.0, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                print(json.dumps({**result, "error": "multiproc hang "
+                                  f"(> {args.timeout:.0f}s)"}))
+                return
+            rcs.append(p.returncode)
+        result["multiproc_wall_s"] = round(time.perf_counter() - t0, 1)
+        if any(rcs):
+            tails = []
+            for log in logs:
+                log.seek(0)
+                tails.append(log.read().strip().splitlines()[-8:])
+            print(json.dumps({**result, "error": f"multiproc rcs={rcs}",
+                              "log_tails": tails}))
+            return
+        result["multiproc"] = eval_vectors(
+            os.path.join(tmp, "vec_mp.txt"), pairs, topic_of
+        )
+
+        # --- identical single-process run --------------------------------
+        env = {
+            **env_base,
+            "XLA_FLAGS": (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={dp}"
+            ).strip(),
+        }
+        sp = subprocess.run(
+            cli_cmd("full", "vocab.txt", "vec_sp.txt", dp),
+            cwd=tmp, env=env, capture_output=True, text=True,
+            timeout=args.timeout,
+        )
+        if sp.returncode != 0:
+            print(json.dumps({**result, "error": "singleproc rc="
+                              f"{sp.returncode}",
+                              "stderr_tail": sp.stderr.splitlines()[-8:]}))
+            return
+        result["singleproc"] = eval_vectors(
+            os.path.join(tmp, "vec_sp.txt"), pairs, topic_of
+        )
+
+    for k in ("spearman", "neighbor_purity@10", "cos_margin"):
+        result[f"delta_{k}"] = round(
+            result["multiproc"][k] - result["singleproc"][k], 4
+        )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

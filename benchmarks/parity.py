@@ -80,9 +80,20 @@ def eval_vectors(path: str, pairs, topic_of) -> dict:
             ii.append(idx[a])
             jj.append(idx[b])
             gold.append(s)
+    if not ii:
+        return {"error": "every eval pair OOV at this budget"}
     cos = cosine_rows(W, np.asarray(ii), np.asarray(jj))
     gold_arr = np.asarray(gold, np.float64)
-    hi = gold_arr >= np.median(gold_arr)
+    # split at the midpoint of the gold range, NOT the median: with the
+    # two-level golds an OOV-dropped high pair shifts the median onto the
+    # low level and `>= median` would select every pair (empty cross side,
+    # NaN margin — observed at reduced budgets). If OOV drops an entire
+    # level the margin is undefined; report null rather than NaN.
+    hi = gold_arr > (gold_arr.min() + gold_arr.max()) / 2.0
+    margin = (
+        round(float(cos[hi].mean() - cos[~hi].mean()), 4)
+        if hi.any() and (~hi).any() else None
+    )
     return {
         "spearman": round(spearman(cos, gold_arr), 4),
         # Spearman saturates at its tie-ceiling (~0.866 for the two-level
@@ -90,10 +101,40 @@ def eval_vectors(path: str, pairs, topic_of) -> dict:
         # CONTINUOUS sensitivity metric — mean cosine separation between
         # same-topic and cross-topic pairs — so small quality regressions
         # remain visible after both sides hit the ceiling.
-        "cos_margin": round(float(cos[hi].mean() - cos[~hi].mean()), 4),
+        "cos_margin": margin,
         "pairs_used": len(ii),
         "pairs_total": len(pairs),
         "neighbor_purity@10": round(neighbor_purity(words, W, topic_of), 4),
+    }
+
+
+def eval_analogy_vectors(path: str, questions) -> dict:
+    """Score saved text vectors on planted-relation analogy questions with
+    the SAME 3CosAdd path the CLI's --eval-analogy uses (eval/analogy.py).
+    Completes the Google-analogy half of the BASELINE.json accuracy gate:
+    the reference ships no eval at all (README.md:1-14), so parity is both
+    sides scored on identical generated questions."""
+    from word2vec_tpu.data.vocab import Vocab
+    from word2vec_tpu.eval.analogy import evaluate_analogy_sections
+    from word2vec_tpu.io.embeddings import load_embeddings_text
+
+    words, W = load_embeddings_text(path)
+    if W.size == 0:
+        return {"error": "empty embedding matrix (reference cbow+hs latent bug)"}
+    # saved word2vec files are count-sorted, so index order is frequency
+    # order and restrict_vocab keeps its most-frequent-N meaning
+    vocab = Vocab(list(words), np.ones(len(words), dtype=np.int64))
+    r = evaluate_analogy_sections(
+        W, vocab, [("planted-relations", list(questions))]
+    )
+    return {
+        "analogy_accuracy": round(r.accuracy, 4),
+        "correct": r.correct,
+        "total": r.total,
+        "skipped_oov": r.skipped_oov,
+        # continuous sensitivity metric: stays informative after both sides
+        # reach accuracy 1.0 (the instrument must not saturate)
+        "mean_gold_rank": round(r.mean_gold_rank, 3),
     }
 
 
@@ -117,16 +158,35 @@ def main() -> None:
                     help="band-kernel slab-space context scatter for OUR side")
     ap.add_argument("--prng", choices=["threefry", "rbg"], default="threefry",
                     help="jax PRNG impl for OUR side (CLI --prng)")
+    ap.add_argument("--table-dtype", choices=["float32", "bfloat16"],
+                    default="float32",
+                    help="table storage dtype for OUR side")
+    ap.add_argument("--sr", type=int, default=0, choices=[0, 1],
+                    help="stochastic rounding for OUR side (bf16 tables)")
     ap.add_argument("--skip-reference", action="store_true",
                     help="evaluate only this framework (no g++/reference)")
+    ap.add_argument("--analogy", action="store_true",
+                    help="analogy-parity mode: train both sides on the "
+                    "planted-RELATION corpus (utils/synthetic.analogy_corpus) "
+                    "and gate 3CosAdd accuracy instead of similarity Spearman "
+                    "— the Google-analogy half of the BASELINE accuracy gate")
     args = ap.parse_args()
 
     from measure_baseline import build  # reference_harness
 
-    from word2vec_tpu.utils.synthetic import topic_corpus, topic_similarity_pairs
+    from word2vec_tpu.utils.synthetic import (
+        analogy_corpus, topic_corpus, topic_similarity_pairs,
+    )
 
-    tokens, topic_of = topic_corpus(n_tokens=args.tokens, seed=args.seed)
-    pairs = topic_similarity_pairs(topic_of, seed=args.seed + 1)
+    if args.analogy:
+        tokens, questions = analogy_corpus(n_tokens=args.tokens, seed=args.seed)
+        evaluate = lambda path: eval_analogy_vectors(path, questions)  # noqa: E731
+        corpus_name = f"analogy-synthetic-{args.tokens} tokens"
+    else:
+        tokens, topic_of = topic_corpus(n_tokens=args.tokens, seed=args.seed)
+        pairs = topic_similarity_pairs(topic_of, seed=args.seed + 1)
+        evaluate = lambda path: eval_vectors(path, pairs, topic_of)  # noqa: E731
+        corpus_name = f"topic-synthetic-{args.tokens} tokens"
 
     if args.train_method == "hs":
         args.negative = 0
@@ -135,7 +195,7 @@ def main() -> None:
         f"dim={args.dim} w={args.window} iter={args.iters} "
         f"subsample={args.subsample} kernel={args.kernel} "
         f"kp={args.shared_negatives} prng={args.prng}",
-        "corpus": f"topic-synthetic-{args.tokens} tokens",
+        "corpus": corpus_name,
     }
     with tempfile.TemporaryDirectory() as tmp:
         with open(os.path.join(tmp, "text8"), "w") as f:
@@ -155,9 +215,7 @@ def main() -> None:
                 [exe, *common, "-output", "vec_ref.txt", "-threads", "1"],
                 cwd=tmp, check=True, capture_output=True,
             )
-            result["reference"] = eval_vectors(
-                os.path.join(tmp, "vec_ref.txt"), pairs, topic_of
-            )
+            result["reference"] = evaluate(os.path.join(tmp, "vec_ref.txt"))
 
         subprocess.run(
             [
@@ -167,26 +225,39 @@ def main() -> None:
                 "--shared-negatives", str(args.shared_negatives),
                 "--slab-scatter", str(args.slab_scatter),
                 "--prng", args.prng,
+                "--table-dtype", args.table_dtype,
+                "--stochastic-rounding", str(args.sr),
             ],
             cwd=tmp, check=True, capture_output=True,
             env={**os.environ, "PYTHONPATH": REPO + os.pathsep
                  + os.environ.get("PYTHONPATH", "")},
         )
-        result["ours"] = eval_vectors(
-            os.path.join(tmp, "vec_ours.txt"), pairs, topic_of
-        )
+        result["ours"] = evaluate(os.path.join(tmp, "vec_ours.txt"))
 
     if "reference" in result and "error" not in result["reference"]:
-        result["delta_spearman"] = round(
-            result["ours"]["spearman"] - result["reference"]["spearman"], 4
-        )
-        result["delta_purity"] = round(
-            result["ours"]["neighbor_purity@10"]
-            - result["reference"]["neighbor_purity@10"], 4
-        )
-        result["delta_margin"] = round(
-            result["ours"]["cos_margin"] - result["reference"]["cos_margin"], 4
-        )
+        if args.analogy:
+            result["delta_accuracy"] = round(
+                result["ours"]["analogy_accuracy"]
+                - result["reference"]["analogy_accuracy"], 4
+            )
+            result["delta_gold_rank"] = round(
+                result["ours"]["mean_gold_rank"]
+                - result["reference"]["mean_gold_rank"], 3
+            )
+        else:
+            result["delta_spearman"] = round(
+                result["ours"]["spearman"] - result["reference"]["spearman"], 4
+            )
+            result["delta_purity"] = round(
+                result["ours"]["neighbor_purity@10"]
+                - result["reference"]["neighbor_purity@10"], 4
+            )
+            m_ours = result["ours"]["cos_margin"]
+            m_ref = result["reference"]["cos_margin"]
+            result["delta_margin"] = (
+                round(m_ours - m_ref, 4)
+                if m_ours is not None and m_ref is not None else None
+            )
     print(json.dumps(result))
 
 

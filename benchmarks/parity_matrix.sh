@@ -1,0 +1,31 @@
+#!/bin/bash
+# Full-budget accuracy-parity matrix vs the compiled C++ reference (CPU).
+# Rows: every shipped model x objective route at the 200k/dim64/5-iter
+# budget (deltas are meaningful there; the CI tests gate a reduced budget),
+# the pair-kernel route, KP sensitivity, bf16+SR tables, and the
+# analogy-parity rows (grid corpus, 3CosAdd, BASELINE gate's second half).
+# Usage: bash benchmarks/parity_matrix.sh > benchmarks/PARITY_MATRIX_r3.txt
+cd "$(dirname "$0")/.." || exit 1
+P="python benchmarks/parity.py --tokens 200000 --dim 64 --iters 5"
+echo "# Parity matrix r3 ($(date -u +%F)): ours vs compiled reference,"
+echo "# same stream, same eval. delta_* = ours - reference."
+for args in \
+  "--model sg   --train-method ns" \
+  "--model cbow --train-method ns" \
+  "--model sg   --train-method hs" \
+  "--model cbow --train-method hs" \
+  "--model sg   --train-method ns --kernel pair" \
+  "--model sg   --train-method ns --shared-negatives 32" \
+  "--model sg   --train-method ns --shared-negatives 8" \
+  "--model sg   --train-method ns --prng rbg" \
+  "--model sg   --train-method ns --table-dtype bfloat16 --sr 1" \
+  ; do
+  echo "## parity $args"
+  timeout 900 $P $args 2>/dev/null | tail -1
+done
+echo "## analogy parity (grid corpus, 3CosAdd)"
+timeout 900 python benchmarks/parity.py --analogy --tokens 300000 2>/dev/null | tail -1
+echo "## analogy parity, cbow"
+timeout 900 python benchmarks/parity.py --analogy --tokens 300000 --model cbow 2>/dev/null | tail -1
+echo "## analogy parity, hs"
+timeout 900 python benchmarks/parity.py --analogy --tokens 300000 --train-method hs 2>/dev/null | tail -1

@@ -79,7 +79,8 @@ def main() -> None:
             "-negative", str(args.negative), "-size", str(args.dim),
             "-window", str(args.window), "-iter", str(args.iters),
             "-min-count", "5", "-subsample", "1e-4",
-            "--chunk-steps", "0",
+            "--chunk-steps", "0", "--emit-device",
+            "--log-jsonl", "train_log.jsonl", "--log-every", "1",
         ]
         if args.backend:
             cmd += ["--backend", args.backend]
@@ -109,9 +110,37 @@ def main() -> None:
             return
         scores = eval_vectors(os.path.join(tmp, "vec.txt"), pairs, topic_of)
 
+        # trust-region engagement across the run (ADVICE r2: at-scale runs
+        # must report when/how often clip_row_update actually fires)
+        clip_total = clip_max = 0.0
+        log_path = os.path.join(tmp, "train_log.jsonl")
+        if os.path.exists(log_path):
+            with open(log_path) as f:
+                for line in f:
+                    try:
+                        v = json.loads(line).get("clip_engaged_rows")
+                    except json.JSONDecodeError:
+                        continue
+                    if v is not None:
+                        clip_total += v
+                        clip_max = max(clip_max, v)
+        scores["clip_engaged_rows_total"] = clip_total
+        scores["clip_engaged_rows_max_per_chunk"] = clip_max
+
+    # where the train child actually executed (cli.py --emit-device): a
+    # silent CPU fallback must be distinguishable from an on-chip run
+    platform, device_kind = "unknown", "unknown"
+    for line in run.stderr.splitlines():
+        if line.startswith("device: "):
+            parts = line[len("device: "):].split(None, 1)
+            platform = parts[0]
+            device_kind = parts[1] if len(parts) > 1 else platform
+
     # what the CLI's auto-selection actually routes this config through
     kernel = "band" if args.train_method == "ns" else "hs-positional"
     print(json.dumps({
+        "platform": platform,
+        "device_kind": device_kind,
         "config": f"{args.model}+{args.train_method} k={args.negative} "
         f"dim={args.dim} w={args.window} iter={args.iters} "
         f"(shipped path: {kernel} kernel, resident, chunked, auto geometry)",

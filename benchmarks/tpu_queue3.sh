@@ -1,0 +1,91 @@
+#!/bin/bash
+# Round-3 TPU measurement queue — IDEMPOTENT, tunnel-flap-proof.
+#
+# The round-2 watchers were one-shot sweeps: when the tunnel dropped mid-list
+# the remaining items were lost (benchmarks/TPU_R2/sweep1.txt dies mid-line,
+# sweep2.txt is a header only). This queue banks every result as its own file
+# in benchmarks/TPU_R3/ and SKIPS items that already banked, so the script can
+# be killed and restarted any number of times and always resumes at the first
+# unmeasured item. Probe runs before every item, not once up front.
+#
+# Usage: nohup bash benchmarks/tpu_queue3.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/TPU_R3
+mkdir -p "$OUT"
+LOG=$OUT/queue.log
+
+probe() { timeout 75 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1; }
+
+# run_item <name> <timeout_s> <success_marker> <cmd...>
+# Banks the last stdout line to $OUT/<name>.json iff it contains the marker;
+# otherwise saves it as .failed (a later restart retries the item).
+run_item() {
+  local name=$1 tmo=$2 marker=$3; shift 3
+  [ -s "$OUT/$name.json" ] && return 0
+  until probe; do sleep 110; done
+  echo "$(date -u +%FT%TZ) start $name: $*" >> "$LOG"
+  timeout "$tmo" "$@" 2>>"$OUT/$name.stderr" | tail -1 > "$OUT/$name.tmp"
+  if grep -q "$marker" "$OUT/$name.tmp" 2>/dev/null; then
+    mv "$OUT/$name.tmp" "$OUT/$name.json"
+    rm -f "$OUT/$name.stderr" "$OUT/$name.failed"
+    echo "$(date -u +%FT%TZ) banked $name: $(cat "$OUT/$name.json")" >> "$LOG"
+  else
+    mv "$OUT/$name.tmp" "$OUT/$name.failed" 2>/dev/null
+    echo "$(date -u +%FT%TZ) FAILED $name" >> "$LOG"
+  fi
+}
+
+B='python bench.py --probe-retries 1'
+TPU='"platform": "tpu"'
+
+# --- phase 1: the lever sweep (VERDICT item 1) -------------------------------
+run_item default      900 "$TPU" $B
+run_item fused        900 "$TPU" $B --fused 1
+run_item kp32         900 "$TPU" $B --kp 32
+run_item chunk96      900 "$TPU" $B --chunk-cap 96
+run_item b512         900 "$TPU" $B --batch-rows 512
+run_item rbg          900 "$TPU" $B --prng rbg
+# combos (each lever is independent machinery; measure the stack)
+run_item fused_kp32           900 "$TPU" $B --fused 1 --kp 32
+run_item fused_kp32_c96       900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96
+run_item fused_kp32_c96_rbg   900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96 --prng rbg
+run_item fused_kp32_c96_b512  900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96 --batch-rows 512
+
+# bf16 table storage + stochastic rounding (VERDICT item 8)
+run_item bf16sr               900 "$TPU" $B --table-dtype bfloat16 --sr 1
+run_item bf16sr_fused_kp32_c96 900 "$TPU" $B --table-dtype bfloat16 --sr 1 --fused 1 --kp 32 --chunk-cap 96
+
+# --- phase 2: BASELINE configs 2 & 3 (VERDICT item 5) ------------------------
+run_item cbow_dim100  900 "$TPU" $B --model cbow --dim 100
+run_item hs_dim200    900 "$TPU" $B --train-method hs --dim 200
+
+# --- phase 3: quality at scale on chip (VERDICT item 6) ----------------------
+# marker is the platform field (cli --emit-device → quality_full JSON): a
+# silent CPU fallback must not bank as an on-chip quality result
+run_item quality_hs_dim300 2400 "$TPU" \
+  python benchmarks/quality_full.py --tokens 4000000 --train-method hs --dim 300
+run_item quality_sg_dim300 2400 "$TPU" \
+  python benchmarks/quality_full.py --tokens 4000000
+
+# --- phase 4: enwik9-shape scale rehearsal (VERDICT item 7) ------------------
+run_item enwik9_100M 3600 "$TPU" $B --tokens 100000000 --window 10 --run-timeout 3000
+
+# --- phase 5: fresh step trace with round-3 defaults -------------------------
+# keep the report only if it parsed a device plane ("XLA Ops total"), so a
+# failed capture is retried on the next restart instead of banking a traceback
+if [ ! -s "$OUT/trace_report.txt" ]; then
+  until probe; do sleep 110; done
+  echo "$(date -u +%FT%TZ) start trace" >> "$LOG"
+  timeout 900 python benchmarks/trace_tools.py capture --out /tmp/tr_r3 \
+    >> "$OUT/trace_capture.out" 2>&1
+  timeout 300 python benchmarks/trace_tools.py report /tmp/tr_r3 \
+    > "$OUT/trace_report.tmp" 2>&1
+  if grep -q "XLA Ops total" "$OUT/trace_report.tmp"; then
+    mv "$OUT/trace_report.tmp" "$OUT/trace_report.txt"
+    echo "$(date -u +%FT%TZ) banked trace_report" >> "$LOG"
+  else
+    echo "$(date -u +%FT%TZ) FAILED trace" >> "$LOG"
+  fi
+fi
+
+echo "$(date -u +%FT%TZ) QUEUE COMPLETE" >> "$LOG"

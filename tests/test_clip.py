@@ -122,3 +122,45 @@ def test_hot_row_batch_bounded_and_finite(train_method, kernel):
         for k in p2
     )
     assert moved_off > 2.0 * moved_on, (moved_off, moved_on)
+
+
+@pytest.mark.parametrize("train_method,kernel", [
+    ("ns", "band"), ("ns", "pair"), ("hs", "band"),
+])
+def test_clip_engagement_metric(train_method, kernel):
+    """clip_engaged (ADVICE r2): the metrics must report HOW OFTEN the trust
+    region fires — >0 on the adversarial hot-row batch, exactly 0 on a tame
+    batch (where the clip is a bitwise no-op) and with the clip disabled."""
+    cfg, tables, tokens, params = _hot_setup(train_method, kernel)
+    step = jax.jit(make_train_step(cfg, tables))
+    _, m = step(
+        {k: v.copy() for k, v in params.items()},
+        tokens, jax.random.key(1), jnp.float32(cfg.init_alpha),
+    )
+    assert float(m["clip_engaged"]) > 0.0
+
+    # tame batch at a sane LR: no ns row reaches the cap. hs differs by
+    # design — the Huffman root collects a contribution from EVERY path in
+    # the batch (the documented worst-case hot row, ops/hs_step.py), so a
+    # couple of top-of-tree rows legitimately engage even here.
+    import dataclasses
+
+    tame_tokens = jnp.asarray(
+        np.arange(16 * 64, dtype=np.int32).reshape(16, 64) % V
+    )
+    _, m2 = step(
+        {k: v.copy() for k, v in params.items()},
+        tame_tokens, jax.random.key(1), jnp.float32(0.025),
+    )
+    if train_method == "ns":
+        assert float(m2["clip_engaged"]) == 0.0
+    else:
+        assert float(m2["clip_engaged"]) <= 4.0
+
+    cfg_off = dataclasses.replace(cfg, clip_row_update=0.0)
+    step_off = jax.jit(make_train_step(cfg_off, tables))
+    _, m3 = step_off(
+        {k: v.copy() for k, v in params.items()},
+        tokens, jax.random.key(1), jnp.float32(cfg.init_alpha),
+    )
+    assert float(m3["clip_engaged"]) == 0.0

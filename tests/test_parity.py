@@ -87,6 +87,22 @@ def test_eval_score_parity_with_reference(model, method, extra):
     assert result["ours"]["cos_margin"] > 0.3, result
 
 
+def test_analogy_parity_with_reference():
+    """The Google-analogy half of the BASELINE accuracy gate: train both
+    implementations on the planted compositional-grid corpus
+    (utils/synthetic.analogy_corpus) and score the SAME 3CosAdd questions
+    with eval/analogy.py. At this budget both sides solve the grid exactly
+    (accuracy 1.0, mean gold rank 1.0 — calibrated 2026-07-30), so the gate
+    is the BASELINE ±1% with headroom-free absolute floors."""
+    result = run_parity("--analogy", "--tokens", "200000")
+    ref, ours = result["reference"], result["ours"]
+    assert ref["analogy_accuracy"] >= 0.98, result
+    assert ours["analogy_accuracy"] >= 0.98, result
+    assert abs(result["delta_accuracy"]) <= 0.01, result  # BASELINE ±1%
+    # continuous instrument: gold must rank essentially first on average
+    assert ours["mean_gold_rank"] < 1.5, result
+
+
 def test_cbow_hs_absolute_quality():
     """The reference cannot train cbow+hs (latent bug above); we can. Gate on
     absolute recovery of the planted structure instead of a delta."""

@@ -72,9 +72,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="jax PRNG impl for the device draw streams "
                         "(subsample gate / window shrink / negatives); rbg "
                         "is cheaper on TPU, statistically equivalent, but a "
-                        "different stream - the impl is not part of the "
-                        "checkpoint, so pass the same --prng when resuming "
-                        "to keep one consistent stream")
+                        "different stream. Persisted in checkpoints "
+                        "(config.prng_impl): a resumed run keeps the "
+                        "checkpoint's impl and warns if this flag differs")
     p.add_argument("--dp", type=int, default=1, help="data-parallel mesh axis")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
     p.add_argument("--sp", type=int, default=1,
@@ -120,6 +120,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-dtype", choices=["bfloat16", "float32"],
                    default="bfloat16",
                    help="dot-product dtype; float32 for reference-exact scores")
+    p.add_argument("--table-dtype", choices=["float32", "bfloat16"],
+                   default="float32",
+                   help="storage dtype of the [V, d] embedding tables; "
+                        "bfloat16 halves their HBM bytes (pair with "
+                        "--stochastic-rounding: SGD updates are usually "
+                        "below bf16's ulp and nearest-rounding drops them)")
+    p.add_argument("--stochastic-rounding", type=int, default=0, choices=[0, 1],
+                   help="unbiased stochastic rounding of table updates "
+                        "(bfloat16 tables, ns band route; "
+                        "config.stochastic_rounding)")
     p.add_argument("--shared-negatives", type=int, default=64,
                    help="shared negative draws per batch row (band kernel)")
     p.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
@@ -156,6 +166,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable jax_debug_nans (SURVEY §5: the batched-update "
                         "analog of a race detector/sanitizer)")
     p.add_argument("--quiet", action="store_true")
+    p.add_argument("--emit-device", action="store_true",
+                   help="after training, print one machine-readable "
+                        "'device: <platform> <kind>' line to stderr even "
+                        "under --quiet (harnesses use it to prove where a "
+                        "run actually executed — a silent CPU fallback must "
+                        "not bank as an on-chip result)")
     return p
 
 
@@ -167,6 +183,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     args = parser.parse_args(argv)
 
+    if args.backend == "cpu":
+        # before the multihost init: the coordination handshake must see the
+        # cpu platform, not the tunnel backend the sitecustomize pins
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
     if args.multihost:
         # must run before any backend use on every host
         from .parallel.multihost import initialize_from_env
@@ -177,19 +203,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "not configured; continuing single-process",
                 file=sys.stderr,
             )
-
-    if args.backend == "cpu":
-        import jax
-
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
-    if args.prng != "threefry":
-        import jax
-
-        jax.config.update("jax_default_prng_impl", args.prng)
-
     import jax
 
     from .config import Word2VecConfig
@@ -247,10 +260,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             slab_scatter=bool(args.slab_scatter),
             resident=args.resident,
             clip_row_update=args.clip_row_update,
+            prng_impl=args.prng,
+            dtype=args.table_dtype,
+            stochastic_rounding=bool(args.stochastic_rounding),
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+
+    if ck_cfg is not None and args.prng != ck_cfg.prng_impl:
+        # unconditional (even under --quiet): silently switching the draw
+        # streams mid-run is exactly the hazard the persisted field prevents
+        print(
+            f"resume: checkpoint pins prng_impl={ck_cfg.prng_impl!r}; "
+            f"ignoring --prng {args.prng} (the draw streams stay on the "
+            f"checkpoint's impl)",
+            file=sys.stderr,
+        )
 
     if not args.train:
         print("error: -train <file> is required", file=sys.stderr)
@@ -423,6 +449,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             checkpoint_cb=ckpt_cb,
             checkpoint_every=args.checkpoint_every,
         )
+    if args.emit_device:
+        dev = jax.devices()[0]
+        print(f"device: {dev.platform} {dev.device_kind}", file=sys.stderr)
     if not args.quiet and is_primary:
         print(f"\ntrained {report.total_words} words in {report.wall_time:.1f}s "
               f"({report.words_per_sec:,.0f} words/sec), final loss "

@@ -26,6 +26,10 @@ class AnalogyResult:
     total: int
     skipped_oov: int
     by_section: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    # Mean rank of the gold answer among candidates (1 = top). Accuracy
+    # saturates once every gold ranks first; the rank stays continuous, so
+    # parity harnesses keep sensitivity after both sides hit 100%.
+    mean_gold_rank: float = 0.0
 
 
 def load_questions(path: str) -> List[Tuple[str, List[Tuple[str, str, str, str]]]]:
@@ -56,17 +60,35 @@ def evaluate_analogies(
     batch_size: int = 512,
     restrict_vocab: int = 30000,
 ) -> AnalogyResult:
+    """3CosAdd over a questions-words.txt file; see evaluate_analogy_sections
+    for the protocol."""
+    return evaluate_analogy_sections(
+        W, vocab, load_questions(path), batch_size, restrict_vocab
+    )
+
+
+def evaluate_analogy_sections(
+    W: np.ndarray,
+    vocab: Vocab,
+    sections: List[Tuple[str, List[Tuple[str, str, str, str]]]],
+    batch_size: int = 512,
+    restrict_vocab: int = 30000,
+) -> AnalogyResult:
     """3CosAdd with the compute-accuracy conventions.
+
+    Takes in-memory (section, questions) lists so harnesses with generated
+    questions (benchmarks/parity.py planted-relation corpus) share the exact
+    scoring path the file-based CLI eval uses.
 
     restrict_vocab: candidate answers come from the most frequent N words
     (the original tool's `threshold`, default 30000), which also decides OOV
     skips — matching how published text8 numbers are produced.
     """
-    sections = load_questions(path)
     V = min(len(vocab), restrict_vocab) if restrict_vocab else len(vocab)
     Wn = W[:V] / np.maximum(np.linalg.norm(W[:V], axis=1, keepdims=True), 1e-12)
 
     correct = total = skipped = 0
+    rank_sum = 0.0
     by_section: Dict[str, Tuple[int, int]] = {}
     for name, questions in sections:
         ids = []
@@ -90,6 +112,7 @@ def evaluate_analogies(
             sims[rows, c] = -np.inf
             pred = sims.argmax(axis=1)
             sec_correct += int((pred == d).sum())
+            rank_sum += float((sims > sims[rows, d][:, None]).sum(axis=1).sum()) + len(chunk)
         by_section[name] = (sec_correct, len(ids))
         correct += sec_correct
         total += len(ids)
@@ -99,4 +122,5 @@ def evaluate_analogies(
         total=total,
         skipped_oov=skipped,
         by_section=by_section,
+        mean_gold_rank=rank_sum / total if total else 0.0,
     )

@@ -77,7 +77,9 @@ from ..config import Word2VecConfig
 from ..models.params import Params
 from . import banded
 from .tables import DeviceTables
-from .train_step import _draw_negatives, _dup_mean_scale, _row_clip_scale
+from .train_step import (
+    _cast_update, _draw_negatives, _dup_mean_scale, _row_clip_scale,
+)
 
 Metrics = Dict[str, jnp.ndarray]
 
@@ -165,6 +167,7 @@ def make_band_train_step(
     scatter_mean = config.scatter_mean
     clip_tau = config.clip_row_update
     slab_scatter = config.slab_scatter
+    sr = config.stochastic_rounding
     cdt = jnp.dtype(config.compute_dtype)
 
     def psum(x):
@@ -186,6 +189,12 @@ def make_band_train_step(
             center_zone = (pos >= W) & (pos < W + Lloc)
         B, L = tokens.shape
         k_sub, k_win, k_neg = jax.random.split(key, 3)
+        # SR draw streams, one per update site; fold_in (not a wider split)
+        # so the sub/win/neg streams are bit-identical with SR off or on
+        k_sr = (
+            (lambda i: jax.random.fold_in(jax.random.fold_in(key, 0x5B), i))
+            if sr else (lambda i: None)
+        )
 
         valid = tokens >= 0
         tok = jnp.where(valid, tokens, 0)
@@ -383,6 +392,7 @@ def make_band_train_step(
             d_out_flat = d_out_flat * inv[out_idx][:, None]
             d_neg_flat = d_neg_flat * inv[flat_negs][:, None]
 
+        clip_count = jnp.float32(0.0)
         if clip_tau > 0.0:
             # per-row trust region (train_step._row_clip_scale): the out
             # table's positive-context and negative-draw contributions share
@@ -396,6 +406,9 @@ def make_band_train_step(
                 (out_idx, d_out_flat), (flat_negs, d_neg_flat),
                 tp_axis=tp_axis,
             )
+            clip_count = jnp.sum((in_scale < 1.0).astype(jnp.float32)) + jnp.sum(
+                (out_scale < 1.0).astype(jnp.float32)
+            )
             d_in_flat = d_in_flat * in_scale[in_idx][:, None]
             d_out_flat = d_out_flat * out_scale[out_idx][:, None]
             d_neg_flat = d_neg_flat * out_scale[flat_negs][:, None]
@@ -405,20 +418,44 @@ def make_band_train_step(
             # one [N, 2, d] scatter covers both tables (same sorted ids);
             # negative rows land on the out plane of the fused array
             vals2 = jnp.stack([d_in_flat, d_out_flat], axis=1)
+            # SR quantizes each delta to the destination row's ulp grid, so
+            # the dest rows are re-gathered at the scatter indices (sr only)
             new_emb = emb.at[sorted_idx].add(
-                vals2.astype(emb.dtype), indices_are_sorted=True
+                _cast_update(
+                    vals2, emb.dtype, k_sr(0),
+                    emb[sorted_idx] if sr else None,
+                ),
+                indices_are_sorted=True,
             )
-            new_emb = new_emb.at[flat_negs, 1].add(d_neg_flat.astype(emb.dtype))
+            new_emb = new_emb.at[flat_negs, 1].add(
+                _cast_update(
+                    d_neg_flat, emb.dtype, k_sr(1),
+                    emb[flat_negs, 1] if sr else None,
+                )
+            )
             new_params[FUSED_KEY] = new_emb
         else:
             new_in = emb_in.at[in_idx].add(
-                d_in_flat.astype(emb_in.dtype), indices_are_sorted=in_sorted
+                _cast_update(
+                    d_in_flat, emb_in.dtype, k_sr(0),
+                    emb_in[in_idx] if sr else None,
+                ),
+                indices_are_sorted=in_sorted,
             )
             new_out = emb_out.at[out_idx].add(
-                d_out_flat.astype(emb_out.dtype), indices_are_sorted=out_sorted
+                _cast_update(
+                    d_out_flat, emb_out.dtype, k_sr(1),
+                    emb_out[out_idx] if sr else None,
+                ),
+                indices_are_sorted=out_sorted,
             )
             # negative-row scatter (KP rows per batch row; duplicates sum)
-            new_out = new_out.at[flat_negs].add(d_neg_flat.astype(emb_out.dtype))
+            new_out = new_out.at[flat_negs].add(
+                _cast_update(
+                    d_neg_flat, emb_out.dtype, k_sr(2),
+                    emb_out[flat_negs] if sr else None,
+                )
+            )
             new_params["emb_in"] = new_in
             new_params["emb_out_ns"] = new_out
 
@@ -428,6 +465,7 @@ def make_band_train_step(
         metrics = {
             "loss_sum": pos_loss + neg_loss,
             "pairs": pos_pairs + jnp.sum(w_neg),
+            "clip_engaged": clip_count,
         }
         return new_params, metrics
 

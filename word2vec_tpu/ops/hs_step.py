@@ -91,6 +91,7 @@ def make_hs_train_step(
         emb_in = params["emb_in"]
         syn1 = params["emb_out_hs"]
         C = tables.hs_points.shape[1]
+        clip_count = jnp.float32(0.0)  # rows the trust region engaged on
 
         if not is_cbow:
             # ---- skip-gram: h = center row; targets = each context's path.
@@ -158,10 +159,12 @@ def make_hs_train_step(
                     ctx_hit.reshape(-1).astype(jnp.float32),
                 )[:, None]
             if clip_tau > 0.0:
-                vals = vals * _row_clip_scale(
+                scale = _row_clip_scale(
                     emb_in.shape[0], clip_tau, (flat_c, vals),
                     tp_axis=tp_axis,
-                )[flat_c][:, None]
+                )
+                clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
+                vals = vals * scale[flat_c][:, None]
             new_in = emb_in.at[flat_c].add(vals.astype(emb_in.dtype))
 
             # path rows: one aggregated scatter over the padded positions
@@ -173,10 +176,12 @@ def make_hs_train_step(
                     syn1.shape[0], flat_p[order], out_touch.reshape(-1)[order]
                 )[:, None]
             if clip_tau > 0.0:
-                d_rows_flat = d_rows_flat * _row_clip_scale(
+                scale = _row_clip_scale(
                     syn1.shape[0], clip_tau, (flat_p[order], d_rows_flat),
                     tp_axis=tp_axis,
-                )[flat_p[order]][:, None]
+                )
+                clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
+                d_rows_flat = d_rows_flat * scale[flat_p[order]][:, None]
             new_out = syn1.at[flat_p[order]].add(
                 d_rows_flat.astype(syn1.dtype), indices_are_sorted=True
             )
@@ -250,10 +255,12 @@ def make_hs_train_step(
                         emb_in.shape[0], sflat, w
                     )[:, None]
                 if clip_tau > 0.0:
-                    vals = vals * _row_clip_scale(
+                    scale = _row_clip_scale(
                         emb_in.shape[0], clip_tau, (sflat, vals),
                         tp_axis=tp_axis,
-                    )[sflat][:, None]
+                    )
+                    clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
+                    vals = vals * scale[sflat][:, None]
                 new_in = emb_in.at[sflat].add(vals.astype(emb_in.dtype))
             else:
                 d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
@@ -266,10 +273,12 @@ def make_hs_train_step(
                         banded.band_col_sum(band_f, L, W, S).reshape(-1)[order],
                     )[:, None]
                 if clip_tau > 0.0:
-                    d_in_flat = d_in_flat * _row_clip_scale(
+                    scale = _row_clip_scale(
                         emb_in.shape[0], clip_tau, (flat_c[order], d_in_flat),
                         tp_axis=tp_axis,
-                    )[flat_c[order]][:, None]
+                    )
+                    clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
+                    d_in_flat = d_in_flat * scale[flat_c[order]][:, None]
                 new_in = emb_in.at[flat_c[order]].add(
                     d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
                 )
@@ -282,10 +291,12 @@ def make_hs_train_step(
                     syn1.shape[0], flat_p[porder], m.reshape(-1)[porder]
                 )[:, None]
             if clip_tau > 0.0:
-                d_rows_flat = d_rows_flat * _row_clip_scale(
+                scale = _row_clip_scale(
                     syn1.shape[0], clip_tau, (flat_p[porder], d_rows_flat),
                     tp_axis=tp_axis,
-                )[flat_p[porder]][:, None]
+                )
+                clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
+                d_rows_flat = d_rows_flat * scale[flat_p[porder]][:, None]
             new_out = syn1.at[flat_p[porder]].add(
                 d_rows_flat.astype(syn1.dtype), indices_are_sorted=True
             )
@@ -293,6 +304,10 @@ def make_hs_train_step(
         new_params = dict(params)
         new_params["emb_in"] = new_in
         new_params["emb_out_hs"] = new_out
-        return new_params, {"loss_sum": loss, "pairs": pairs}
+        return new_params, {
+            "loss_sum": loss,
+            "pairs": pairs,
+            "clip_engaged": clip_count,
+        }
 
     return step

@@ -36,17 +36,39 @@ from ..data.batcher import PAD, PackedCorpus, epoch_order  # noqa: F401
 from .tables import DeviceTables
 from .train_step import make_train_step
 
-# Corpora above this many packed bytes stay on the streaming host path
-# (auto mode). 2 GiB leaves the [V, d] tables and step workspace ample HBM
+# Ceiling for the auto-mode resident gate when the backend cannot report
+# real memory: 2 GiB leaves the [V, d] tables and step workspace ample HBM
 # on any current chip; int32 row addressing holds to 2^31 tokens anyway.
 RESIDENT_MAX_BYTES = 2 << 30
+
+
+def resident_budget_bytes() -> int:
+    """The packed-corpus HBM budget for auto mode.
+
+    Prefers the device's real accounting (memory_stats: bytes_limit minus
+    bytes_in_use, which already counts the tables and any donation
+    double-buffers living on the chip — the corpus is replicated per device
+    on sharded meshes, so per-device free memory is the right denominator)
+    with a 2x headroom for step workspace, capped at RESIDENT_MAX_BYTES.
+    Falls back to the constant where the backend reports nothing (CPU)."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        if limit:
+            free = int(limit) - int(stats.get("bytes_in_use", 0))
+            return max(0, min(RESIDENT_MAX_BYTES, free // 2))
+    except Exception:
+        pass
+    return RESIDENT_MAX_BYTES
+
 
 DeviceCorpus = Dict[str, jnp.ndarray]  # {"flat": [N], "starts": [R], "lens": [R]} i32
 
 
 def corpus_fits(corpus: PackedCorpus, max_bytes: int | None = None) -> bool:
-    if max_bytes is None:  # read the module attr at call time (testable)
-        max_bytes = RESIDENT_MAX_BYTES
+    if max_bytes is None:
+        # live budget each call (testable via the module attrs)
+        max_bytes = resident_budget_bytes()
     return (
         corpus.flat.nbytes + 8 * corpus.num_rows <= max_bytes
         and len(corpus.flat) < 2**31
@@ -132,14 +154,14 @@ def make_resident_chunk_runner(
             tokens = assemble_batch(corpus, order, epoch_t0 + i, B, L)
             key = jax.random.fold_in(base_key, step0 + i)
             p, m = step(p, tokens, key, a)
-            return p, (m["loss_sum"], m["pairs"])
+            return p, m
 
         s = alphas.shape[0]
         idx = jnp.arange(s, dtype=jnp.int32)
-        params, (loss, pairs) = jax.lax.scan(body, params, (idx, alphas))
+        params, metrics = jax.lax.scan(body, params, (idx, alphas))
         if fused:
             params = unfuse_tables(params)
-        return params, {"loss_sum": loss, "pairs": pairs}
+        return params, metrics
 
     return chunk
 

@@ -90,6 +90,50 @@ def _row_clip_scale(
     return tau / jnp.maximum(s, tau)
 
 
+def _cast_update(
+    vals: jnp.ndarray,
+    dtype: jnp.dtype,
+    key: jax.Array | None = None,
+    dest: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """f32 update values -> table storage dtype.
+
+    Plain astype (round-to-nearest) unless a key is given and the target is
+    bfloat16: then stochastic rounding AGAINST THE DESTINATION's ulp grid.
+
+    Why the destination grid: an SGD table update is usually far smaller
+    than bf16's ~2^-8 relative ulp of the weight it lands on, and the
+    scatter-add accumulates in bf16 with round-to-nearest — so any delta
+    below half that ulp would be swallowed by the ADD even if the delta
+    itself were stochastically rounded on its own (much finer) binade grid.
+    Quantizing each delta to an integer multiple of ulp(dest) with
+    probability proportional to the remainder keeps E[delta] exact AND
+    makes the subsequent bf16 accumulate exact (grid multiples add without
+    rounding until a binade crossing, a second-order effect): tiny updates
+    land as occasional whole-ulp steps instead of silently vanishing.
+    `dest` must hold the bf16 rows being updated, gathered at the same
+    indices the scatter uses. Without `dest` no SR is possible — callers
+    pass it whenever config.stochastic_rounding is on.
+
+    The |dest| floor of 1e-7 keeps the grid math inside f32's normal/
+    precision range (an unclamped ulp of a ZERO-initialized emb_out row
+    underflows and the q division NaNs): below it the grid is ~2^-31,
+    far finer than any SGD delta, so rounding there is effectively exact —
+    which is also the correct limit, since accumulating onto weights that
+    small is itself near-exact in bf16.
+    """
+    if key is None or jnp.dtype(dtype) != jnp.dtype(jnp.bfloat16):
+        return vals.astype(dtype)
+    assert dest is not None, "stochastic rounding needs the destination rows"
+    w = jnp.abs(dest.astype(jnp.float32))
+    # bf16 ulp(w) = 2^(exponent(w) - 7)
+    ulp = jnp.exp2(jnp.floor(jnp.log2(jnp.maximum(w, 1e-7))) - 7.0)
+    q = vals.astype(jnp.float32) / ulp
+    qf = jnp.floor(q)
+    u = jax.random.uniform(key, q.shape)
+    return ((qf + (u < q - qf)) * ulp).astype(jnp.bfloat16)
+
+
 def _dup_mean_scale(
     num_rows: int, flat_idx: jnp.ndarray, flat_weight: jnp.ndarray
 ) -> jnp.ndarray:
@@ -114,8 +158,10 @@ def _score_and_update(
     scatter_mean: bool,
     tp_axis: str | None = None,
     clip_tau: float = 0.0,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One sigmoid-SGD objective: returns (grad_h, new_out, loss_sum, pair_count).
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One sigmoid-SGD objective: returns (grad_h, new_out, loss_sum,
+    pair_count, clip_count) — clip_count = rows of `out` whose summed update
+    the trust region actually scaled this step (0 when clip_tau=0).
 
     Implements f = sigmoid(out[target] . h); g = (label - f) * alpha;
     grad_h += g * out[target]; out[target] += g * h
@@ -151,16 +197,19 @@ def _score_and_update(
     vals = grad_t.reshape(-1, d)
     if scatter_mean:
         vals = vals * _dup_mean_scale(out.shape[0], flat_t, tmask.reshape(-1))[:, None]
+    clip_count = jnp.float32(0.0)
     if clip_tau > 0.0:
-        vals = vals * _row_clip_scale(
+        scale = _row_clip_scale(
             out.shape[0], clip_tau, (flat_t, vals), tp_axis=tp_axis
-        )[flat_t][:, None]
+        )
+        clip_count = jnp.sum((scale < 1.0).astype(jnp.float32))
+        vals = vals * scale[flat_t][:, None]
     new_out = out.at[flat_t].add(vals.astype(out.dtype))
     # masked binary cross-entropy, for metrics only:
     # -[y log s(x) + (1-y) log s(-x)], with log s(-x) = log s(x) - x
     ls = jax.nn.log_sigmoid(logits)
     loss = -jnp.sum(tmask * jnp.where(labels > 0.5, ls, ls - logits))
-    return grad_h, new_out, loss, jnp.sum(tmask)
+    return grad_h, new_out, loss, jnp.sum(tmask), clip_count
 
 
 def make_train_step(
@@ -204,20 +253,18 @@ def make_train_step(
         sub = tokens.reshape(k, B // k, L)
 
         def body(i, carry):
-            p, loss, pairs = carry
+            p, acc = carry
             ki = jax.random.fold_in(key, i)
             p, m = base(p, sub[i], ki, alpha)
-            return p, loss + m["loss_sum"], pairs + m["pairs"]
+            return p, jax.tree.map(jnp.add, acc, m)
 
         # first sub-block peeled: under shard_map the metrics are varying
         # over the mesh axes, and a jnp.float32(0.0) initial carry would be
         # unvarying — a loop-carry type mismatch. Seeding the carry from a
         # real step gives it the right varying-axes type on any mesh.
         params, m0 = base(params, sub[0], jax.random.fold_in(key, 0), alpha)
-        params, loss, pairs = jax.lax.fori_loop(
-            1, k, body, (params, m0["loss_sum"], m0["pairs"])
-        )
-        return params, {"loss_sum": loss, "pairs": pairs}
+        params, metrics = jax.lax.fori_loop(1, k, body, (params, m0))
+        return params, metrics
 
     return micro
 
@@ -320,6 +367,7 @@ def make_pair_train_step(
         new_params = dict(params)
         loss_sum = jnp.float32(0.0)
         pair_count = jnp.float32(0.0)
+        clip_count = jnp.float32(0.0)  # rows the trust region engaged on
 
         if not is_cbow:
             # ---- skip-gram: input = center row of emb_in (W), predicted =
@@ -346,7 +394,7 @@ def make_pair_train_step(
                         [jnp.ones((P, 1), bool), negs != pred[:, None]], axis=1
                     )
                 ).astype(jnp.float32)
-                gh, new_out, ls, pc = _score_and_update(
+                gh, new_out, ls, pc, cc = _score_and_update(
                     h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
                     scatter_mean, tp_axis, clip_tau,
                 )
@@ -354,6 +402,7 @@ def make_pair_train_step(
                 new_params["emb_out_ns"] = new_out
                 loss_sum += ls
                 pair_count += pc
+                clip_count += cc
 
             if use_hs:
                 targets = tables.hs_points[pred]  # [P, Lc]
@@ -363,7 +412,7 @@ def make_pair_train_step(
                     mask[:, None]
                     & (jnp.arange(Lc, dtype=jnp.int32)[None, :] < tables.hs_len[pred][:, None])
                 ).astype(jnp.float32)
-                gh, new_out, ls, pc = _score_and_update(
+                gh, new_out, ls, pc, cc = _score_and_update(
                     h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
                     scatter_mean, tp_axis, clip_tau,
                 )
@@ -371,6 +420,7 @@ def make_pair_train_step(
                 new_params["emb_out_hs"] = new_out
                 loss_sum += ls
                 pair_count += pc
+                clip_count += cc
 
             # W.row(center) += grad accumulated over the center's window
             # (Word2Vec.cpp:351). The per-position window sum is reference-
@@ -390,10 +440,12 @@ def make_pair_train_step(
                     pair_mask.any(axis=2).reshape(-1).astype(jnp.float32),
                 )[:, None]
             if clip_tau > 0.0:
-                vals = vals * _row_clip_scale(
+                scale = _row_clip_scale(
                     params["emb_in"].shape[0], clip_tau, (flat_c, vals),
                     tp_axis=tp_axis,
-                )[flat_c][:, None]
+                )
+                clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
+                vals = vals * scale[flat_c][:, None]
             new_params["emb_in"] = params["emb_in"].at[flat_c].add(
                 vals.astype(params["emb_in"].dtype)
             )
@@ -428,7 +480,7 @@ def make_pair_train_step(
                         [jnp.ones((P, 1), bool), negs != pred[:, None]], axis=1
                     )
                 ).astype(jnp.float32)
-                gh, new_out, ls, pc = _score_and_update(
+                gh, new_out, ls, pc, cc = _score_and_update(
                     h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
                     scatter_mean, tp_axis, clip_tau,
                 )
@@ -436,6 +488,7 @@ def make_pair_train_step(
                 new_params["emb_out_ns"] = new_out
                 loss_sum += ls
                 pair_count += pc
+                clip_count += cc
 
             if use_hs:
                 targets = tables.hs_points[pred]
@@ -445,7 +498,7 @@ def make_pair_train_step(
                     mask[:, None]
                     & (jnp.arange(Lc, dtype=jnp.int32)[None, :] < tables.hs_len[pred][:, None])
                 ).astype(jnp.float32)
-                gh, new_out, ls, pc = _score_and_update(
+                gh, new_out, ls, pc, cc = _score_and_update(
                     h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
                     scatter_mean, tp_axis, clip_tau,
                 )
@@ -453,6 +506,7 @@ def make_pair_train_step(
                 new_params["emb_out_hs"] = new_out
                 loss_sum += ls
                 pair_count += pc
+                clip_count += cc
 
             # Fan the projection grad back to every contributing context row
             # (Word2Vec.cpp:313-315), with the second /neu1_num under cbow_mean.
@@ -468,15 +522,21 @@ def make_pair_train_step(
                     pair_mask.reshape(-1).astype(jnp.float32),
                 )[:, None]
             if clip_tau > 0.0:
-                g_ctx = g_ctx * _row_clip_scale(
+                scale = _row_clip_scale(
                     params["emb_in"].shape[0], clip_tau, (flat_ctx, g_ctx),
                     tp_axis=tp_axis,
-                )[flat_ctx][:, None]
+                )
+                clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
+                g_ctx = g_ctx * scale[flat_ctx][:, None]
             new_params["emb_in"] = params["emb_in"].at[flat_ctx].add(
                 g_ctx.astype(params["emb_in"].dtype)
             )
 
-        metrics = {"loss_sum": loss_sum, "pairs": pair_count}
+        metrics = {
+            "loss_sum": loss_sum,
+            "pairs": pair_count,
+            "clip_engaged": clip_count,
+        }
         return new_params, metrics
 
     return step
@@ -528,14 +588,16 @@ def make_chunk_runner(
             toks, i, a = xs
             key = jax.random.fold_in(base_key, step0 + i)
             p, m = step(p, toks, key, a)
-            return p, (m["loss_sum"], m["pairs"])
+            return p, m
 
         s = tokens.shape[0]
         idx = jnp.arange(s, dtype=jnp.int32)
-        params, (loss, pairs) = jax.lax.scan(body, params, (tokens, idx, alphas))
+        # scan stacks each metric key to [S]; keys are whatever the kernel
+        # emits (loss_sum / pairs / clip_engaged / ...)
+        params, metrics = jax.lax.scan(body, params, (tokens, idx, alphas))
         if fused:
             params = unfuse_tables(params)
-        return params, {"loss_sum": loss, "pairs": pairs}
+        return params, metrics
 
     return chunk
 

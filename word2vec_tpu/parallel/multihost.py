@@ -120,20 +120,40 @@ def make_global_mesh(
 ) -> jax.sharding.Mesh:
     """A (data, seq, model) mesh over the global device set.
 
-    Single-process: identical to parallel.mesh.make_mesh. Multi-process:
-    builds a hybrid DCN x ICI device grid via mesh_utils so that mesh
-    coordinates map to the physical topology per the policy above.
-    `num_slices` defaults to jax.process_count() (one slice per host).
+    Single-process: identical to parallel.mesh.make_mesh. Multi-process on
+    multi-slice hardware (devices report distinct slice_index, i.e. TPU
+    slices joined by DCN): a hybrid DCN x ICI device grid via mesh_utils so
+    mesh coordinates map to the physical topology per the policy above.
+    Multi-process on a SINGLE slice — every CPU multi-process job, and TPU
+    hosts sharing one pod slice — has no DCN boundary to respect (mesh_utils
+    rejects dcn shapes there; found by executing benchmarks/multiproc.py):
+    the grid is the process-ordered jax.devices() list reshaped data-major,
+    which keeps each process's local devices contiguous along the data axis
+    — the layout the per-process batch assembly assumes
+    (ShardedTrainer._place, make_array_from_process_local_data).
+    `num_slices` defaults to the detected slice count.
     """
     if jax.process_count() == 1:
         return make_mesh(dp, tp, sp)
-    from jax.experimental import mesh_utils
+    import numpy as np
 
-    slices = jax.process_count() if num_slices is None else num_slices
-    dcn, ici = hybrid_axes(dp, sp, tp, slices)
-    grid = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=ici, dcn_mesh_shape=dcn
-    )
+    devs = jax.devices()
+    if num_slices is None:
+        num_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    if dp * sp * tp != len(devs):
+        raise ValueError(
+            f"mesh dp*sp*tp = {dp}*{sp}*{tp} must cover the global device "
+            f"set ({len(devs)} devices across {jax.process_count()} processes)"
+        )
+    if num_slices > 1:
+        from jax.experimental import mesh_utils
+
+        dcn, ici = hybrid_axes(dp, sp, tp, num_slices)
+        grid = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=ici, dcn_mesh_shape=dcn
+        )
+    else:
+        grid = np.asarray(devs).reshape(dp, sp, tp)
     return jax.sharding.Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 

@@ -178,17 +178,14 @@ def make_sharded_chunk(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh)
                 k: jax.lax.psum(jax.lax.psum(v, MODEL_AXIS) / tp, REPLICA_AXES)
                 for k, v in m.items()
             }
-            return pp, (m["loss_sum"], m["pairs"])
+            return pp, m
 
         s = tokens.shape[0]
         idx = jnp.arange(s, dtype=jnp.int32)
-        p, (loss, pairs) = jax.lax.scan(body, p, (tokens, idx, alphas))
+        p, metrics = jax.lax.scan(body, p, (tokens, idx, alphas))
         if fused:
             p = unfuse_tables(p)
-        return (
-            {k: v[None] for k, v in p.items()},
-            {"loss_sum": loss, "pairs": pairs},
-        )
+        return ({k: v[None] for k, v in p.items()}, metrics)
 
     def chunkfn(params, tokens, base_key, step0, alphas):
         specs = {k: PARAM_SPEC for k in params}
@@ -256,17 +253,14 @@ def make_sharded_resident_chunk(
                 k: jax.lax.psum(jax.lax.psum(v, MODEL_AXIS) / tp, REPLICA_AXES)
                 for k, v in m.items()
             }
-            return pp, (m["loss_sum"], m["pairs"])
+            return pp, m
 
         s = alphas.shape[0]
         idx = jnp.arange(s, dtype=jnp.int32)
-        p, (loss, pairs) = jax.lax.scan(body, p, (idx, alphas))
+        p, metrics = jax.lax.scan(body, p, (idx, alphas))
         if fused:
             p = unfuse_tables(p)
-        return (
-            {k: v[None] for k, v in p.items()},
-            {"loss_sum": loss, "pairs": pairs},
-        )
+        return ({k: v[None] for k, v in p.items()}, metrics)
 
     def chunkfn(params, corpus, order, base_key, step0, epoch_t0, alphas):
         specs = {k: PARAM_SPEC for k in params}

@@ -163,7 +163,10 @@ class Trainer:
 
     # ----------------------------------------------------------------- api
     def init_state(self, seed: Optional[int] = None) -> TrainState:
-        key = jax.random.key(self.config.seed if seed is None else seed)
+        key = jax.random.key(
+            self.config.seed if seed is None else seed,
+            impl=self.config.jax_prng_impl,
+        )
         return TrainState(params=self._init_params(key))
 
     def alpha_at(self, words_done: int) -> float:
@@ -183,7 +186,9 @@ class Trainer:
         batcher = BatchIterator(
             self.corpus, cfg.batch_rows, cfg.max_sentence_len, seed=cfg.seed
         )
-        base_key = jax.random.key(cfg.seed ^ 0x5EED)
+        # the root of the device draw streams; impl comes from the config so
+        # checkpoints pin it and a resumed run keeps one consistent stream
+        base_key = jax.random.key(cfg.seed ^ 0x5EED, impl=cfg.jax_prng_impl)
 
         t0 = time.perf_counter()
         loss_hist: List[float] = []
@@ -243,17 +248,18 @@ class Trainer:
                         )
                     if self.log_fn:
                         dt = time.perf_counter() - t0
-                        self.log_fn(
-                            {
-                                "step": state.step,
-                                "epoch": epoch,
-                                "alpha": float(alpha),
-                                "loss": loss,
-                                "progress": state.words_done
-                                / (cfg.iters * self.total_words),
-                                "words_per_sec": state.words_done / max(dt, 1e-9),
-                            }
-                        )
+                        rec = {
+                            "step": state.step,
+                            "epoch": epoch,
+                            "alpha": float(alpha),
+                            "loss": loss,
+                            "progress": state.words_done
+                            / (cfg.iters * self.total_words),
+                            "words_per_sec": state.words_done / max(dt, 1e-9),
+                        }
+                        if "clip_engaged" in m:
+                            rec["clip_engaged_rows"] = float(m["clip_engaged"])
+                        self.log_fn(rec)
                 if checkpoint_every and checkpoint_cb and state.step % checkpoint_every == 0:
                     checkpoint_cb(state)
             state.epoch = epoch + 1  # epoch completed
@@ -539,14 +545,19 @@ class Trainer:
         loss_hist.append(loss)
         if self.log_fn:
             dt = time.perf_counter() - t0
-            self.log_fn(
-                {
-                    "step": at_step,
-                    "epoch": at_epoch,
-                    "alpha": at_alpha,
-                    "loss": loss,
-                    "progress": at_words
-                    / (self.config.iters * max(1, self.total_words)),
-                    "words_per_sec": at_words / max(dt, 1e-9),
-                }
-            )
+            rec = {
+                "step": at_step,
+                "epoch": at_epoch,
+                "alpha": at_alpha,
+                "loss": loss,
+                "progress": at_words
+                / (self.config.iters * max(1, self.total_words)),
+                "words_per_sec": at_words / max(dt, 1e-9),
+            }
+            if "clip_engaged" in m:
+                # trust-region observability (config.clip_row_update): rows
+                # whose summed update was actually scaled this chunk — 0 on
+                # healthy runs; a persistently large value means the cap is
+                # reshaping training, not just catching spikes
+                rec["clip_engaged_rows"] = float(np.sum(m["clip_engaged"]))
+            self.log_fn(rec)

@@ -98,60 +98,71 @@ def topic_corpus(
 
 
 def analogy_corpus(
-    n_pairs: int = 16,
-    words_per_topic: int = 20,
-    marker_words: int = 20,
+    n_rows: int = 8,
+    n_cols: int = 4,
+    words_per_pool: int = 20,
     n_tokens: int = 300_000,
     span_len: int = 20,
-    p_pairword: float = 0.3,
-    p_marker: float = 0.25,
+    p_cell: float = 0.2,
     seed: int = 0,
 ) -> Tuple[List[str], List[Tuple[str, str, str, str]]]:
-    """A token stream with planted RELATION structure for analogy parity.
+    """A token stream with planted COMPOSITIONAL structure for analogy parity.
 
-    Word pairs (base_i, marked_i), one per topic i: both draw their contexts
-    from topic i's pool, but marked_i's spans additionally mix in words from
-    one SHARED marker pool. Distributionally, marked_i - base_i then points
-    along the same marker direction for every i — the mechanism 3CosAdd
-    (b - a + c -> d) exploits in real corpora (king-queen etc.), so a
-    correct word2vec recovers the planted analogies and two implementations
-    can be compared on the SAME questions (the Google-analogy half of the
-    BASELINE parity gate, eval/analogy.py protocol).
+    A grid of cell words c{i}_{j}: each span picks a grid cell (i, j) and
+    emits the cell word mixed with words from row pool i and column pool j.
+    Distributionally a cell word is then row_i + col_j, so
 
-    Returns (tokens, questions) with questions = all ordered pairs
-    (base_i, marked_i, base_j, marked_j), i != j.
+        c{i}_{k} - c{i}_{j} + c{l}_{j}  ->  row_l + col_k  =  c{l}_{k}
+
+    — exactly the additive mechanism 3CosAdd exploits in real corpora
+    (king - man + woman -> queen), with the row pools playing "semantic"
+    content and the column pools the shared relation (tense/gender/...).
+    Row-pool words lack the column component and column-pool words lack the
+    row component, so the planted answer beats both candidate families only
+    when BOTH components were learned: a real instrument, unlike a
+    same-topic-nearest-neighbor test. Two implementations trained on the
+    same stream are compared on the SAME questions (the Google-analogy half
+    of the BASELINE parity gate, eval/analogy.py protocol; an earlier
+    marker-pool design was unrecoverable by construction — the markers
+    co-occurred with the whole topic pool, so content words absorbed the
+    relation direction and crowded out every answer).
+
+    Returns (tokens, questions) with questions = all
+    (c{i}_{j}, c{i}_{k}, c{l}_{j}, c{l}_{k}), i != l, j != k.
     """
     rng = np.random.default_rng(seed)
-    topics = [
-        [f"r{i}c{k}" for k in range(words_per_topic)] for i in range(n_pairs)
+    rows = [
+        [f"row{i}w{k}" for k in range(words_per_pool)] for i in range(n_rows)
     ]
-    markers = [f"mk{k}" for k in range(marker_words)]
-    zipf_t = 1.0 / np.arange(1, words_per_topic + 1)
-    zipf_t /= zipf_t.sum()
-    zipf_m = 1.0 / np.arange(1, marker_words + 1)
-    zipf_m /= zipf_m.sum()
+    cols = [
+        [f"col{j}w{k}" for k in range(words_per_pool)] for j in range(n_cols)
+    ]
+    zipf = 1.0 / np.arange(1, words_per_pool + 1)
+    zipf /= zipf.sum()
 
     tokens: List[str] = []
     n_spans = n_tokens // span_len
-    for s in range(n_spans):
-        i = int(rng.integers(n_pairs))
-        marked = bool(rng.integers(2))
-        pairword = f"b{i}m" if marked else f"b{i}"
+    for _ in range(n_spans):
+        i = int(rng.integers(n_rows))
+        j = int(rng.integers(n_cols))
         r = rng.random(span_len)
-        ctx_t = rng.choice(words_per_topic, size=span_len, p=zipf_t)
-        ctx_m = rng.choice(marker_words, size=span_len, p=zipf_m)
+        ctx_r = rng.choice(words_per_pool, size=span_len, p=zipf)
+        ctx_c = rng.choice(words_per_pool, size=span_len, p=zipf)
+        p_pool = p_cell + (1.0 - p_cell) / 2.0
         for k in range(span_len):
-            if r[k] < p_pairword:
-                tokens.append(pairword)
-            elif marked and r[k] < p_pairword + p_marker:
-                tokens.append(markers[ctx_m[k]])
+            if r[k] < p_cell:
+                tokens.append(f"c{i}_{j}")
+            elif r[k] < p_pool:
+                tokens.append(rows[i][ctx_r[k]])
             else:
-                tokens.append(topics[i][ctx_t[k]])
+                tokens.append(cols[j][ctx_c[k]])
     questions = [
-        (f"b{i}", f"b{i}m", f"b{j}", f"b{j}m")
-        for i in range(n_pairs)
-        for j in range(n_pairs)
-        if i != j
+        (f"c{i}_{j}", f"c{i}_{k}", f"c{l}_{j}", f"c{l}_{k}")
+        for i in range(n_rows)
+        for l in range(n_rows)  # noqa: E741
+        for j in range(n_cols)
+        for k in range(n_cols)
+        if i != l and j != k
     ]
     return tokens, questions
 

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Rank the banked round-3 on-chip results (benchmarks/TPU_R3/*.json).
+
+Prints a words/sec table sorted best-first with vs_baseline and the lever
+deltas vs the banked default, so promoting winners to config defaults is a
+read-off. Run any time; the queue (tpu_queue3.sh) banks items as the tunnel
+allows.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main() -> None:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(HERE, "TPU_R3", "*.json"))):
+        name = os.path.basename(path)[:-5]
+        try:
+            with open(path) as f:
+                rec = json.loads(f.read().strip().splitlines()[-1])
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(rec.get("value"), (int, float)):
+            rows.append((name, rec))
+    if not rows:
+        print("no banked results yet (tunnel down?); see TPU_R3/queue.log")
+        return
+    bench = [(n, r) for n, r in rows if "words/sec" in r.get("metric", "")]
+    base = dict(bench).get("default")
+    bench.sort(key=lambda nr: -nr[1]["value"])
+    print(f"{'item':28s} {'words/sec':>12s} {'vs_base':>8s} {'vs_default':>10s}")
+    for name, r in bench:
+        delta = (
+            f"{r['value'] / base['value'] - 1:+.1%}"
+            if base and name != "default" else ""
+        )
+        vs = r.get("vs_baseline")
+        print(f"{name:28s} {r['value']:12,.0f} "
+              f"{vs if vs is not None else '':>8} {delta:>10s}")
+    others = [(n, r) for n, r in rows if (n, r) not in bench]
+    for name, r in others:
+        print(f"{name}: {json.dumps(r)[:160]}")
+
+
+if __name__ == "__main__":
+    main()

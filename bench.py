@@ -170,6 +170,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         slab_scatter=bool(args.slab_scatter),
         fused_tables=bool(args.fused) and args.train_method == "ns",
         shared_negatives=args.kp,
+        negative_scope=args.neg_scope,
         band_chunk=args.band_chunk,
         prng_impl=args.prng,
         dtype=args.table_dtype,
@@ -352,6 +353,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--kp", type=int, default=64,
                     help="shared negative draws per row (accuracy holds to "
                     "KP=8 on the parity harness; PERF.md)")
+    ap.add_argument("--neg-scope", choices=["row", "batch"], default="row",
+                    help="negative pool scope: per row, or one pool per "
+                    "batch (single dense matmul, KP-row update scatter)")
     ap.add_argument("--band-chunk", type=int, default=0,
                     help="band slab row-chunk S (0 = auto; ops/banded.py)")
     ap.add_argument("--table-dtype", choices=["float32", "bfloat16"],
@@ -463,7 +467,8 @@ def main() -> None:
         ("--window", args.window), ("--negative", args.negative),
         ("--batch-rows", args.batch_rows), ("--max-len", args.max_len),
         ("--chunk-cap", args.chunk_cap), ("--slab-scatter", args.slab_scatter),
-        ("--kp", args.kp), ("--band-chunk", args.band_chunk),
+        ("--kp", args.kp), ("--neg-scope", args.neg_scope),
+        ("--band-chunk", args.band_chunk),
         ("--resident", args.resident), ("--fused", args.fused),
         ("--prng", args.prng), ("--table-dtype", args.table_dtype),
         ("--sr", args.sr),

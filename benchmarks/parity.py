@@ -154,6 +154,8 @@ def main() -> None:
                     help="device kernel for OUR side (reference has no analog)")
     ap.add_argument("--shared-negatives", type=int, default=64,
                     help="band-kernel shared draws per row for OUR side")
+    ap.add_argument("--negative-scope", choices=["row", "batch"],
+                    default="row", help="negative pool scope for OUR side")
     ap.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                     help="band-kernel slab-space context scatter for OUR side")
     ap.add_argument("--prng", choices=["threefry", "rbg"], default="threefry",
@@ -223,6 +225,7 @@ def main() -> None:
                 "-output", "vec_ours.txt", "--backend", "cpu", "--quiet",
                 "--kernel", args.kernel,
                 "--shared-negatives", str(args.shared_negatives),
+                "--negative-scope", args.negative_scope,
                 "--slab-scatter", str(args.slab_scatter),
                 "--prng", args.prng,
                 "--table-dtype", args.table_dtype,

@@ -19,6 +19,7 @@ for args in \
   "--model sg   --train-method ns --shared-negatives 8" \
   "--model sg   --train-method ns --prng rbg" \
   "--model sg   --train-method ns --table-dtype bfloat16 --sr 1" \
+  "--model sg   --train-method ns --negative-scope batch --shared-negatives 256" \
   ; do
   echo "## parity $args"
   timeout 900 $P $args 2>/dev/null | tail -1

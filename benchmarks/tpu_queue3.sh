@@ -51,6 +51,11 @@ run_item fused_kp32_c96       900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96
 run_item fused_kp32_c96_rbg   900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96 --prng rbg
 run_item fused_kp32_c96_b512  900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96 --batch-rows 512
 
+# batch-scoped shared negatives (one dense matmul + KP-row update scatter;
+# parity-validated at kp=256: delta_spearman 0.0, delta_margin +0.031)
+run_item negbatch_kp256       900 "$TPU" $B --neg-scope batch --kp 256
+run_item negbatch_kp256_fused_c96 900 "$TPU" $B --neg-scope batch --kp 256 --fused 1 --chunk-cap 96
+
 # bf16 table storage + stochastic rounding (VERDICT item 8)
 run_item bf16sr               900 "$TPU" $B --table-dtype bfloat16 --sr 1
 run_item bf16sr_fused_kp32_c96 900 "$TPU" $B --table-dtype bfloat16 --sr 1 --fused 1 --kp 32 --chunk-cap 96

@@ -132,6 +132,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "config.stochastic_rounding)")
     p.add_argument("--shared-negatives", type=int, default=64,
                    help="shared negative draws per batch row (band kernel)")
+    p.add_argument("--negative-scope", choices=["row", "batch"], default="row",
+                   help="share the negative pool per row, or one pool for "
+                        "the whole batch (one dense matmul + KP-row update; "
+                        "raise --shared-negatives with 'batch'; "
+                        "config.negative_scope)")
     p.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                    help="band kernel: scatter context grads from slab space "
                         "(skips the overlap-add; config.slab_scatter)")
@@ -256,6 +261,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             kernel=args.kernel,
             compute_dtype=args.compute_dtype,
             shared_negatives=args.shared_negatives,
+            negative_scope=args.negative_scope,
             scatter_mean=bool(args.scatter_mean),
             slab_scatter=bool(args.slab_scatter),
             resident=args.resident,

@@ -73,8 +73,8 @@ class Word2VecConfig:
     # drops most updates and training stalls; stochastic rounding makes the
     # rounded update unbiased (E[round(v)] = v), recovering f32-like
     # trajectories in expectation (ops/train_step._cast_update). Implemented
-    # on the band ns route (the flagship bench path) — the A/B perf lever
-    # VERDICT r2 item 8; f32 tables remain the default.
+    # in all three kernels (band ns, positional hs, pair); f32 tables remain
+    # the default pending the on-chip A/B verdict.
     stochastic_rounding: bool = False
 
     # Which device kernel realizes the objective (ops/):
@@ -85,10 +85,25 @@ class Word2VecConfig:
     #            incl. per-pair negative draws (ops/train_step.py)
     #   "auto" — band (the objective's fast path)
     kernel: str = "auto"
-    # Shared negative draws per batch row for the band kernel; each center
-    # weights them by (its reference draw count) / shared_negatives, so the
-    # expected update matches per-pair sampling (see ops/band_step.py).
+    # Shared negative draws for the band kernel; each center weights them by
+    # (its reference draw count) / shared_negatives, so the expected update
+    # matches per-pair sampling (see ops/band_step.py).
     shared_negatives: int = 64
+    # Scope of the shared pool:
+    #   "row"   — shared_negatives draws PER BATCH ROW ([B, KP]): B separate
+    #             [L,d]x[d,KP] batched matmuls, B*KP update rows.
+    #   "batch" — ONE pool for the whole batch ([KP]): the negative side
+    #             becomes a single dense [B*L, d] x [d, KP] matmul (bigger
+    #             MXU tile, no batching) and the update scatter shrinks from
+    #             B*KP rows to KP. E[update] is unchanged (same weighting
+    #             against the same unigram^0.75 draw distribution); the
+    #             trade is correlation — every center shares the same pool,
+    #             and each drawn row aggregates the whole batch's negative
+    #             gradient mass (the per-row trust region bounds it, and
+    #             per-center variance DROPS when the pool is sized >= the
+    #             old per-row KP). A/B perf lever for the on-chip sweep;
+    #             raise shared_negatives (e.g. 256) when using it.
+    negative_scope: str = "row"
     # Window-blocked band chunk size S (ops/banded.py): positive-side band
     # contractions cost L*(S+2W) instead of L^2. 0 = auto (dense for short
     # rows, 128-lane slabs for long); explicit S must be >= 2*window.
@@ -208,6 +223,17 @@ class Word2VecConfig:
             raise ValueError(f"kernel must be auto|band|pair, got {self.kernel!r}")
         if self.shared_negatives < 1:
             raise ValueError("shared_negatives must be >= 1")
+        if self.negative_scope not in ("row", "batch"):
+            raise ValueError(
+                f"negative_scope must be 'row' or 'batch', "
+                f"got {self.negative_scope!r}"
+            )
+        if self.negative_scope == "batch" and (
+            self.train_method != "ns" or self.kernel == "pair"
+        ):
+            raise ValueError(
+                "negative_scope='batch' applies to the ns band kernel only"
+            )
         if self.band_chunk < 0:
             raise ValueError("band_chunk must be >= 0 (0 = auto)")
         if self.band_chunk and self.band_chunk < 2 * self.window:
@@ -236,17 +262,11 @@ class Word2VecConfig:
             raise ValueError(
                 f"resident must be auto|on|off, got {self.resident!r}"
             )
-        if self.stochastic_rounding:
-            if self.dtype != "bfloat16":
-                raise ValueError(
-                    "stochastic_rounding applies to bfloat16 table storage "
-                    "(dtype='bfloat16'); f32 tables round nothing"
-                )
-            if self.train_method != "ns" or self.kernel == "pair":
-                raise ValueError(
-                    "stochastic_rounding is implemented on the ns band "
-                    "route only (the flagship bench path)"
-                )
+        if self.stochastic_rounding and self.dtype != "bfloat16":
+            raise ValueError(
+                "stochastic_rounding applies to bfloat16 table storage "
+                "(dtype='bfloat16'); f32 tables round nothing"
+            )
         if self.prng_impl not in ("threefry", "rbg"):
             raise ValueError(
                 f"prng_impl must be 'threefry' or 'rbg', got {self.prng_impl!r}"

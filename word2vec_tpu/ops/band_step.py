@@ -17,18 +17,22 @@ This module re-expresses the same objective in the shapes the hardware wants
                gradient sides are band matmuls too, so the update touches
                only B*L aggregated rows per table instead of B*L*2W
                per-pair rows.
-  negatives  — drawn SHARED per batch row ([B, KP] ids from the alias table)
-               instead of per pair, turning the negative score/update into
-               dense [L, d] x [d, KP] matmuls with no scatter at all for the
-               score side and a KP-row scatter for the update. Each center i
-               weights every shared draw by k_i / KP, where k_i is the number
-               of draws the reference would have made for it (SG: n_ctx(i)*K
-               per Word2Vec.cpp:339-349; CBOW: K per Word2Vec.cpp:304-311),
-               so the expected update equals the reference's per-pair
-               sampling; only the variance/correlation structure differs
-               (draws are shared across the centers of a row). This is the
-               standard batched-SGNS trade (e.g. candidate sampling) and is
-               validated by the eval-parity gate, not bitwise.
+  negatives  — drawn SHARED ([B, KP] per-row ids from the alias table, or
+               with config.negative_scope="batch" one [KP] pool for the
+               whole batch) instead of per pair, turning the negative
+               score/update into dense [L, d] x [d, KP] matmuls (batch
+               scope: one [B*L, d] x [d, KP] matmul) with no scatter at all
+               for the score side and a (B*)KP-row scatter for the update.
+               Each center i weights every shared draw by k_i / KP, where
+               k_i is the number of draws the reference would have made for
+               it (SG: n_ctx(i)*K per Word2Vec.cpp:339-349; CBOW: K per
+               Word2Vec.cpp:304-311), so the expected update equals the
+               reference's per-pair sampling; only the variance/correlation
+               structure differs (draws are shared across the centers of a
+               row, or of the batch). This is the standard batched-SGNS
+               trade (e.g. candidate sampling) and is validated by the
+               eval-parity gate plus the cross-scope expectation test
+               (tests/test_negative_scope.py), not bitwise.
   scatter    — token-id scatters are pre-sorted (argsort once, reused for
                both tables) so XLA takes the sorted-indices fast path.
 
@@ -79,6 +83,7 @@ from . import banded
 from .tables import DeviceTables
 from .train_step import (
     _cast_update, _draw_negatives, _dup_mean_scale, _row_clip_scale,
+    _sr_streams,
 )
 
 Metrics = Dict[str, jnp.ndarray]
@@ -162,6 +167,7 @@ def make_band_train_step(
     W = config.window
     K = config.negative
     KP = config.shared_negatives
+    per_row = config.negative_scope == "row"
     is_cbow = config.model == "cbow"
     cbow_mean = config.cbow_mean
     scatter_mean = config.scatter_mean
@@ -189,12 +195,7 @@ def make_band_train_step(
             center_zone = (pos >= W) & (pos < W + Lloc)
         B, L = tokens.shape
         k_sub, k_win, k_neg = jax.random.split(key, 3)
-        # SR draw streams, one per update site; fold_in (not a wider split)
-        # so the sub/win/neg streams are bit-identical with SR off or on
-        k_sr = (
-            (lambda i: jax.random.fold_in(jax.random.fold_in(key, 0x5B), i))
-            if sr else (lambda i: None)
-        )
+        k_sr = _sr_streams(key, sr)
 
         valid = tokens >= 0
         tok = jnp.where(valid, tokens, 0)
@@ -229,13 +230,17 @@ def make_band_train_step(
             ein = emb_in[tok]   # [B, L, d]
             eout = emb_out[tok]  # [B, L, d]
 
-        # Shared negatives per row + collision mask vs the row's centers and
-        # active contexts (see module docstring).
+        # Shared negatives (per row, or one batch-wide pool) + collision
+        # mask vs each row's centers and active contexts (module docstring).
         negs = _draw_negatives(
-            k_neg, (B, KP), tables.alias_accept, tables.alias_idx
-        )  # [B, KP]
-        en = emb[negs, 1] if fused else emb_out[negs]  # [B, KP, d]
-        center_hit = tok[:, :, None] == negs[:, None, :]  # [B, L, KP]
+            k_neg, (B, KP) if per_row else (KP,),
+            tables.alias_accept, tables.alias_idx,
+        )  # [B, KP] | [KP]
+        en = emb[negs, 1] if fused else emb_out[negs]  # [B, KP, d] | [KP, d]
+        if per_row:
+            center_hit = tok[:, :, None] == negs[:, None, :]  # [B, L, KP]
+        else:
+            center_hit = tok[:, :, None] == negs[None, None, :]
         # context collision: neg n hits center i if any active context j of i
         # carries the same token id
         # 0/1 operands with row sums <= 2W, exactly representable in bf16, so
@@ -256,10 +261,13 @@ def make_band_train_step(
                 h = h / jnp.maximum(n_ctx, 1.0)[:, :, None]
             k_i = jnp.where(n_ctx > 0, float(K), 0.0)  # ns once per center, :304
 
-        # ---- negative side: dense matmuls against the shared draws
+        # ---- negative side: dense matmuls against the shared draws.
+        # batch scope turns the B batched [L,d]x[d,KP] contractions into one
+        # [B*L, d] x [d, KP] matmul and the update into a [KP, d] reduction.
+        en_spec = "bnd" if per_row else "nd"
         nlog = psum(
             jnp.einsum(
-                "bid,bnd->bin",
+                f"bid,{en_spec}->bin",
                 h.astype(cdt),
                 en.astype(cdt),
                 preferred_element_type=jnp.float32,
@@ -268,17 +276,17 @@ def make_band_train_step(
         w_neg = (k_i / KP)[:, :, None] * neg_ok  # [B, L, KP]
         gn = (0.0 - jax.nn.sigmoid(nlog)) * w_neg * alpha
         d_h = jnp.einsum(
-            "bin,bnd->bid",
+            f"bin,{en_spec}->bid",
             gn.astype(cdt),
             en.astype(cdt),
             preferred_element_type=jnp.float32,
         )  # [B, L, d]
         d_neg = jnp.einsum(
-            "bin,bid->bnd",
+            f"bin,bid->{en_spec}",
             gn.astype(cdt),
             h.astype(cdt),
             preferred_element_type=jnp.float32,
-        )  # [B, KP, d]
+        )  # [B, KP, d] | [KP, d]
 
         # ---- positive side
         if not is_cbow:
@@ -386,7 +394,10 @@ def make_band_train_step(
             cnt = (
                 jnp.zeros((emb_out.shape[0],), jnp.float32)
                 .at[cnt_idx].add(cnt_w)
-                .at[flat_negs].add(w_neg.sum(axis=1).reshape(-1))
+                .at[flat_negs].add(
+                    w_neg.sum(axis=1).reshape(-1) if per_row
+                    else w_neg.sum(axis=(0, 1))
+                )
             )
             inv = 1.0 / jnp.maximum(cnt, 1.0)
             d_out_flat = d_out_flat * inv[out_idx][:, None]

@@ -45,7 +45,9 @@ from ..config import Word2VecConfig
 from ..models.params import Params
 from . import banded
 from .tables import DeviceTables
-from .train_step import _dup_mean_scale, _row_clip_scale
+from .train_step import (
+    _cast_update, _dup_mean_scale, _row_clip_scale, _sr_streams,
+)
 
 Metrics = Dict[str, jnp.ndarray]
 
@@ -70,6 +72,7 @@ def make_hs_train_step(
     # more than ns: the Huffman ROOT node sits on EVERY word's path, so its
     # syn1 row accumulates the entire batch's path gradients in one scatter
     clip_tau = config.clip_row_update
+    sr = config.stochastic_rounding
     cdt = jnp.dtype(config.compute_dtype)
 
     def psum(x):
@@ -82,6 +85,7 @@ def make_hs_train_step(
         if dp_axis is not None:
             key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
         k_sub, k_win, _ = jax.random.split(key, 3)
+        k_sr = _sr_streams(key, sr)
 
         valid = tokens >= 0
         tok = jnp.where(valid, tokens, 0)
@@ -165,7 +169,12 @@ def make_hs_train_step(
                 )
                 clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
                 vals = vals * scale[flat_c][:, None]
-            new_in = emb_in.at[flat_c].add(vals.astype(emb_in.dtype))
+            new_in = emb_in.at[flat_c].add(
+                _cast_update(
+                    vals, emb_in.dtype, k_sr(0),
+                    emb_in[flat_c] if sr else None,
+                )
+            )
 
             # path rows: one aggregated scatter over the padded positions
             flat_p = paths.reshape(-1)
@@ -183,7 +192,11 @@ def make_hs_train_step(
                 clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
                 d_rows_flat = d_rows_flat * scale[flat_p[order]][:, None]
             new_out = syn1.at[flat_p[order]].add(
-                d_rows_flat.astype(syn1.dtype), indices_are_sorted=True
+                _cast_update(
+                    d_rows_flat, syn1.dtype, k_sr(1),
+                    syn1[flat_p[order]] if sr else None,
+                ),
+                indices_are_sorted=True,
             )
         else:
             # ---- CBOW: h = (mean of) context rows; targets = center's path.
@@ -261,7 +274,12 @@ def make_hs_train_step(
                     )
                     clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
                     vals = vals * scale[sflat][:, None]
-                new_in = emb_in.at[sflat].add(vals.astype(emb_in.dtype))
+                new_in = emb_in.at[sflat].add(
+                    _cast_update(
+                        vals, emb_in.dtype, k_sr(0),
+                        emb_in[sflat] if sr else None,
+                    )
+                )
             else:
                 d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
                 flat_c = tok.reshape(-1)
@@ -280,7 +298,11 @@ def make_hs_train_step(
                     clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
                     d_in_flat = d_in_flat * scale[flat_c[order]][:, None]
                 new_in = emb_in.at[flat_c[order]].add(
-                    d_in_flat.astype(emb_in.dtype), indices_are_sorted=True
+                    _cast_update(
+                        d_in_flat, emb_in.dtype, k_sr(0),
+                        emb_in[flat_c[order]] if sr else None,
+                    ),
+                    indices_are_sorted=True,
                 )
 
             flat_p = paths.reshape(-1)
@@ -298,7 +320,11 @@ def make_hs_train_step(
                 clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
                 d_rows_flat = d_rows_flat * scale[flat_p[porder]][:, None]
             new_out = syn1.at[flat_p[porder]].add(
-                d_rows_flat.astype(syn1.dtype), indices_are_sorted=True
+                _cast_update(
+                    d_rows_flat, syn1.dtype, k_sr(1),
+                    syn1[flat_p[porder]] if sr else None,
+                ),
+                indices_are_sorted=True,
             )
 
         new_params = dict(params)

@@ -134,6 +134,17 @@ def _cast_update(
     return ((qf + (u < q - qf)) * ulp).astype(jnp.bfloat16)
 
 
+def _sr_streams(key: jax.Array, sr: bool):
+    """Per-update-site SR key streams: `k_sr(i)` for site i, or None when
+    SR is off. fold_in (not a wider split) keeps every existing draw stream
+    (subsample / window / negatives) bit-identical whether SR is on or off;
+    0x5B domain-separates the SR streams from fold_in(key, step) uses."""
+    if not sr:
+        return lambda i: None
+    base = jax.random.fold_in(key, 0x5B)
+    return lambda i: jax.random.fold_in(base, i)
+
+
 def _dup_mean_scale(
     num_rows: int, flat_idx: jnp.ndarray, flat_weight: jnp.ndarray
 ) -> jnp.ndarray:
@@ -158,6 +169,7 @@ def _score_and_update(
     scatter_mean: bool,
     tp_axis: str | None = None,
     clip_tau: float = 0.0,
+    sr_key: jax.Array | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One sigmoid-SGD objective: returns (grad_h, new_out, loss_sum,
     pair_count, clip_count) — clip_count = rows of `out` whose summed update
@@ -204,7 +216,12 @@ def _score_and_update(
         )
         clip_count = jnp.sum((scale < 1.0).astype(jnp.float32))
         vals = vals * scale[flat_t][:, None]
-    new_out = out.at[flat_t].add(vals.astype(out.dtype))
+    new_out = out.at[flat_t].add(
+        _cast_update(
+            vals, out.dtype, sr_key,
+            out[flat_t] if sr_key is not None else None,
+        )
+    )
     # masked binary cross-entropy, for metrics only:
     # -[y log s(x) + (1-y) log s(-x)], with log s(-x) = log s(x) - x
     ls = jax.nn.log_sigmoid(logits)
@@ -328,6 +345,7 @@ def make_pair_train_step(
     cbow_mean = config.cbow_mean
     scatter_mean = config.scatter_mean
     clip_tau = config.clip_row_update
+    sr = config.stochastic_rounding
     cdt = jnp.dtype(config.compute_dtype)
     # Static offset vector o in {-W..-1, 1..W} — the unrolled j-loop of
     # Word2Vec.cpp:339 (j != i excluded by construction).
@@ -341,6 +359,7 @@ def make_pair_train_step(
         if dp_axis is not None:
             key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
         k_sub, k_win, k_neg = jax.random.split(key, 3)
+        k_sr = _sr_streams(key, sr)
 
         valid = tokens >= 0
         tok = jnp.where(valid, tokens, 0)
@@ -396,7 +415,7 @@ def make_pair_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc, cc = _score_and_update(
                     h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean, tp_axis, clip_tau,
+                    scatter_mean, tp_axis, clip_tau, k_sr(1),
                 )
                 grad_h += gh
                 new_params["emb_out_ns"] = new_out
@@ -414,7 +433,7 @@ def make_pair_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc, cc = _score_and_update(
                     h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean, tp_axis, clip_tau,
+                    scatter_mean, tp_axis, clip_tau, k_sr(2),
                 )
                 grad_h += gh
                 new_params["emb_out_hs"] = new_out
@@ -447,7 +466,10 @@ def make_pair_train_step(
                 clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
                 vals = vals * scale[flat_c][:, None]
             new_params["emb_in"] = params["emb_in"].at[flat_c].add(
-                vals.astype(params["emb_in"].dtype)
+                _cast_update(
+                    vals, params["emb_in"].dtype, k_sr(0),
+                    params["emb_in"][flat_c] if sr else None,
+                )
             )
         else:
             # ---- CBOW: projection = (mean of) context rows of emb_in (C),
@@ -482,7 +504,7 @@ def make_pair_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc, cc = _score_and_update(
                     h, params["emb_out_ns"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean, tp_axis, clip_tau,
+                    scatter_mean, tp_axis, clip_tau, k_sr(1),
                 )
                 grad_h += gh
                 new_params["emb_out_ns"] = new_out
@@ -500,7 +522,7 @@ def make_pair_train_step(
                 ).astype(jnp.float32)
                 gh, new_out, ls, pc, cc = _score_and_update(
                     h, params["emb_out_hs"], targets, labels, tmask, alpha, cdt,
-                    scatter_mean, tp_axis, clip_tau,
+                    scatter_mean, tp_axis, clip_tau, k_sr(2),
                 )
                 grad_h += gh
                 new_params["emb_out_hs"] = new_out
@@ -529,7 +551,10 @@ def make_pair_train_step(
                 clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
                 g_ctx = g_ctx * scale[flat_ctx][:, None]
             new_params["emb_in"] = params["emb_in"].at[flat_ctx].add(
-                g_ctx.astype(params["emb_in"].dtype)
+                _cast_update(
+                    g_ctx, params["emb_in"].dtype, k_sr(0),
+                    params["emb_in"][flat_ctx] if sr else None,
+                )
             )
 
         metrics = {

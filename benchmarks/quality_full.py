@@ -29,7 +29,7 @@ REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 sys.path.insert(0, HERE)
 
-from parity import eval_vectors  # noqa: E402
+from parity import eval_analogy_vectors, eval_vectors  # noqa: E402
 
 
 def main() -> None:
@@ -48,22 +48,43 @@ def main() -> None:
                     help="forwarded to the CLI (default: device auto)")
     ap.add_argument("--shared-negatives", type=int, default=0,
                     help="band-kernel KP override (0 = config default)")
+    ap.add_argument("--analogy", action="store_true",
+                    help="analogy mode: train on the compositional-grid "
+                    "corpus (utils/synthetic.analogy_corpus) and score "
+                    "3CosAdd accuracy at full dim — the at-scale form of "
+                    "the parity harness's analogy gate")
     ap.add_argument("--run-timeout", type=float, default=1800.0,
                     help="watchdog for the training child (a tunnel hang "
                     "post-probe would otherwise wedge with no output, the "
                     "BENCH_r01 failure mode)")
     args = ap.parse_args()
 
-    from word2vec_tpu.utils.synthetic import topic_corpus, topic_similarity_pairs
-
-    tokens, topic_of = topic_corpus(
-        n_topics=args.n_topics,
-        words_per_topic=args.words_per_topic,
-        shared_words=args.n_topics * 5,
-        n_tokens=args.tokens,
-        seed=args.seed,
+    from word2vec_tpu.utils.synthetic import (
+        analogy_corpus, topic_corpus, topic_similarity_pairs,
     )
-    pairs = topic_similarity_pairs(topic_of, seed=args.seed + 1)
+
+    if args.analogy:
+        # larger grid than the parity budget: more cells and pool words so
+        # full-dim training has a non-trivial instrument
+        tokens, questions = analogy_corpus(
+            n_rows=16, n_cols=4, words_per_pool=40,
+            n_tokens=args.tokens, seed=args.seed,
+        )
+        corpus_desc = (
+            f"analogy-grid-{args.tokens} tokens (16x4 cells)"
+        )
+    else:
+        tokens, topic_of = topic_corpus(
+            n_topics=args.n_topics,
+            words_per_topic=args.words_per_topic,
+            shared_words=args.n_topics * 5,
+            n_tokens=args.tokens,
+            seed=args.seed,
+        )
+        pairs = topic_similarity_pairs(topic_of, seed=args.seed + 1)
+        corpus_desc = (
+            f"topic-synthetic-{args.tokens} tokens ({args.n_topics} topics)"
+        )
     if args.train_method == "hs":
         args.negative = 0
 
@@ -108,7 +129,14 @@ def main() -> None:
                 "stderr_tail": run.stderr.strip().splitlines()[-6:],
             }))
             return
-        scores = eval_vectors(os.path.join(tmp, "vec.txt"), pairs, topic_of)
+        if args.analogy:
+            scores = eval_analogy_vectors(
+                os.path.join(tmp, "vec.txt"), questions
+            )
+        else:
+            scores = eval_vectors(
+                os.path.join(tmp, "vec.txt"), pairs, topic_of
+            )
 
         # trust-region engagement across the run (ADVICE r2: at-scale runs
         # must report when/how often clip_row_update actually fires)
@@ -144,8 +172,7 @@ def main() -> None:
         "config": f"{args.model}+{args.train_method} k={args.negative} "
         f"dim={args.dim} w={args.window} iter={args.iters} "
         f"(shipped path: {kernel} kernel, resident, chunked, auto geometry)",
-        "corpus": f"topic-synthetic-{args.tokens} tokens "
-        f"({args.n_topics} topics)",
+        "corpus": corpus_desc,
         "train_wall_s": round(wall, 1),
         **scores,
     }))

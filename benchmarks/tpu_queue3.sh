@@ -71,6 +71,8 @@ run_item quality_hs_dim300 2400 "$TPU" \
   python benchmarks/quality_full.py --tokens 4000000 --train-method hs --dim 300
 run_item quality_sg_dim300 2400 "$TPU" \
   python benchmarks/quality_full.py --tokens 4000000
+run_item quality_analogy_dim300 2400 "$TPU" \
+  python benchmarks/quality_full.py --analogy --tokens 4000000
 
 # --- phase 4: enwik9-shape scale rehearsal (VERDICT item 7) ------------------
 run_item enwik9_100M 3600 "$TPU" $B --tokens 100000000 --window 10 --run-timeout 3000

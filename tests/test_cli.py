@@ -145,3 +145,28 @@ def test_eval_flags(tmp_path, corpus_file, capsys):
     out = capsys.readouterr().out
     assert "WS-353 spearman:" in out
     assert "analogy accuracy:" in out
+
+
+def test_prng_impl_persisted_and_pinned_on_resume(tmp_path, corpus_file, capsys):
+    """--prng is part of the config, hence of the checkpoint: a resume under
+    a different flag keeps the checkpoint's impl and says so (silently
+    switching the draw streams mid-run is the hazard; ADVICE r2)."""
+    import json
+
+    ck = str(tmp_path / "ck")
+    common = [
+        "-train", corpus_file, "-size", "8", "-negative", "2", "-min-count", "1",
+        "--backend", "cpu", "--batch-rows", "4", "--max-sentence-len", "32",
+        "--quiet",
+    ]
+    rc = run(common + ["-output", str(tmp_path / "v.txt"), "-iter", "1",
+                       "--prng", "rbg", "--checkpoint-dir", ck])
+    assert rc == 0
+    with open(os.path.join(ck, "config.json")) as f:
+        assert json.load(f)["prng_impl"] == "rbg"
+    # resume with the default flag (threefry): checkpoint wins, warning shown
+    rc = run(common + ["-output", str(tmp_path / "v2.txt"), "-iter", "2",
+                       "--resume", ck])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "prng_impl='rbg'" in err and "ignoring --prng threefry" in err

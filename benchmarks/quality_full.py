@@ -48,6 +48,12 @@ def main() -> None:
                     help="forwarded to the CLI (default: device auto)")
     ap.add_argument("--shared-negatives", type=int, default=0,
                     help="band-kernel KP override (0 = config default)")
+    ap.add_argument("--negative-scope", choices=["row", "batch"],
+                    default="row", help="negative pool scope (CLI passthrough)")
+    ap.add_argument("--table-dtype", choices=["float32", "bfloat16"],
+                    default="float32", help="table storage dtype (passthrough)")
+    ap.add_argument("--sr", type=int, default=0, choices=[0, 1],
+                    help="stochastic rounding (bf16 tables; passthrough)")
     ap.add_argument("--analogy", action="store_true",
                     help="analogy mode: train on the compositional-grid "
                     "corpus (utils/synthetic.analogy_corpus) and score "
@@ -107,6 +113,11 @@ def main() -> None:
             cmd += ["--backend", args.backend]
         if args.shared_negatives:
             cmd += ["--shared-negatives", str(args.shared_negatives)]
+        if args.negative_scope != "row":
+            cmd += ["--negative-scope", args.negative_scope]
+        if args.table_dtype != "float32":
+            cmd += ["--table-dtype", args.table_dtype,
+                    "--stochastic-rounding", str(args.sr)]
         env = {
             **os.environ,
             "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -166,6 +177,12 @@ def main() -> None:
 
     # what the CLI's auto-selection actually routes this config through
     kernel = "band" if args.train_method == "ns" else "hs-positional"
+    if args.negative_scope != "row":
+        kernel += f", neg-scope={args.negative_scope}"
+        if args.shared_negatives:
+            kernel += f" kp={args.shared_negatives}"
+    if args.table_dtype != "float32":
+        kernel += f", {args.table_dtype} tables" + (" +sr" if args.sr else "")
     print(json.dumps({
         "platform": platform,
         "device_kind": device_kind,

@@ -40,6 +40,9 @@ TPU='"platform": "tpu"'
 
 # --- phase 1: the lever sweep (VERDICT item 1) -------------------------------
 run_item default      900 "$TPU" $B
+# the best-guess stack right after the headline default, in case the live
+# window is short: these two items alone give the 50x shot + its baseline
+run_item fused_kp32_c96       900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96
 run_item fused        900 "$TPU" $B --fused 1
 run_item kp32         900 "$TPU" $B --kp 32
 run_item chunk96      900 "$TPU" $B --chunk-cap 96
@@ -47,7 +50,6 @@ run_item b512         900 "$TPU" $B --batch-rows 512
 run_item rbg          900 "$TPU" $B --prng rbg
 # combos (each lever is independent machinery; measure the stack)
 run_item fused_kp32           900 "$TPU" $B --fused 1 --kp 32
-run_item fused_kp32_c96       900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96
 run_item fused_kp32_c96_rbg   900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96 --prng rbg
 run_item fused_kp32_c96_b512  900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96 --batch-rows 512
 

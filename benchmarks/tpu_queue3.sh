@@ -40,9 +40,10 @@ TPU='"platform": "tpu"'
 
 # --- phase 1: the lever sweep (VERDICT item 1) -------------------------------
 run_item default      900 "$TPU" $B
-# the best-guess stack right after the headline default, in case the live
-# window is short: these two items alone give the 50x shot + its baseline
+# the best-guess stacks right after the headline default, in case the live
+# window is short: these items alone give the 50x shots + their baseline
 run_item fused_kp32_c96       900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96
+run_item full_stack           900 "$TPU" $B --fused 1 --chunk-cap 96 --neg-scope batch --kp 256 --table-dtype bfloat16 --sr 1
 run_item fused        900 "$TPU" $B --fused 1
 run_item kp32         900 "$TPU" $B --kp 32
 run_item chunk96      900 "$TPU" $B --chunk-cap 96

@@ -47,14 +47,14 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def cli_cmd(train: str, vocab: str, out: str, dp: int, extra=()) -> list:
+def cli_cmd(train: str, vocab: str, out: str, dp: int, tp: int = 1, extra=()) -> list:
     return [
         sys.executable, "-m", "word2vec_tpu.cli",
         "-train", train, "-read-vocab", vocab, "-output", out,
         "-model", "sg", "-train_method", "ns", "-negative", "5",
         "-size", "64", "-window", "5", "-iter", "3",
         "-min-count", "5", "-subsample", "1e-4",
-        "--backend", "cpu", "--dp", str(dp), "--quiet",
+        "--backend", "cpu", "--dp", str(dp), "--tp", str(tp), "--quiet",
         *extra,
     ]
 
@@ -66,17 +66,22 @@ def main() -> None:
     ap.add_argument("--tokens", type=int, default=200_000)
     ap.add_argument("--timeout", type=float, default=900.0)
     ap.add_argument("--sync-mode", choices=["mean", "delta"], default="mean")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel width WITHIN each process's "
+                    "devices (the data axis is the only one that spans "
+                    "processes; parallel/multihost.py topology policy)")
     args = ap.parse_args()
 
     from word2vec_tpu.utils.synthetic import topic_corpus, topic_similarity_pairs
 
     tokens, topic_of = topic_corpus(n_tokens=args.tokens, seed=0)
     pairs = topic_similarity_pairs(topic_of, seed=1)
-    dp = args.procs * args.devices_per_proc  # pure-dp global mesh
+    dp = args.procs * args.devices_per_proc // args.tp
 
     result = {
-        "config": f"sg+ns dim=64 dp={dp} over {args.procs} processes x "
-        f"{args.devices_per_proc} virtual cpu devices, sync={args.sync_mode}",
+        "config": f"sg+ns dim=64 dp={dp} tp={args.tp} over {args.procs} "
+        f"processes x {args.devices_per_proc} virtual cpu devices, "
+        f"sync={args.sync_mode}",
         "corpus": f"topic-synthetic-{args.tokens} tokens, "
         f"{args.procs} round-robin shards",
     }
@@ -127,7 +132,7 @@ def main() -> None:
             log = open(os.path.join(tmp, f"rank{r}.log"), "w+")
             logs.append(log)
             procs.append(subprocess.Popen(
-                cli_cmd(f"shard{r}", "vocab.txt", "vec_mp.txt", dp,
+                cli_cmd(f"shard{r}", "vocab.txt", "vec_mp.txt", dp, args.tp,
                         ("--multihost", "--sync-mode", args.sync_mode)),
                 cwd=tmp, env=env,
                 stdout=log, stderr=subprocess.STDOUT, text=True,
@@ -162,11 +167,11 @@ def main() -> None:
             **env_base,
             "XLA_FLAGS": (
                 os.environ.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={dp}"
+                + f" --xla_force_host_platform_device_count={dp * args.tp}"
             ).strip(),
         }
         sp = subprocess.run(
-            cli_cmd("full", "vocab.txt", "vec_sp.txt", dp),
+            cli_cmd("full", "vocab.txt", "vec_sp.txt", dp, args.tp),
             cwd=tmp, env=env, capture_output=True, text=True,
             timeout=args.timeout,
         )

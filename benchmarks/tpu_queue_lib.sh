@@ -1,0 +1,94 @@
+# Shared machinery for the per-round TPU measurement queues.
+# Source from a round script after setting OUT (banking dir), e.g.:
+#   OUT=benchmarks/TPU_R4
+#   . "$(dirname "$0")/tpu_queue_lib.sh"
+# Provides: probe, wait_for_chip, run_item, run_trace, and a flock
+# single-instance guard so a second queue launch exits instead of racing the
+# first on the one TPU chip (two concurrent benches would contend for the
+# chip and could bank contention-degraded numbers as official evidence).
+
+mkdir -p "$OUT"
+LOG=$OUT/queue.log
+
+# Single-instance guard, keyed on the CHIP (benchmarks/.tpu.lock), not the
+# round dir: two different rounds' queues would contend for the same one TPU
+# just as hard as two copies of the same round. Held on fd 9 for the queue's
+# lifetime; children are spawned with 9>&- so a hung orphaned bench cannot
+# keep the lock after the queue itself is killed.
+exec 9>"benchmarks/.tpu.lock"
+if ! flock -n 9; then
+  echo "$(date -u +%FT%TZ) second instance pid=$$ refused (chip lock held)" >> "$LOG"
+  exit 0
+fi
+
+echo "$(date -u +%FT%TZ) queue started pid=$$" >> "$LOG"
+
+# -k 10: the axon tunnel's failure mode is a HANG in an uninterruptible read;
+# without a kill-after, `timeout`'s SIGTERM is ignored and the queue (and its
+# heartbeat) wedges behind the child forever.
+probe() { timeout -k 10 75 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1 9>&-; }
+
+# Heartbeat cadence: a failed-probe iteration costs up to 85 s (probe
+# timeout+kill on a hung tunnel) + 110 s sleep ~= 195 s, so
+# HEARTBEAT_EVERY=20 logs one line per ~65 min of dead tunnel (worst case;
+# ~40 min if probes fail fast).
+HEARTBEAT_EVERY=${HEARTBEAT_EVERY:-20}
+FAILED_PROBES=0
+wait_for_chip() {
+  local waited=0
+  until probe; do
+    FAILED_PROBES=$((FAILED_PROBES + 1)); waited=$((waited + 1))
+    if [ $((FAILED_PROBES % HEARTBEAT_EVERY)) -eq 0 ]; then
+      echo "$(date -u +%FT%TZ) heartbeat: $FAILED_PROBES probes failed so far, tunnel still down" >> "$LOG"
+    fi
+    sleep 110 9>&-
+  done
+  [ "$waited" -gt 0 ] && echo "$(date -u +%FT%TZ) chip live after $waited failed probes" >> "$LOG"
+}
+
+# run_item <name> <timeout_s> <success_marker> <cmd...>
+# Banks the last stdout line to $OUT/<name>.json iff it contains the marker
+# AND parses as JSON (a timeout mid-write must not bank a truncated line that
+# then blocks the item from ever retrying); otherwise saves it as .failed so
+# a later restart retries the item.
+run_item() {
+  local name=$1 tmo=$2 marker=$3; shift 3
+  [ -s "$OUT/$name.json" ] && return 0
+  wait_for_chip
+  echo "$(date -u +%FT%TZ) start $name: $*" >> "$LOG"
+  # the 9>&- covers the whole pipeline group: tail must not inherit the lock
+  # fd either, or a wedged bench holding the pipe keeps tail (and the flock)
+  # alive after the queue itself is killed
+  { timeout -k 10 "$tmo" "$@" 2>>"$OUT/$name.stderr" | tail -1 > "$OUT/$name.tmp"; } 9>&-
+  if grep -q "$marker" "$OUT/$name.tmp" 2>/dev/null \
+     && python -c "import json,sys; json.loads(sys.stdin.read())" < "$OUT/$name.tmp" 2>/dev/null; then
+    mv "$OUT/$name.tmp" "$OUT/$name.json"
+    rm -f "$OUT/$name.stderr" "$OUT/$name.failed"
+    echo "$(date -u +%FT%TZ) banked $name: $(cat "$OUT/$name.json")" >> "$LOG"
+  else
+    mv "$OUT/$name.tmp" "$OUT/$name.failed" 2>/dev/null
+    echo "$(date -u +%FT%TZ) FAILED $name" >> "$LOG"
+  fi
+}
+
+# run_trace <tmpdir>
+# Captures a profiler trace and banks the parsed report to
+# $OUT/trace_report.txt iff it contains a device plane ("XLA Ops total"), so
+# a failed capture is retried on the next restart instead of banking a
+# traceback.
+run_trace() {
+  local tmpdir=$1
+  [ -s "$OUT/trace_report.txt" ] && return 0
+  wait_for_chip
+  echo "$(date -u +%FT%TZ) start trace" >> "$LOG"
+  timeout -k 10 900 python benchmarks/trace_tools.py capture --out "$tmpdir" \
+    >> "$OUT/trace_capture.out" 2>&1 9>&-
+  timeout -k 10 300 python benchmarks/trace_tools.py report "$tmpdir" \
+    > "$OUT/trace_report.tmp" 2>&1 9>&-
+  if grep -q "XLA Ops total" "$OUT/trace_report.tmp"; then
+    mv "$OUT/trace_report.tmp" "$OUT/trace_report.txt"
+    echo "$(date -u +%FT%TZ) banked trace_report" >> "$LOG"
+  else
+    echo "$(date -u +%FT%TZ) FAILED trace" >> "$LOG"
+  fi
+}

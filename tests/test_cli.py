@@ -170,3 +170,74 @@ def test_prng_impl_persisted_and_pinned_on_resume(tmp_path, corpus_file, capsys)
     assert rc == 0
     err = capsys.readouterr().err
     assert "prng_impl='rbg'" in err and "ignoring --prng threefry" in err
+
+
+def test_resume_warns_on_ignored_lever_flags(tmp_path, corpus_file, capsys):
+    """A lever flag passed at resume time is overridden by the checkpoint
+    config and must be called out even under --quiet — a silently-ignored
+    --table-dtype/--sr/--negative-scope is how an A/B run measures the wrong
+    configuration (ADVICE r3)."""
+    ck = str(tmp_path / "ck")
+    common = [
+        "-train", corpus_file, "-size", "8", "-negative", "2", "-min-count", "1",
+        "--backend", "cpu", "--batch-rows", "4", "--max-sentence-len", "32",
+        "--quiet",
+    ]
+    rc = run(common + ["-output", str(tmp_path / "v.txt"), "-iter", "1",
+                       "--checkpoint-dir", ck])
+    assert rc == 0
+    capsys.readouterr()
+    rc = run(common + ["-output", str(tmp_path / "v2.txt"), "-iter", "2",
+                       "--resume", ck, "--table-dtype", "bfloat16",
+                       "--stochastic-rounding", "1", "--negative-scope", "batch"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "ignoring differing flags" in err
+    for field in ("dtype", "stochastic_rounding", "negative_scope"):
+        assert field in err, (field, err)
+    # batch_rows was passed explicitly and identically: not reported
+    assert "batch_rows" not in err
+
+    # negative control: a resume passing no differing flags must not cry
+    # wolf — fields at their parser defaults were never "ignored", even
+    # where the checkpoint config differs from those defaults (the
+    # checkpoint's batch geometry legitimately differs from parser
+    # defaults on every resume)
+    rc = run(common + ["-output", str(tmp_path / "v3.txt"), "-iter", "1",
+                       "--resume", ck])
+    assert rc == 0
+    assert "ignoring differing flags" not in capsys.readouterr().err
+
+    # a flag explicitly passed AT its parser default is still overridden
+    # when the checkpoint pins the non-default value — and must be reported
+    # (the A/B-arm silent-misconfiguration case)
+    ck2 = str(tmp_path / "ck2")
+    rc = run(common + ["-output", str(tmp_path / "v4.txt"), "-iter", "1",
+                       "--table-dtype", "bfloat16", "--checkpoint-dir", ck2])
+    assert rc == 0
+    capsys.readouterr()
+    rc = run(common + ["-output", str(tmp_path / "v5.txt"), "-iter", "1",
+                       "--resume", ck2, "--table-dtype", "float32"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "ignoring differing flags" in err and "dtype" in err
+
+
+def test_resume_reports_typed_micro_steps(tmp_path, corpus_file, capsys):
+    """--micro-steps typed at resume (without --batch-rows) is honored on
+    fresh runs but pinned by the checkpoint on resume — it must be reported,
+    not suppressed with the geometry placeholders."""
+    ck = str(tmp_path / "ck")
+    common = [
+        "-train", corpus_file, "-size", "8", "-negative", "2", "-min-count", "1",
+        "--backend", "cpu", "--max-sentence-len", "32", "--quiet",
+    ]
+    rc = run(common + ["-output", str(tmp_path / "v.txt"), "-iter", "1",
+                       "--checkpoint-dir", ck])
+    assert rc == 0
+    capsys.readouterr()
+    rc = run(common + ["-output", str(tmp_path / "v2.txt"), "-iter", "1",
+                       "--resume", ck, "--micro-steps", "8"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "ignoring differing flags" in err and "micro_steps" in err

@@ -123,3 +123,41 @@ def test_neighbors_and_analogy_query():
     assert res[0][0] == "queen"
     with pytest.raises(KeyError):
         nearest_neighbors(W, vocab, "zzz")
+
+
+def test_analogy_degenerate_gold_skipped(tmp_path):
+    """Questions whose gold repeats a question word are unanswerable (the
+    exclusion mask -infs the gold) and must be skipped, not scored at ~V."""
+    words = ["man", "woman", "king", "queen"]
+    vocab = Vocab.from_counter({w: 10 - i for i, w in enumerate(words)}, min_count=1)
+    W = np.array(
+        [[1, 0, 0], [0, 1, 0], [1, 0, 1], [0, 1, 1]], dtype=np.float32
+    )
+    f = tmp_path / "q.txt"
+    f.write_text(": s\nman woman king queen\nman woman king man\n")
+    r = evaluate_analogies(W, vocab, str(f))
+    assert r.total == 1 and r.correct == 1
+    assert r.skipped_degenerate == 1 and r.skipped_oov == 0
+    assert r.mean_gold_rank == 1.0
+
+
+def test_analogy_rank_averages_ties(tmp_path):
+    """Tied candidate similarities take the average of tied ranks: with the
+    gold tied against one other candidate for best, rank = (1+2)/2, not 1."""
+    words = ["a", "b", "c", "gold", "tie"]
+    vocab = Vocab.from_counter({w: 10 - i for i, w in enumerate(words)}, min_count=1)
+    W = np.array(
+        [
+            [1.0, 0.0, 0.0],  # a
+            [0.0, 1.0, 0.0],  # b
+            [1.0, 0.0, 1.0],  # c
+            [0.0, 1.0, 1.0],  # gold = b - a + c
+            [0.0, 1.0, 1.0],  # tie: identical to gold
+        ],
+        dtype=np.float32,
+    )
+    f = tmp_path / "q.txt"
+    f.write_text(": s\na b c gold\n")
+    r = evaluate_analogies(W, vocab, str(f))
+    assert r.total == 1
+    assert r.mean_gold_rank == pytest.approx(1.5)

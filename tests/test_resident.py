@@ -199,3 +199,79 @@ def test_sharded_resident_matches_streaming(mesh_shape):
         np.testing.assert_array_equal(
             np.asarray(p_on[k]), np.asarray(p_off[k]), err_msg=k
         )
+
+
+def test_budget_reads_local_device(monkeypatch):
+    """The budget must come from a LOCAL device: on multi-process runs the
+    global jax.devices()[0] is non-addressable on ranks != 0 (memory_stats
+    raises), which would silently split ranks between live stats and the
+    fallback constant (ADVICE r3)."""
+    import jax
+
+    calls = {}
+
+    class FakeDev:
+        def memory_stats(self):
+            calls["local"] = True
+            return {"bytes_limit": 1000, "bytes_in_use": 200}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
+    monkeypatch.setattr(
+        jax, "devices",
+        lambda *a: (_ for _ in ()).throw(AssertionError("global devices used")),
+    )
+    assert res.resident_budget_bytes() == 400  # (1000-200)//2
+    assert calls.get("local")
+
+
+def test_budget_agreed_across_processes(monkeypatch):
+    """Multi-process runs must gate corpus_fits on one agreed number, or
+    ranks compile mismatched resident/streaming programs whose collectives
+    deadlock (ADVICE r3 medium)."""
+    import jax
+
+    from word2vec_tpu.parallel import multihost
+
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_limit": 10_000, "bytes_in_use": 0}
+
+    seen = {}
+
+    def fake_agree(v):
+        seen["value"] = v
+        return 123  # pretend another rank reported less
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [FakeDev()])
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(multihost, "global_agree_min", fake_agree)
+    assert res.resident_budget_bytes() == 123
+    assert seen["value"] == 5_000
+
+
+def test_resident_resolution_reported(monkeypatch):
+    """The auto gate depends on free HBM at call time, so the resolved path
+    and budget must be attributable: event log record + TrainReport.resident
+    (ADVICE r3)."""
+    vocab, sents = _toy_corpus()
+    corpus = PackedCorpus.pack(sents, 16)
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=2, word_dim=8, window=2,
+        min_count=1, iters=1, batch_rows=4, max_sentence_len=16,
+        chunk_steps=4, resident="auto",
+    )
+    logs = []
+    _, report = Trainer(cfg, vocab, corpus, log_fn=logs.append).train(log_every=0)
+    events = [m for m in logs if m.get("event") == "resident_path"]
+    assert len(events) == 1
+    assert events[0]["resolved"] in ("resident", "streaming")
+    assert events[0]["budget_bytes"] > 0
+    assert report.resident == events[0]
+
+    # and the streaming side of the gate reports too
+    monkeypatch.setattr(res, "RESIDENT_MAX_BYTES", 16)
+    logs2 = []
+    _, report2 = Trainer(cfg, vocab, corpus, log_fn=logs2.append).train(log_every=0)
+    ev2 = [m for m in logs2 if m.get("event") == "resident_path"]
+    assert ev2 and ev2[0]["resolved"] == "streaming"
+    assert report2.resident["resolved"] == "streaming"

@@ -190,3 +190,36 @@ def test_sharded_mid_epoch_resume_matches(tmp_path):
         np.testing.assert_allclose(
             full[k], resumed[k], rtol=0, atol=1e-5, err_msg=k
         )
+
+
+def test_bf16_checkpoint_roundtrip_bit_exact(tmp_path):
+    """bfloat16 tables survive save/load bit-for-bit. numpy's npz cannot
+    represent the ml_dtypes bfloat16 (it silently stores "|V2" void that
+    jnp.asarray rejects on load), so the checkpoint stores the uint16 bit
+    pattern plus a dtype manifest."""
+    import jax.numpy as jnp
+
+    from word2vec_tpu.train import TrainState
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=2, word_dim=8, window=2,
+        min_count=1, iters=1, batch_rows=4, max_sentence_len=16,
+        dtype="bfloat16",
+    )
+    rng = np.random.default_rng(0)
+    params = {
+        "emb_in": jnp.asarray(rng.normal(size=(7, 8)), jnp.bfloat16),
+        "emb_out_ns": jnp.asarray(rng.normal(size=(7, 8)), jnp.bfloat16),
+    }
+    state = TrainState(params=params, step=3, words_done=42, epoch=1)
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, state, cfg)
+    loaded, ck_cfg, _ = load_checkpoint(ck)
+    assert ck_cfg.dtype == "bfloat16"
+    assert loaded.step == 3 and loaded.words_done == 42 and loaded.epoch == 1
+    for k, v in params.items():
+        lv = loaded.params[k]
+        assert lv.dtype == jnp.bfloat16, (k, lv.dtype)
+        np.testing.assert_array_equal(
+            np.asarray(lv).view(np.uint16), np.asarray(v).view(np.uint16)
+        )

@@ -236,40 +236,46 @@ def main(argv: Optional[List[str]] = None) -> int:
     if alpha is None:
         # word2vec.c-style default: 0.05 for cbow(+mean), 0.025 for sg
         alpha = 0.05 if (args.model == "cbow" and args.cbow_mean) else 0.025
+    # One kwargs dict serves both the fresh-run constructor and the resume
+    # flag-diff notice below, so the notice can never silently fall out of
+    # sync with the set of flags the constructor honors (ADVICE r3: levers
+    # like --table-dtype/--sr/--negative-scope were invisible to the old
+    # subset comparison).
+    flag_kwargs = dict(
+        iters=args.iter,
+        window=args.window,
+        min_count=args.min_count,
+        word_dim=args.size,
+        negative=args.negative,
+        subsample_threshold=args.subsample,
+        init_alpha=alpha,
+        cbow_mean=bool(args.cbow_mean),
+        train_method=args.train_method,
+        model=args.model,
+        batch_rows=args.batch_rows or 32,  # placeholder; auto-sized below
+        # with auto batch sizing the real (rows, micro) pair is set
+        # below; constructing with micro here would trip the
+        # divisibility check against the placeholder
+        micro_steps=max(1, args.micro_steps) if args.batch_rows else 1,
+        chunk_steps=args.chunk_steps,
+        max_sentence_len=args.max_sentence_len,
+        seed=args.seed,
+        dp_sync_every=args.dp_sync_every,
+        sync_mode=args.sync_mode,
+        kernel=args.kernel,
+        compute_dtype=args.compute_dtype,
+        shared_negatives=args.shared_negatives,
+        negative_scope=args.negative_scope,
+        scatter_mean=bool(args.scatter_mean),
+        slab_scatter=bool(args.slab_scatter),
+        resident=args.resident,
+        clip_row_update=args.clip_row_update,
+        prng_impl=args.prng,
+        dtype=args.table_dtype,
+        stochastic_rounding=bool(args.stochastic_rounding),
+    )
     try:
-        cfg = ck_cfg if ck_cfg is not None else Word2VecConfig(
-            iters=args.iter,
-            window=args.window,
-            min_count=args.min_count,
-            word_dim=args.size,
-            negative=args.negative,
-            subsample_threshold=args.subsample,
-            init_alpha=alpha,
-            cbow_mean=bool(args.cbow_mean),
-            train_method=args.train_method,
-            model=args.model,
-            batch_rows=args.batch_rows or 32,  # placeholder; auto-sized below
-            # with auto batch sizing the real (rows, micro) pair is set
-            # below; constructing with micro here would trip the
-            # divisibility check against the placeholder
-            micro_steps=max(1, args.micro_steps) if args.batch_rows else 1,
-            chunk_steps=args.chunk_steps,
-            max_sentence_len=args.max_sentence_len,
-            seed=args.seed,
-            dp_sync_every=args.dp_sync_every,
-            sync_mode=args.sync_mode,
-            kernel=args.kernel,
-            compute_dtype=args.compute_dtype,
-            shared_negatives=args.shared_negatives,
-            negative_scope=args.negative_scope,
-            scatter_mean=bool(args.scatter_mean),
-            slab_scatter=bool(args.slab_scatter),
-            resident=args.resident,
-            clip_row_update=args.clip_row_update,
-            prng_impl=args.prng,
-            dtype=args.table_dtype,
-            stochastic_rounding=bool(args.stochastic_rounding),
-        )
+        cfg = ck_cfg if ck_cfg is not None else Word2VecConfig(**flag_kwargs)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
@@ -297,26 +303,68 @@ def main(argv: Optional[List[str]] = None) -> int:
     # same -output on a shared filesystem would interleave writes.
     is_primary = jax.process_index() == 0
 
-    if ck_cfg is not None and not args.quiet:
-        # best-effort notice about flags the checkpoint config overrides
-        # (the flag combo itself may not even be constructible — fine)
+    if ck_cfg is not None:
+        # Notice about flags the checkpoint config overrides. Unconditional
+        # (even under --quiet), like the prng warning above: a lever flag
+        # passed at resume time being silently ignored is exactly how an A/B
+        # run ends up measuring the wrong configuration. Built from the SAME
+        # kwargs as the fresh-run constructor so every honored flag is
+        # compared (the combo itself may not be constructible — fine).
         try:
-            flag_cfg = Word2VecConfig(
-                iters=args.iter, window=args.window, min_count=args.min_count,
-                word_dim=args.size, negative=args.negative,
-                subsample_threshold=args.subsample, init_alpha=alpha,
-                cbow_mean=bool(args.cbow_mean), train_method=args.train_method,
-                model=args.model,
-            )
+            flag_cfg = Word2VecConfig(**flag_kwargs)
         except ValueError:
             flag_cfg = None
         if flag_cfg is not None:
             import dataclasses as _dc
 
+            # Only flags the user actually typed can be "ignored": the
+            # checkpoint legitimately differs from parser defaults all the
+            # time, and reporting untyped fields would bury real mismatches
+            # in false alarms. Presence is detected by scanning argv for the
+            # parser's own option strings (covers every alias and the
+            # --flag=value form, and catches a flag explicitly passed AT its
+            # default — which IS overridden when the checkpoint differs).
+            argv_tokens = list(sys.argv[1:] if argv is None else argv)
+            opts_by_dest = {
+                a.dest: a.option_strings for a in parser._actions
+            }
+            # config fields whose argparse dest is spelled differently; any
+            # field not listed here uses its own name as the dest, so a new
+            # lever added with matching names is covered automatically
+            dest_overrides = {
+                "iters": "iter", "word_dim": "size",
+                "subsample_threshold": "subsample", "init_alpha": "alpha",
+                "dtype": "table_dtype",
+            }
+
+            def user_set(field: str) -> bool:
+                opts = opts_by_dest.get(dest_overrides.get(field, field))
+                if opts is None:
+                    # unknown field->flag mapping: fail OPEN — a spurious
+                    # notice beats silently re-opening the ADVICE-r3 hole
+                    return True
+                return any(
+                    t == o or t.startswith(o + "=")
+                    for t in argv_tokens
+                    for o in opts
+                )
+
+            def flag_value(field: str):
+                # without --batch-rows, flag_kwargs carries geometry
+                # PLACEHOLDERS (32, 1); a typed --micro-steps must still be
+                # compared by the value the user typed, or its silent
+                # override on resume goes unreported (batch_rows untyped is
+                # already filtered by user_set)
+                if field == "micro_steps" and not args.batch_rows:
+                    return max(1, args.micro_steps)
+                return getattr(flag_cfg, field)
+
             diffs = sorted(
                 f.name
                 for f in _dc.fields(flag_cfg)
-                if getattr(flag_cfg, f.name) != getattr(ck_cfg, f.name)
+                if f.name != "prng_impl"  # warned separately above
+                and user_set(f.name)
+                and flag_value(f.name) != getattr(ck_cfg, f.name)
             )
             if diffs:
                 print(

@@ -28,8 +28,16 @@ class AnalogyResult:
     by_section: Dict[str, Tuple[int, int]] = field(default_factory=dict)
     # Mean rank of the gold answer among candidates (1 = top). Accuracy
     # saturates once every gold ranks first; the rank stays continuous, so
-    # parity harnesses keep sensitivity after both sides hit 100%.
+    # parity harnesses keep sensitivity after both sides hit 100%. Tied
+    # similarities take the average of their tied ranks
+    # (count(>) + (count(==)+1)/2), so quantized embeddings (bf16 tables)
+    # don't rank optimistically.
     mean_gold_rank: float = 0.0
+    # Questions whose gold answer repeats a question word (d in {a,b,c}):
+    # the exclusion mask makes them unanswerable by construction, so they
+    # are skipped rather than scored at rank ~V. Generated grids never
+    # produce these; malformed file-based question sets can.
+    skipped_degenerate: int = 0
 
 
 def load_questions(path: str) -> List[Tuple[str, List[Tuple[str, str, str, str]]]]:
@@ -87,16 +95,19 @@ def evaluate_analogy_sections(
     V = min(len(vocab), restrict_vocab) if restrict_vocab else len(vocab)
     Wn = W[:V] / np.maximum(np.linalg.norm(W[:V], axis=1, keepdims=True), 1e-12)
 
-    correct = total = skipped = 0
+    correct = total = skipped = degenerate = 0
     rank_sum = 0.0
     by_section: Dict[str, Tuple[int, int]] = {}
     for name, questions in sections:
         ids = []
         for a, b, c, d in questions:
-            if all(w in vocab and vocab[w] < V for w in (a, b, c, d)):
-                ids.append((vocab[a], vocab[b], vocab[c], vocab[d]))
-            else:
+            if not all(w in vocab and vocab[w] < V for w in (a, b, c, d)):
                 skipped += 1
+            elif d in (a, b, c):
+                # gold is excluded from candidates below — unanswerable
+                degenerate += 1
+            else:
+                ids.append((vocab[a], vocab[b], vocab[c], vocab[d]))
         sec_correct = 0
         for i in range(0, len(ids), batch_size):
             chunk = np.asarray(ids[i : i + batch_size])
@@ -112,7 +123,12 @@ def evaluate_analogy_sections(
             sims[rows, c] = -np.inf
             pred = sims.argmax(axis=1)
             sec_correct += int((pred == d).sum())
-            rank_sum += float((sims > sims[rows, d][:, None]).sum(axis=1).sum()) + len(chunk)
+            gold = sims[rows, d][:, None]
+            # average-of-tied-ranks: count(==) includes gold itself, so the
+            # tie-free case reduces to the familiar count(>) + 1
+            rank_sum += float(
+                ((sims > gold).sum(axis=1) + ((sims == gold).sum(axis=1) + 1) / 2.0).sum()
+            )
         by_section[name] = (sec_correct, len(ids))
         correct += sec_correct
         total += len(ids)
@@ -123,4 +139,5 @@ def evaluate_analogy_sections(
         skipped_oov=skipped,
         by_section=by_section,
         mean_gold_rank=rank_sum / total if total else 0.0,
+        skipped_degenerate=degenerate,
     )

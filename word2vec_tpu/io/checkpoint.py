@@ -37,12 +37,25 @@ def save_checkpoint(path: str, state: TrainState, config: Word2VecConfig,
     os.makedirs(parent, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=parent, prefix=".ckpt_tmp_")
     try:
-        arrays = {k: np.asarray(v) for k, v in state.params.items()}
+        # bfloat16 tables (config.dtype="bfloat16") are an ml_dtypes dtype
+        # numpy's npz format cannot represent: savez silently stores them as
+        # raw 2-byte void ("|V2") and the LOAD then hands jnp.asarray an
+        # invalid dtype. Store such arrays as their uint16 bit pattern plus
+        # a dtype manifest, and view them back on load.
+        arrays = {}
+        nonnative = {}
+        for k, v in state.params.items():
+            a = np.asarray(v)
+            if a.dtype == np.dtype(jnp.bfloat16):
+                nonnative[k] = "bfloat16"
+                a = a.view(np.uint16)
+            arrays[k] = a
         np.savez(
             os.path.join(tmp, "state.npz"),
             __step=np.int64(state.step),
             __words_done=np.int64(state.words_done),
             __epoch=np.int64(state.epoch),
+            __dtypes=np.str_(json.dumps(nonnative)),
             **arrays,
         )
         with open(os.path.join(tmp, "config.json"), "w") as f:
@@ -70,8 +83,18 @@ def load_checkpoint(path: str) -> Tuple[TrainState, Word2VecConfig, Optional[Voc
         if os.path.exists(os.path.join(backup, "state.npz")):
             path = backup  # crash landed between move-aside and replace
     with np.load(os.path.join(path, "state.npz")) as z:
+        nonnative = (
+            json.loads(str(z["__dtypes"])) if "__dtypes" in z.files else {}
+        )
+
+        def restore(k: str) -> jnp.ndarray:
+            a = z[k]
+            if nonnative.get(k) == "bfloat16":
+                a = a.view(np.dtype(jnp.bfloat16))
+            return jnp.asarray(a)
+
         params = {
-            k: jnp.asarray(z[k]) for k in z.files if not k.startswith("__")
+            k: restore(k) for k in z.files if not k.startswith("__")
         }
         state = TrainState(
             params=params,

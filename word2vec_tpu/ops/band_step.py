@@ -438,10 +438,14 @@ def make_band_train_step(
                 ),
                 indices_are_sorted=True,
             )
+            # SR dest rows come from NEW_emb: the positive scatter above may
+            # have moved a shared row across a binade, and quantizing on the
+            # stale pre-step grid would let the bf16 add re-round (or
+            # swallow) the delta
             new_emb = new_emb.at[flat_negs, 1].add(
                 _cast_update(
                     d_neg_flat, emb.dtype, k_sr(1),
-                    emb[flat_negs, 1] if sr else None,
+                    new_emb[flat_negs, 1] if sr else None,
                 )
             )
             new_params[FUSED_KEY] = new_emb
@@ -460,11 +464,12 @@ def make_band_train_step(
                 ),
                 indices_are_sorted=out_sorted,
             )
-            # negative-row scatter (KP rows per batch row; duplicates sum)
+            # negative-row scatter (KP rows per batch row; duplicates sum);
+            # SR dest rows from NEW_out — see the fused branch's note
             new_out = new_out.at[flat_negs].add(
                 _cast_update(
                     d_neg_flat, emb_out.dtype, k_sr(2),
-                    emb_out[flat_negs] if sr else None,
+                    new_out[flat_negs] if sr else None,
                 )
             )
             new_params["emb_in"] = new_in

@@ -50,16 +50,36 @@ def resident_budget_bytes() -> int:
     double-buffers living on the chip — the corpus is replicated per device
     on sharded meshes, so per-device free memory is the right denominator)
     with a 2x headroom for step workspace, capped at RESIDENT_MAX_BYTES.
-    Falls back to the constant where the backend reports nothing (CPU)."""
+    Falls back to the constant where the backend reports nothing (CPU).
+
+    Reads the first LOCAL device: on multi-process runs the global
+    jax.devices()[0] is non-addressable on ranks != 0 and memory_stats
+    raises there, which would silently put rank 0 on live stats and every
+    other rank on the fallback constant. Because the resident-vs-streaming
+    choice gates which program gets compiled, every process must gate on
+    the SAME number — live per-host free-memory differences would otherwise
+    compile mismatched programs whose collectives deadlock — so
+    multi-process callers agree on the min budget across processes,
+    mirroring the steps-per-epoch agreement (parallel/trainer.py). Note the
+    shipped multi-host trainer currently STREAMS unconditionally
+    (parallel/trainer.py _build_resident returns None when procs > 1, so
+    its budget call never happens with procs > 1); the agreement branch
+    makes this function safe for any direct caller and for future
+    multi-host resident wiring, which must keep it."""
+    budget = RESIDENT_MAX_BYTES
     try:
-        stats = jax.devices()[0].memory_stats() or {}
+        stats = jax.local_devices()[0].memory_stats() or {}
         limit = stats.get("bytes_limit")
         if limit:
             free = int(limit) - int(stats.get("bytes_in_use", 0))
-            return max(0, min(RESIDENT_MAX_BYTES, free // 2))
+            budget = max(0, min(RESIDENT_MAX_BYTES, free // 2))
     except Exception:
         pass
-    return RESIDENT_MAX_BYTES
+    if jax.process_count() > 1:
+        from ..parallel.multihost import global_agree_min
+
+        budget = global_agree_min(budget)
+    return budget
 
 
 DeviceCorpus = Dict[str, jnp.ndarray]  # {"flat": [N], "starts": [R], "lens": [R]} i32

@@ -112,8 +112,16 @@ def _cast_update(
     rounding until a binade crossing, a second-order effect): tiny updates
     land as occasional whole-ulp steps instead of silently vanishing.
     `dest` must hold the bf16 rows being updated, gathered at the same
-    indices the scatter uses. Without `dest` no SR is possible — callers
-    pass it whenever config.stochastic_rounding is on.
+    indices the scatter uses — and gathered from the LATEST table state: a
+    caller issuing two scatters onto the same table must gather the second
+    scatter's dest rows from the first scatter's output (band_step does),
+    or a row moved across a binade by scatter one leaves scatter two's
+    delta on a stale ulp grid. Duplicate indices WITHIN one scatter still
+    share a single pre-scatter dest row; like the binade crossing, that is
+    a second-order effect (the duplicates' grid is right at the start of
+    the add chain and only drifts if earlier duplicates cross a binade).
+    Without `dest` no SR is possible — callers pass it whenever
+    config.stochastic_rounding is on.
 
     The |dest| floor of 1e-7 keeps the grid math inside f32's normal/
     precision range (an unclamped ulp of a ZERO-initialized emb_out row

@@ -51,6 +51,10 @@ class TrainReport:
     wall_time: float
     final_loss: float
     loss_history: List[float] = field(default_factory=list)
+    #: how the resident-corpus gate resolved for this run (None on the
+    #: per-step path, which never consults it) — mode/resolved/budget_bytes/
+    #: corpus_bytes, for attributing A/B throughput differences
+    resident: Optional[Dict] = None
 
 
 class Trainer:
@@ -66,6 +70,9 @@ class Trainer:
     _last_chunk_loss: float = float("nan")
     #: active resident-corpus state, set per train() run (_setup_resident)
     _resident = None
+    #: how the resident gate resolved (set by _build_resident; surfaced on
+    #: TrainReport.resident and as an "event" log record)
+    resident_resolution: Optional[Dict] = None
 
     def __init__(
         self,
@@ -280,6 +287,7 @@ class Trainer:
             wall_time=wall,
             final_loss=final_loss,
             loss_history=loss_hist,
+            resident=self.resident_resolution,
         )
         return state, report
 
@@ -382,6 +390,7 @@ class Trainer:
             wall_time=wall,
             final_loss=self._last_chunk_loss,
             loss_history=loss_hist,
+            resident=self.resident_resolution,
         )
 
     def _build_chunk_fn(self):
@@ -418,12 +427,32 @@ class Trainer:
                     stacklevel=2,
                 )
             return None
-        if not res.corpus_fits(self.corpus):
+        # In auto mode the gate depends on free HBM at call time, so the
+        # resident-vs-streaming choice can differ between otherwise identical
+        # runs (fresh run vs resume with different warm-up allocations).
+        # Record the resolution + computed budget so A/B throughput records
+        # can attribute the difference (TrainReport.resident and an "event"
+        # log record).
+        budget = res.resident_budget_bytes()
+        fits = res.corpus_fits(self.corpus, max_bytes=budget)
+        self.resident_resolution = {
+            "event": "resident_path",
+            "mode": cfg.resident,
+            "resolved": "resident" if fits else "streaming",
+            "budget_bytes": int(budget),
+            "corpus_bytes": int(self.corpus.flat.nbytes),
+        }
+        if self.log_fn:
+            self.log_fn(dict(self.resident_resolution))
+        if not fits:
             if cfg.resident == "on":
+                # the live budget (memory_stats-derived) is what failed, not
+                # the RESIDENT_MAX_BYTES ceiling — name the number
                 raise ValueError(
                     f"config.resident='on' but the packed corpus "
                     f"({self.corpus.flat.nbytes >> 20} MiB) exceeds the HBM "
-                    f"budget (ops/resident.RESIDENT_MAX_BYTES)"
+                    f"budget ({budget >> 20} MiB free-memory-derived, "
+                    f"capped at ops/resident.RESIDENT_MAX_BYTES)"
                 )
             return None
         return self._make_resident_runtime()

@@ -19,10 +19,16 @@ def progress_logger(stream: IO = sys.stderr) -> Callable[[Dict], None]:
     """Reference-style one-line progress (Word2Vec.cpp:384) + words/sec."""
 
     def log(m: Dict) -> None:
-        stream.write(
-            f"\ralpha: {m['alpha']:.6f}  progress: {100 * m.get('progress', 0):6.2f}%  "
-            f"loss: {m['loss']:.4f}  {m['words_per_sec']:,.0f} words/sec "
-        )
+        if "event" in m:
+            # one-off event records (e.g. the resident-path resolution) get
+            # their own line instead of crashing the \r progress format
+            detail = " ".join(f"{k}={v}" for k, v in m.items() if k != "event")
+            stream.write(f"\n[{m['event']}] {detail}\n")
+        else:
+            stream.write(
+                f"\ralpha: {m['alpha']:.6f}  progress: {100 * m.get('progress', 0):6.2f}%  "
+                f"loss: {m['loss']:.4f}  {m['words_per_sec']:,.0f} words/sec "
+            )
         stream.flush()
 
     return log

@@ -47,12 +47,13 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def cli_cmd(train: str, vocab: str, out: str, dp: int, tp: int = 1, extra=()) -> list:
+def cli_cmd(train: str, vocab: str, out: str, dp: int, tp: int = 1,
+            iters: int = 3, extra=()) -> list:
     return [
         sys.executable, "-m", "word2vec_tpu.cli",
         "-train", train, "-read-vocab", vocab, "-output", out,
         "-model", "sg", "-train_method", "ns", "-negative", "5",
-        "-size", "64", "-window", "5", "-iter", "3",
+        "-size", "64", "-window", "5", "-iter", str(iters),
         "-min-count", "5", "-subsample", "1e-4",
         "--backend", "cpu", "--dp", str(dp), "--tp", str(tp), "--quiet",
         *extra,
@@ -64,6 +65,10 @@ def main() -> None:
     ap.add_argument("--procs", type=int, default=2)
     ap.add_argument("--devices-per-proc", type=int, default=4)
     ap.add_argument("--tokens", type=int, default=200_000)
+    ap.add_argument("--iters", type=int, default=3,
+                    help="epochs; at dp=8 the per-replica sequential-update "
+                    "budget is 1/8 of the token stream, so the margin gate "
+                    "needs tokens*iters sized for the dp width")
     ap.add_argument("--timeout", type=float, default=900.0)
     ap.add_argument("--sync-mode", choices=["mean", "delta"], default="mean")
     ap.add_argument("--tp", type=int, default=1,
@@ -79,9 +84,9 @@ def main() -> None:
     dp = args.procs * args.devices_per_proc // args.tp
 
     result = {
-        "config": f"sg+ns dim=64 dp={dp} tp={args.tp} over {args.procs} "
-        f"processes x {args.devices_per_proc} virtual cpu devices, "
-        f"sync={args.sync_mode}",
+        "config": f"sg+ns dim=64 iters={args.iters} dp={dp} tp={args.tp} "
+        f"over {args.procs} processes x {args.devices_per_proc} virtual "
+        f"cpu devices, sync={args.sync_mode}",
         "corpus": f"topic-synthetic-{args.tokens} tokens, "
         f"{args.procs} round-robin shards",
     }
@@ -133,6 +138,7 @@ def main() -> None:
             logs.append(log)
             procs.append(subprocess.Popen(
                 cli_cmd(f"shard{r}", "vocab.txt", "vec_mp.txt", dp, args.tp,
+                        args.iters,
                         ("--multihost", "--sync-mode", args.sync_mode)),
                 cwd=tmp, env=env,
                 stdout=log, stderr=subprocess.STDOUT, text=True,
@@ -171,7 +177,7 @@ def main() -> None:
             ).strip(),
         }
         sp = subprocess.run(
-            cli_cmd("full", "vocab.txt", "vec_sp.txt", dp, args.tp),
+            cli_cmd("full", "vocab.txt", "vec_sp.txt", dp, args.tp, args.iters),
             cwd=tmp, env=env, capture_output=True, text=True,
             timeout=args.timeout,
         )

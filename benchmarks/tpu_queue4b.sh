@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-4 follow-up measurement queue — runs AFTER tpu_queue4.sh (the
+# chip flock in tpu_queue_lib.sh serializes them: launched while queue4
+# holds the lock this script just exits; benchmarks/tpu_supervisor4.sh
+# keeps re-launching it until its COMPLETE line lands in queue.log).
+#
+# Items here are the levers invented or re-designed mid-round plus the
+# combo escalations that depend on the queue4 singles:
+#   - slab_sorted: slab-space context scatter v2 — r2 measured the
+#     UNSORTED slab scatter losing (2.26M vs 3.64M w/s); v2 argsorts the
+#     slab ids so the scatter keeps XLA's sorted fast path while still
+#     skipping the overlap-add layout-copy chain (band_step.py).
+#   - b1024/c192: batch-rows and chunk-cap escalation beyond the queue4
+#     sweep points.
+#   - combo items: stack the individually-promising levers.
+#   - full_stack retry LAST with a longer cap: its first attempt wedged
+#     >900s (compile) and the kill coincided with a tunnel outage.
+#
+# Usage: nohup bash benchmarks/tpu_queue4b.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/TPU_R4
+. benchmarks/tpu_queue_lib.sh
+
+B='python bench.py --probe-retries 1'
+TPU='"platform": "tpu"'
+
+# --- new/re-designed levers --------------------------------------------------
+run_item slab_sorted          900 "$TPU" $B --slab-scatter 1
+run_item b1024                900 "$TPU" $B --batch-rows 1024
+run_item c192                 900 "$TPU" $B --chunk-cap 192
+
+# --- combos over queue4 singles ---------------------------------------------
+run_item b512_c96             900 "$TPU" $B --batch-rows 512 --chunk-cap 96
+run_item slab_b512            900 "$TPU" $B --slab-scatter 1 --batch-rows 512
+run_item negbatch_b512        900 "$TPU" $B --neg-scope batch --kp 256 --batch-rows 512
+run_item bf16sr_negbatch      900 "$TPU" $B --table-dtype bfloat16 --sr 1 --neg-scope batch --kp 256
+run_item slab_rbg_b512        900 "$TPU" $B --slab-scatter 1 --prng rbg --batch-rows 512
+
+# --- deferred retry: wedged once at 900s, tunnel died around the kill --------
+run_item full_stack          1800 "$TPU" $B --fused 1 --chunk-cap 96 --neg-scope batch --kp 256 --table-dtype bfloat16 --sr 1
+
+echo "$(date -u +%FT%TZ) QUEUE4B COMPLETE after $FAILED_PROBES failed probes total" >> "$LOG"

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-4 queue supervisor: make sure the measurement queues run to
+# completion no matter how the tunnel or their processes behave.
+#
+#   1. While tpu_queue4.sh hasn't logged its COMPLETE line, relaunch it
+#      whenever no instance is running (the flock guard makes a redundant
+#      launch a no-op, so the only cost of a race is one refused-launch
+#      log line).
+#   2. Then do the same for tpu_queue4b.sh.
+#
+# The queues themselves are restart-safe (banked items skip, failed items
+# retry), so the supervisor's only job is existence, not ordering.
+#
+# Usage: nohup bash benchmarks/tpu_supervisor4.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+LOG=benchmarks/TPU_R4/queue.log
+
+while ! grep -qs "QUEUE COMPLETE" "$LOG"; do
+  pgrep -f "bash benchmarks/tpu_queue4.sh" >/dev/null \
+    || nohup bash benchmarks/tpu_queue4.sh >/dev/null 2>&1 &
+  sleep 600
+done
+while ! grep -qs "QUEUE4B COMPLETE" "$LOG"; do
+  pgrep -f "bash benchmarks/tpu_queue4b.sh" >/dev/null \
+    || nohup bash benchmarks/tpu_queue4b.sh >/dev/null 2>&1 &
+  sleep 600
+done
+echo "$(date -u +%FT%TZ) supervisor: all round-4 queues complete" >> "$LOG"

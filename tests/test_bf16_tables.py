@@ -107,6 +107,7 @@ BASE = dict(
 )
 
 
+@pytest.mark.slow  # two full training soaks to convergence
 def test_bf16_tables_with_sr_recover_structure():
     f32 = Word2VecConfig(**BASE)
     bf16 = dataclasses.replace(f32, dtype="bfloat16", stochastic_rounding=True)
@@ -124,6 +125,7 @@ def test_sr_requires_bf16():
         Word2VecConfig(**BASE, stochastic_rounding=True)
 
 
+@pytest.mark.slow  # training soak per route
 @pytest.mark.parametrize("model,method,kernel", [
     ("sg", "hs", "auto"), ("cbow", "hs", "auto"), ("sg", "ns", "pair"),
 ])

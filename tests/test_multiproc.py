@@ -14,7 +14,11 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow  # spawns 2 real jax.distributed processes
 
 
 def test_two_process_training_matches_single_process():
@@ -22,10 +26,13 @@ def test_two_process_training_matches_single_process():
         [
             sys.executable,
             os.path.join(REPO, "benchmarks", "multiproc.py"),
-            # dp=8 splits a small corpus 8 ways between syncs: 120k tokens
-            # leaves each replica undertrained (purity 0.63); 200k converges
-            # (purity 1.0, benchmarks/MULTIPROC_TRAIN_r3.json)
-            "--tokens", "200000",
+            # dp=8 splits the stream 8 ways, so the per-replica
+            # sequential-update budget drives convergence: 200k/3 iters
+            # leaves cos_margin at 0.004 (both sides undertrained —
+            # VERDICT r3 weak item 3); 400k/5 iters reaches 0.585/0.586
+            # (calibrated 2026-07-31, benchmarks/MULTIPROC_TRAIN_r4.json)
+            # so the margin gate below is meaningful, not vacuous.
+            "--tokens", "400000", "--iters", "5",
         ],
         capture_output=True, text=True, timeout=540,
         # the harness must control its own device/platform env; strip the
@@ -39,3 +46,9 @@ def test_two_process_training_matches_single_process():
     assert result["multiproc"]["neighbor_purity@10"] > 0.9, result
     assert abs(result["delta_spearman"]) < 0.05, result
     assert abs(result["delta_neighbor_purity@10"]) < 0.05, result
+    # both sides demonstrably learn (solid continuous margin), and the
+    # multi-process trajectory tracks single-process within noise
+    # (calibrated above; 0.05 is ~35x the observed |delta|)
+    assert result["multiproc"]["cos_margin"] > 0.3, result
+    assert result["singleproc"]["cos_margin"] > 0.3, result
+    assert abs(result["delta_cos_margin"]) < 0.05, result

@@ -28,9 +28,13 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-pytestmark = pytest.mark.skipif(
-    shutil.which("g++") is None, reason="g++ required to build the reference"
-)
+pytestmark = [
+    pytest.mark.slow,  # builds + trains the C++ reference per cell (~60-90s)
+    pytest.mark.skipif(
+        shutil.which("g++") is None,
+        reason="g++ required to build the reference",
+    ),
+]
 
 
 def run_parity(*extra):
@@ -85,6 +89,29 @@ def test_eval_score_parity_with_reference(model, method, extra):
     # artifact, not a kernel gap), so the absolute floor is the gate and
     # full-budget deltas are tracked in benchmarks/PARITY_MATRIX_r2.txt.
     assert result["ours"]["cos_margin"] > 0.3, result
+
+
+def test_full_budget_margin_delta_vs_reference():
+    """Regression gate PAST the spearman tie ceiling (VERDICT r3 item 8).
+
+    Every matrix config saturates spearman at the 0.866 tie ceiling, so a
+    kernel regression could hide behind the absolute floors above. This
+    gates the continuous instrument instead: at the full parity budget
+    (200k tokens / dim 64 / 5 iters — the PARITY_MATRIX config) the
+    cos_margin DELTA vs the reference must sit inside calibrated
+    run-to-run noise. Ours is deterministic (config seed); the reference
+    seeds from random_device (Word2Vec.cpp:16), so delta spread across
+    identical invocations IS the reference's own noise: 5 calibration
+    runs on 2026-07-31 gave delta_margin in [-0.0040, +0.0044] (ours
+    constant at 0.6757, reference sigma ~0.003;
+    benchmarks/PARITY_CALIB_r4.jsonl). Gate = ±0.02, ~6.7 sigma — safe
+    against reference noise, tight enough to catch the -0.23 class of
+    kernel drift the reduced CI budget shows when a route is genuinely
+    off."""
+    result = run_parity("--tokens", "200000", "--dim", "64", "--iters", "5")
+    assert result["reference"]["spearman"] > 0.8, result
+    assert result["ours"]["spearman"] > 0.8, result
+    assert abs(result["delta_margin"]) < 0.02, result
 
 
 def test_analogy_parity_with_reference():

@@ -340,9 +340,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             def user_set(field: str) -> bool:
                 opts = opts_by_dest.get(dest_overrides.get(field, field))
                 if opts is None:
-                    # unknown field->flag mapping: fail OPEN — a spurious
-                    # notice beats silently re-opening the ADVICE-r3 hole
-                    return True
+                    # No parser action for this field. If the constructor
+                    # kwargs don't carry it either, there is no CLI flag at
+                    # all (min_alpha, band_chunk, ...) — it can never be
+                    # user-typed, and a checkpoint written via the Python
+                    # API with a non-default value would otherwise trigger
+                    # a false notice naming a flag that does not exist.
+                    # A field that IS constructor-fed but has no resolvable
+                    # dest (spelling drift) still fails OPEN — a spurious
+                    # notice beats silently re-opening the ADVICE-r3 hole.
+                    return field in flag_kwargs
                 return any(
                     t == o or t.startswith(o + "=")
                     for t in argv_tokens

@@ -342,9 +342,14 @@ def make_band_train_step(
             out_weight = active
 
         # ---- scatters: one shared sort of the row token ids; with
-        # use_slab the context-side table instead takes an unsorted scatter
-        # of slab-space values over slab token ids (whose duplicate-index
-        # summing is the overlap-add, banded.slab_token_ids)
+        # use_slab the context-side table instead takes its own sorted
+        # scatter of slab-space values over slab token ids (whose
+        # duplicate-index summing is the overlap-add,
+        # banded.slab_token_ids). Round 2 measured the UNSORTED slab
+        # scatter losing more than the skipped overlap-add layout copies
+        # saved (2.26M vs 3.64M w/s end-to-end, PERF.md); v2 here pays a
+        # second argsort (~1.33x the token count) to keep XLA's
+        # sorted-indices scatter fast path on the slab side too.
         flat = tok.reshape(-1)
         order = jnp.argsort(flat)
         sorted_idx = flat[order]
@@ -354,10 +359,14 @@ def make_band_train_step(
             slab_ids = banded.slab_token_ids(tok, W, S)  # [B, C, S+2W]
             slab_ok = slab_ids >= 0
             slab_flat = jnp.where(slab_ok, slab_ids, 0).reshape(-1)
+            slab_order = jnp.argsort(slab_flat)
+            slab_sorted = slab_flat[slab_order]
             d_ctx_flat = jnp.where(slab_ok[..., None], d_ctx_slab, 0.0).reshape(
                 -1, d_ctx_slab.shape[-1]
-            )
-            ctx_w_flat = jnp.where(slab_ok, ctx_w_slab, 0.0).reshape(-1)
+            )[slab_order]
+            ctx_w_flat = jnp.where(slab_ok, ctx_w_slab, 0.0).reshape(-1)[
+                slab_order
+            ]
 
         # emb_in side: dense center rows (sg) or context rows (cbow, slab-able)
         if d_in_pos is not None:
@@ -370,11 +379,11 @@ def make_band_train_step(
                     in_weight.reshape(-1)[order],
                 )[:, None]
         else:  # cbow + slab: context grads scatter from slab space
-            in_idx, in_sorted = slab_flat, False
+            in_idx, in_sorted = slab_sorted, True
             d_in_flat = d_ctx_flat
             if scatter_mean:
                 d_in_flat = d_in_flat * _dup_mean_scale(
-                    emb_in.shape[0], slab_flat, ctx_w_flat
+                    emb_in.shape[0], slab_sorted, ctx_w_flat
                 )[:, None]
 
         # emb_out side: context rows (sg, slab-able) or center rows (cbow),
@@ -387,9 +396,9 @@ def make_band_train_step(
             d_out_flat = d_out_pos.reshape(-1, d_out_pos.shape[-1])[order]
             cnt_idx, cnt_w = flat, out_weight.reshape(-1)
         else:  # sg + slab
-            out_idx, out_sorted = slab_flat, False
+            out_idx, out_sorted = slab_sorted, True
             d_out_flat = d_ctx_flat
-            cnt_idx, cnt_w = slab_flat, ctx_w_flat
+            cnt_idx, cnt_w = slab_sorted, ctx_w_flat
         if scatter_mean:
             cnt = (
                 jnp.zeros((emb_out.shape[0],), jnp.float32)

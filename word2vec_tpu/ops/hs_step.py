@@ -252,18 +252,22 @@ def make_hs_train_step(
                 d_h = d_h / jnp.maximum(n_ctx, 1.0)[:, :, None]
             if config.slab_scatter and S > 0:
                 # slab-space scatter: the table scatter's duplicate-index
-                # summing performs the overlap-add (band_step.py, same knob)
+                # summing performs the overlap-add (band_step.py, same knob).
+                # v2: the slab ids get their own argsort so this scatter
+                # keeps XLA's sorted fast path too (band_step.py rationale).
                 d_in_slab = banded.band_vs_slab(band_f, d_h, W, S, cdt)
                 slab_ids = banded.slab_token_ids(tok, W, S)
                 ok = slab_ids >= 0
-                sflat = jnp.where(ok, slab_ids, 0).reshape(-1)
+                slab_flat = jnp.where(ok, slab_ids, 0).reshape(-1)
+                sorder = jnp.argsort(slab_flat)
+                sflat = slab_flat[sorder]
                 vals = jnp.where(ok[..., None], d_in_slab, 0.0).reshape(
                     -1, d_in_slab.shape[-1]
-                )
+                )[sorder]
                 if scatter_mean:
                     w = jnp.where(
                         ok, banded.band_col_sum_slab(band_f), 0.0
-                    ).reshape(-1)
+                    ).reshape(-1)[sorder]
                     vals = vals * _dup_mean_scale(
                         emb_in.shape[0], sflat, w
                     )[:, None]
@@ -278,7 +282,8 @@ def make_hs_train_step(
                     _cast_update(
                         vals, emb_in.dtype, k_sr(0),
                         emb_in[sflat] if sr else None,
-                    )
+                    ),
+                    indices_are_sorted=True,
                 )
             else:
                 d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)

@@ -440,7 +440,12 @@ class Trainer:
             "mode": cfg.resident,
             "resolved": "resident" if fits else "streaming",
             "budget_bytes": int(budget),
-            "corpus_bytes": int(self.corpus.flat.nbytes),
+            # the gated total (tokens + the [R] starts/lens arrays), so the
+            # record can never show corpus_bytes <= budget_bytes yet
+            # resolved='streaming' (ops/resident.corpus_fits)
+            "corpus_bytes": int(
+                self.corpus.flat.nbytes + 8 * self.corpus.num_rows
+            ),
         }
         if self.log_fn:
             self.log_fn(dict(self.resident_resolution))

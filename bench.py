@@ -172,6 +172,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         shared_negatives=args.kp,
         negative_scope=args.neg_scope,
         band_chunk=args.band_chunk,
+        band_backend=args.band_backend,
         prng_impl=args.prng,
         dtype=args.table_dtype,
         stochastic_rounding=bool(args.sr),
@@ -358,6 +359,10 @@ def build_parser() -> argparse.ArgumentParser:
                     "batch (single dense matmul, KP-row update scatter)")
     ap.add_argument("--band-chunk", type=int, default=0,
                     help="band slab row-chunk S (0 = auto; ops/banded.py)")
+    ap.add_argument("--band-backend", choices=["xla", "pallas"],
+                    default="xla",
+                    help="band step compute: XLA chain or the fused Pallas "
+                    "kernel (ops/pallas_band.py; sg+ns fp32 unfused only)")
     ap.add_argument("--table-dtype", choices=["float32", "bfloat16"],
                     default="float32",
                     help="storage dtype of the [V, d] tables (A/B lever: "

@@ -25,9 +25,16 @@ B='python bench.py --probe-retries 1'
 TPU='"platform": "tpu"'
 
 # --- new/re-designed levers --------------------------------------------------
+#   - pallas: the fused VMEM-resident band kernel (ops/pallas_band.py) —
+#     replaces the whole matmul/copy/elementwise middle of the step, the
+#     segment the round-2 trace put at ~4.7 of 7.97 ms.
+run_item pallas               900 "$TPU" $B --band-backend pallas
 run_item slab_sorted          900 "$TPU" $B --slab-scatter 1
 run_item b1024                900 "$TPU" $B --batch-rows 1024
 run_item c192                 900 "$TPU" $B --chunk-cap 192
+run_item pallas_c96           900 "$TPU" $B --band-backend pallas --chunk-cap 96
+run_item pallas_b512          900 "$TPU" $B --band-backend pallas --batch-rows 512
+run_item pallas_b512_c96      900 "$TPU" $B --band-backend pallas --batch-rows 512 --chunk-cap 96
 
 # --- combos over queue4 singles ---------------------------------------------
 run_item b512_c96             900 "$TPU" $B --batch-rows 512 --chunk-cap 96

@@ -1,0 +1,115 @@
+"""config.band_backend='pallas' (ops/pallas_band.py): the fused
+VMEM-resident band kernel must produce the same step as the XLA band chain.
+
+Both backends consume the identical PRNG streams (same split order for
+subsample/window/negative draws), so the comparison is a direct parameter
+diff after one step — only reassociation noise is tolerated (the kernel
+sums the band plane in a different order and, on the scatter side, routes
+context gradients through slab space exactly like config.slab_scatter).
+Runs through the Pallas interpreter on the CPU test backend; the same code
+compiles to Mosaic on TPU.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.negative import build_alias_table
+from word2vec_tpu.models.params import init_params
+from word2vec_tpu.ops.band_step import make_band_train_step
+from word2vec_tpu.ops.tables import DeviceTables
+
+V, D = 60, 16
+
+
+def _tables(cfg):
+    counts = np.arange(2 * V, V, -1).astype(np.float64)
+    at = build_alias_table(counts**0.75 / np.sum(counts**0.75))
+    return DeviceTables(
+        jnp.ones(V, jnp.float32),
+        jnp.asarray(at.accept),
+        jnp.asarray(at.alias),
+        None,
+        None,
+        None,
+    )
+
+
+def _build(backend, scatter_mean, scope, clip=0.0):
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=D,
+        window=3, min_count=1, subsample_threshold=0,
+        compute_dtype="float32", shared_negatives=8,
+        negative_scope=scope,
+        max_sentence_len=40, band_chunk=10,
+        scatter_mean=scatter_mean, clip_row_update=clip,
+        band_backend=backend,
+    )
+    return cfg, jax.jit(make_band_train_step(cfg, _tables(cfg)))
+
+
+def _tokens():
+    rng = np.random.default_rng(4)
+    tokens = jnp.asarray(rng.integers(0, V, size=(6, 40)).astype(np.int32))
+    # padding exercises the invalid-slot masking on both paths
+    return tokens.at[2, 30:].set(-1)
+
+
+@pytest.mark.parametrize("scope", ["row", "batch"])
+@pytest.mark.parametrize("scatter_mean", [False, True])
+def test_pallas_band_matches_xla(scatter_mean, scope):
+    tokens = _tokens()
+    key = jax.random.key(9)
+    alpha = jnp.float32(0.03)
+
+    cfg_a, step_a = _build("xla", scatter_mean, scope)
+    _, step_b = _build("pallas", scatter_mean, scope)
+    params = init_params(cfg_a, V, jax.random.key(1))
+
+    pa, ma = step_a(dict(params), tokens, key, alpha)
+    pb, mb = step_b(dict(params), tokens, key, alpha)
+
+    for k in pa:
+        np.testing.assert_allclose(
+            np.asarray(pa[k]), np.asarray(pb[k]), rtol=2e-5, atol=2e-6,
+            err_msg=k,
+        )
+    np.testing.assert_allclose(
+        float(ma["loss_sum"]), float(mb["loss_sum"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(ma["pairs"]), float(mb["pairs"]), rtol=1e-6
+    )
+
+
+def test_pallas_band_with_row_clip_matches_xla():
+    tokens = _tokens()
+    key = jax.random.key(9)
+    alpha = jnp.float32(0.03)
+
+    cfg_a, step_a = _build("xla", True, "row", clip=0.5)
+    _, step_b = _build("pallas", True, "row", clip=0.5)
+    params = init_params(cfg_a, V, jax.random.key(1))
+
+    pa, ma = step_a(dict(params), tokens, key, alpha)
+    pb, mb = step_b(dict(params), tokens, key, alpha)
+    for k in pa:
+        np.testing.assert_allclose(
+            np.asarray(pa[k]), np.asarray(pb[k]), rtol=2e-5, atol=2e-6,
+            err_msg=k,
+        )
+    np.testing.assert_allclose(
+        float(ma["clip_engaged"]), float(mb["clip_engaged"])
+    )
+
+
+def test_pallas_rejects_unsupported_routes():
+    cfg = Word2VecConfig(
+        model="cbow", train_method="ns", negative=3, word_dim=D,
+        window=3, min_count=1, band_backend="pallas",
+    )
+    with pytest.raises(ValueError, match="cbow"):
+        make_band_train_step(cfg, _tables(cfg))

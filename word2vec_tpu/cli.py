@@ -137,6 +137,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "the whole batch (one dense matmul + KP-row update; "
                         "raise --shared-negatives with 'batch'; "
                         "config.negative_scope)")
+    p.add_argument("--band-backend", choices=["xla", "pallas"],
+                   default="xla",
+                   help="band step compute: XLA chain or the fused Pallas "
+                        "kernel (config.band_backend; sg+ns fp32 unfused)")
     p.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                    help="band kernel: scatter context grads from slab space "
                         "(skips the overlap-add; config.slab_scatter)")
@@ -268,6 +272,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         negative_scope=args.negative_scope,
         scatter_mean=bool(args.scatter_mean),
         slab_scatter=bool(args.slab_scatter),
+        band_backend=args.band_backend,
         resident=args.resident,
         clip_row_update=args.clip_row_update,
         prng_impl=args.prng,

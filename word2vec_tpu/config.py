@@ -108,6 +108,11 @@ class Word2VecConfig:
     # contractions cost L*(S+2W) instead of L^2. 0 = auto (dense for short
     # rows, 128-lane slabs for long); explicit S must be >= 2*window.
     band_chunk: int = 0
+    # Band-step compute backend: "xla" (ops/band_step.py chain of band
+    # matmuls; every route/axis/dtype) or "pallas" (ops/pallas_band.py —
+    # one fused VMEM-resident kernel per (row, chunk); sg+ns fp32 unfused
+    # single-axis only, A/B perf lever for the on-chip sweep).
+    band_backend: str = "xla"
 
     # Batched-update stabilizer. The reference's Hogwild updates are sequential:
     # after each update to a row, the next sigmoid sees the moved row, so
@@ -223,6 +228,22 @@ class Word2VecConfig:
             raise ValueError(f"kernel must be auto|band|pair, got {self.kernel!r}")
         if self.shared_negatives < 1:
             raise ValueError("shared_negatives must be >= 1")
+        if self.band_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"band_backend must be 'xla' or 'pallas', "
+                f"got {self.band_backend!r}"
+            )
+        if self.band_backend == "pallas" and (
+            self.train_method == "hs" or self.kernel == "pair"
+        ):
+            # reject here, not just in make_band_train_step: the kernel
+            # router never reaches the band step for hs/pair, and a bench
+            # A/B must not bank a measurement labeled pallas that actually
+            # ran another kernel
+            raise ValueError(
+                "band_backend='pallas' applies to the ns band kernel only "
+                "(hs and kernel='pair' route elsewhere; ops/pallas_band.py)"
+            )
         if self.negative_scope not in ("row", "batch"):
             raise ValueError(
                 f"negative_scope must be 'row' or 'batch', "

@@ -164,6 +164,25 @@ def make_band_train_step(
             "fused_tables requires the sorted shared-index scatter "
             "(slab_scatter uses a different index set per table)"
         )
+    pallas = config.band_backend == "pallas"
+    if pallas:
+        # Hard errors, not silent fallbacks: a bench A/B that silently ran
+        # the XLA chain would bank a mislabeled measurement.
+        unsupported = [
+            why for cond, why in [
+                (config.model == "cbow", "model=cbow"),
+                (fused, "fused_tables"),
+                (tp_axis is not None, "tensor parallelism"),
+                (sp_axis is not None, "sequence parallelism"),
+                (config.dtype != "float32", f"table dtype {config.dtype}"),
+            ] if cond
+        ]
+        if unsupported:
+            raise ValueError(
+                "band_backend='pallas' covers the sg+ns fp32 unfused "
+                "single-axis step (ops/pallas_band.py); unsupported here: "
+                + ", ".join(unsupported)
+            )
     W = config.window
     K = config.negative
     KP = config.shared_negatives
@@ -494,4 +513,152 @@ def make_band_train_step(
         }
         return new_params, metrics
 
-    return step
+    if not pallas:
+        return step
+
+    # ------------------------------------------------------------------
+    # Fused-kernel path (ops/pallas_band.py): one VMEM-resident pass
+    # computes everything between the gathers and the scatters. Kept as a
+    # separate step function so the XLA path above stays untouched;
+    # equivalence is pinned by tests/test_pallas_band.py.
+    # ------------------------------------------------------------------
+    from . import pallas_band
+
+    # interpret=True runs the kernel through the Pallas interpreter so the
+    # CPU test/virtual-device meshes exercise the identical code path
+    interpret = jax.devices()[0].platform != "tpu"
+
+    def step_pallas(
+        params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
+    ) -> Tuple[Params, Metrics]:
+        if dp_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+        B, L = tokens.shape
+        k_sub, k_win, k_neg = jax.random.split(key, 3)
+
+        valid = tokens >= 0
+        tok = jnp.where(valid, tokens, 0)
+        keep = valid & (jax.random.uniform(k_sub, (B, L)) < tables.keep_probs[tok])
+        w_eff = W - jax.random.randint(k_win, (B, L), 0, W, dtype=jnp.int32)
+
+        S = banded.resolve_chunk(L, W, config.band_chunk)
+        if S == 0:
+            raise ValueError(
+                f"band_backend='pallas' needs the chunked band "
+                f"representation, but rows of length {L} resolved to the "
+                f"dense path. Chunking requires 2*window <= band_chunk < "
+                f"row length (window={W}); rows with L <= 2*window cannot "
+                f"be chunked at all — use the XLA backend there"
+            )
+        C, P = banded._geom(L, W, S)
+        d = params["emb_in"].shape[1]
+        emb_in = params["emb_in"]
+        emb_out = params["emb_out_ns"]
+
+        negs = _draw_negatives(
+            k_neg, (B, KP) if per_row else (KP,),
+            tables.alias_accept, tables.alias_idx,
+        )
+        en = emb_out[negs]  # [B, KP, d] | [KP, d]
+
+        ein = emb_in[tok]
+        eout = emb_out[tok]
+        pad_c = C * S - L
+        a_c = jnp.pad(ein, ((0, 0), (0, pad_c), (0, 0))).reshape(B, C, S, d)
+        bk = banded._slabs(banded._pad_ctx(eout, W, P), C, S, 2 * W)
+        tok_c = jnp.pad(
+            tokens, ((0, 0), (0, pad_c)), constant_values=-1
+        ).reshape(B, C, S)
+        # raw ids with -1 preserved: the kernel derives context validity
+        # from tok_k >= 0
+        tok_k = banded.slab_token_ids(tokens, W, S)
+        keep_c = jnp.pad(
+            keep.astype(jnp.float32), ((0, 0), (0, pad_c))
+        ).reshape(B, C, S)
+        w_c = jnp.pad(
+            w_eff.astype(jnp.float32), ((0, 0), (0, pad_c))
+        ).reshape(B, C, S)
+
+        d_h4, d_ctx_slab, d_neg_k, nctx_c, ctx_w_slab, wns, losses = (
+            pallas_band.band_core(
+                a_c, bk,
+                en if per_row else en[None],
+                tok_c, tok_k, keep_c, w_c,
+                negs if per_row else negs[None],
+                alpha,
+                W=W, K=K, cdt=cdt, interpret=interpret,
+            )
+        )
+        d_h = d_h4.reshape(B, C * S, d)[:, :L]
+        n_ctx = nctx_c.reshape(B, C * S)[:, :L]
+        d_neg_flat = (d_neg_k if per_row else d_neg_k[0]).reshape(-1, d)
+        w_neg_flat = (wns if per_row else wns[0]).reshape(-1)
+        flat_negs = negs.reshape(-1)
+
+        # ---- scatters: same sorted discipline as the XLA step's
+        # slab-scatter path above (centers by token id, contexts in slab
+        # space). Deliberately a specialized copy, NOT shared code: the XLA
+        # tail interleaves fused/cbow/sr variants this path can never take.
+        # If you change the shared discipline (joint counts, clip budget,
+        # sort order) in either place, tests/test_pallas_band.py pins the
+        # two backends equal across every combination this path supports.
+        flat = tok.reshape(-1)
+        order = jnp.argsort(flat)
+        sorted_idx = flat[order]
+        d_in_flat = d_h.reshape(-1, d)[order]
+
+        slab_ok = tok_k >= 0
+        slab_flat = jnp.where(slab_ok, tok_k, 0).reshape(-1)
+        slab_order = jnp.argsort(slab_flat)
+        slab_sorted = slab_flat[slab_order]
+        # the kernel already zeroes values/weights at invalid slots (their
+        # mask column is zero), so no re-masking is needed here
+        d_ctx_flat = d_ctx_slab.reshape(-1, d)[slab_order]
+        ctx_w_flat = ctx_w_slab.reshape(-1)[slab_order]
+
+        if scatter_mean:
+            in_weight = (keep & (n_ctx > 0)).astype(jnp.float32)
+            d_in_flat = d_in_flat * _dup_mean_scale(
+                emb_in.shape[0], sorted_idx, in_weight.reshape(-1)[order]
+            )[:, None]
+            cnt = (
+                jnp.zeros((emb_out.shape[0],), jnp.float32)
+                .at[slab_sorted].add(ctx_w_flat)
+                .at[flat_negs].add(w_neg_flat)
+            )
+            inv = 1.0 / jnp.maximum(cnt, 1.0)
+            d_ctx_flat = d_ctx_flat * inv[slab_sorted][:, None]
+            d_neg_flat = d_neg_flat * inv[flat_negs][:, None]
+
+        clip_count = jnp.float32(0.0)
+        if clip_tau > 0.0:
+            in_scale = _row_clip_scale(
+                emb_in.shape[0], clip_tau, (sorted_idx, d_in_flat)
+            )
+            out_scale = _row_clip_scale(
+                emb_out.shape[0], clip_tau,
+                (slab_sorted, d_ctx_flat), (flat_negs, d_neg_flat),
+            )
+            clip_count = jnp.sum(
+                (in_scale < 1.0).astype(jnp.float32)
+            ) + jnp.sum((out_scale < 1.0).astype(jnp.float32))
+            d_in_flat = d_in_flat * in_scale[sorted_idx][:, None]
+            d_ctx_flat = d_ctx_flat * out_scale[slab_sorted][:, None]
+            d_neg_flat = d_neg_flat * out_scale[flat_negs][:, None]
+
+        new_params = dict(params)
+        new_params["emb_in"] = emb_in.at[sorted_idx].add(
+            d_in_flat, indices_are_sorted=True
+        )
+        new_params["emb_out_ns"] = (
+            emb_out.at[slab_sorted].add(d_ctx_flat, indices_are_sorted=True)
+            .at[flat_negs].add(d_neg_flat)
+        )
+        metrics = {
+            "loss_sum": losses[0, 0] + losses[0, 1],
+            "pairs": jnp.sum(n_ctx) + jnp.sum(w_neg_flat),
+            "clip_engaged": clip_count,
+        }
+        return new_params, metrics
+
+    return step_pallas

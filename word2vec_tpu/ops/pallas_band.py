@@ -1,0 +1,272 @@
+"""Fused Pallas TPU kernel for the banded sg+ns training step.
+
+The XLA band chain (ops/band_step.py + ops/banded.py) materializes every
+intermediate in HBM: the gathered [B, L, d] row tensors are re-read by four
+band contractions, the [B, C, S, S+2W] logit/grad planes round-trip between
+them, and XLA inserts layout copies around the overlap-add (measured 2.14 ms
+= 27% of the round-2 step, PERF.md). This kernel is the flash-attention
+treatment of the same math (SURVEY §7 step 8): one pass per (batch row,
+chunk) that keeps the logit plane, the sigmoid, both positive-side gradient
+contractions, and the whole shared-negative side in VMEM, reading each row
+tensor from HBM exactly once and writing exactly the gradient tensors the
+scatters need.
+
+Same objective as band_step.py (Word2Vec.cpp:251-271,319-353 semantics with
+the shared-negative reformulation documented there) — pinned against the
+XLA kernel by tests/test_pallas_band.py.
+
+Scope (config.band_backend="pallas"; band_step falls back to the XLA chain
+otherwise): skip-gram + negative sampling, per-row or batch negative scope,
+unfused f32 tables, chunked band representation (S > 0), no tensor/sequence
+axis inside the step (dp sharding is outside and unaffected). The context
+gradient is emitted in SLAB space and flows through the sorted slab scatter
+(band_step.py v2), so the overlap-add never exists anywhere on the pallas
+path.
+
+Layout contract (all pre-chunked by the caller with ops/banded helpers):
+  a      [B, C, S, d]     center rows (ein chunks; zero rows past L)
+  bk     [B, C, S+2W, d]  context slabs (eout; zero rows outside)
+  en     [B, KP, d]       shared negative rows ([1, KP, d] batch scope)
+  tok_c  [B, C, S]        center token ids, -1 past row end
+  tok_k  [B, C, S+2W]     slab token ids, -1 outside (banded.slab_token_ids)
+  keep_c [B, C, S]        center gate (subsample & valid), f32 0/1
+  w_c    [B, C, S]        per-center shrunk window, f32
+  negs   [B, KP]          negative ids ([1, KP] batch scope)
+  alpha  scalar           learning rate
+
+Outputs:
+  d_h        [B, C, S, d]     center-row gradient (positives + negatives)
+  d_ctx      [B, C, S+2W, d]  context-row gradient, slab space
+  d_neg      [B, KP, d]       negative-row gradient (accumulated over C;
+                              [1, KP, d] batch scope, accumulated over B too)
+  n_ctx      [B, C, S]        active contexts per center (band row sums)
+  ctx_w      [B, C, S+2W]     contribution weight per slab slot (col sums)
+  w_neg_sum  [B, KP]          per-draw expectation weight, summed over rows
+  losses     [1, 2]           (pos_loss, neg_loss) accumulated over the grid
+
+The grid is (B, C) with C innermost; d_neg/w_neg_sum accumulate across the
+C steps of one row (across the whole grid under batch scope), losses across
+the whole grid — safe because the TPU grid executes sequentially.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _band_kernel(
+    alpha_ref,  # [1, 1] SMEM
+    a_ref,      # [1, 1, S, d]
+    bk_ref,     # [1, 1, S+2W, d]
+    en_ref,     # [1, KP, d]
+    tokc_ref,   # [1, 1, S] int32
+    tokk_ref,   # [1, 1, S+2W] int32
+    keep_ref,   # [1, 1, S] f32
+    wc_ref,     # [1, 1, S] f32
+    negs_ref,   # [1, KP] int32
+    d_h_ref,    # [1, 1, S, d]
+    d_ctx_ref,  # [1, 1, S+2W, d]
+    d_neg_ref,  # [1, KP, d]
+    nctx_ref,   # [1, 1, S]
+    ctxw_ref,   # [1, 1, S+2W]
+    wns_ref,    # [1, KP]
+    loss_ref,   # [1, 2]
+    *,
+    W: int,
+    K: int,
+    cdt,
+    neg_shared: bool,
+):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    S = a_ref.shape[2]
+    SK = bk_ref.shape[2]  # S + 2W
+    alpha = alpha_ref[0, 0]
+
+    # ---- band mask [S, S+2W]: keep_i & valid_j & 0 < |i-j| <= w_eff_i
+    # (Word2Vec.cpp:282,285-287,332,335-337 gates, as in banded.band_mask)
+    s_iota = jax.lax.broadcasted_iota(jnp.float32, (S, SK), 0)
+    k_iota = jax.lax.broadcasted_iota(jnp.float32, (S, SK), 1)
+    dist = jnp.abs(s_iota + float(W) - k_iota)
+    valid_k = (tokk_ref[0, 0, :] >= 0).astype(jnp.float32)
+    mask = (
+        keep_ref[0, 0, :][:, None]
+        * valid_k[None, :]
+        * (dist <= wc_ref[0, 0, :][:, None]).astype(jnp.float32)
+        * (dist > 0.0).astype(jnp.float32)
+    )
+    n_ctx = jnp.sum(mask, axis=1)  # [S]
+    nctx_ref[0, 0, :] = n_ctx
+    ctxw_ref[0, 0, :] = jnp.sum(mask, axis=0)
+
+    # ---- positive side: band logits + both gradient contractions, in VMEM
+    a = a_ref[0, 0]
+    bk = bk_ref[0, 0]
+    plog = jax.lax.dot_general(
+        a.astype(cdt), bk.astype(cdt),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [S, S+2W]
+    gp = (1.0 - jax.nn.sigmoid(plog)) * mask * alpha
+    d_h = jax.lax.dot_general(
+        gp.astype(cdt), bk.astype(cdt),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [S, d]
+    d_ctx_ref[0, 0] = jax.lax.dot_general(
+        gp.astype(cdt), a.astype(cdt),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [S+2W, d]
+    pos_loss = -jnp.sum(mask * jax.nn.log_sigmoid(plog))
+
+    # ---- negative side: shared draws, collision-masked per center
+    # (center/context-collision semantics of band_step.py lines 233-252)
+    en = en_ref[0]
+    negs = negs_ref[0, :]
+    center_hit = (tokc_ref[0, 0, :][:, None] == negs[None, :]).astype(
+        jnp.float32
+    )  # [S, KP]
+    hit_k = (tokk_ref[0, 0, :][:, None] == negs[None, :]).astype(
+        jnp.float32
+    )  # [S+2W, KP]
+    ctx_hit = jax.lax.dot_general(
+        mask.astype(cdt), hit_k.astype(cdt),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [S, KP]
+    neg_ok = 1.0 - jnp.clip(center_hit + ctx_hit, 0.0, 1.0)
+    KP = neg_ok.shape[1]
+    w_neg = (n_ctx * (float(K) / float(KP)))[:, None] * neg_ok  # [S, KP]
+    nlog = jax.lax.dot_general(
+        a.astype(cdt), en.astype(cdt),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [S, KP]
+    gn = (0.0 - jax.nn.sigmoid(nlog)) * w_neg * alpha
+    d_h_ref[0, 0] = d_h + jax.lax.dot_general(
+        gn.astype(cdt), en.astype(cdt),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    d_neg_c = jax.lax.dot_general(
+        gn.astype(cdt), a.astype(cdt),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [KP, d]
+    neg_loss = -jnp.sum(w_neg * (jax.nn.log_sigmoid(nlog) - nlog))
+
+    # ---- accumulations across the sequential grid
+    fresh = jnp.logical_and(b == 0, c == 0) if neg_shared else (c == 0)
+
+    @pl.when(fresh)
+    def _():
+        d_neg_ref[...] = jnp.zeros_like(d_neg_ref)
+        wns_ref[...] = jnp.zeros_like(wns_ref)
+
+    d_neg_ref[0] += d_neg_c
+    wns_ref[0, :] += jnp.sum(w_neg, axis=0)
+
+    @pl.when(jnp.logical_and(b == 0, c == 0))
+    def _():
+        loss_ref[...] = jnp.zeros_like(loss_ref)
+
+    loss_ref[0, 0] += pos_loss
+    loss_ref[0, 1] += neg_loss
+
+
+@functools.partial(
+    jax.jit, static_argnames=("W", "K", "cdt", "interpret")
+)
+def band_core(
+    a: jnp.ndarray,       # [B, C, S, d]
+    bk: jnp.ndarray,      # [B, C, S+2W, d]
+    en: jnp.ndarray,      # [B|1, KP, d]
+    tok_c: jnp.ndarray,   # [B, C, S] int32
+    tok_k: jnp.ndarray,   # [B, C, S+2W] int32
+    keep_c: jnp.ndarray,  # [B, C, S]
+    w_c: jnp.ndarray,     # [B, C, S]
+    negs: jnp.ndarray,    # [B|1, KP] int32
+    alpha: jnp.ndarray,   # scalar
+    *,
+    W: int,
+    K: int,
+    cdt=jnp.bfloat16,
+    interpret: bool = False,
+):
+    """One fused pass over the band; see the module docstring contract.
+
+    en/negs with leading dim 1 (batch-scope negatives) are shared by every
+    batch row; d_neg/w_neg_sum then come back [1, KP, d]/[1, KP] already
+    summed over the batch.
+    """
+    B, C, S, d = a.shape
+    SK = bk.shape[2]
+    NB, KP = negs.shape
+    neg_shared = NB == 1
+
+    def bc4(i, j):
+        return (i, j, 0, 0)
+
+    def bc3(i, j):
+        return (i, j, 0)
+
+    def nb3(i, j):
+        return (0 if neg_shared else i, 0, 0)
+
+    def nb2(i, j):
+        return (0 if neg_shared else i, 0)
+
+    grid_spec = pl.GridSpec(
+        grid=(B, C),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, S, d), bc4),
+            pl.BlockSpec((1, 1, SK, d), bc4),
+            pl.BlockSpec((1, KP, d), nb3),
+            pl.BlockSpec((1, 1, S), bc3),
+            pl.BlockSpec((1, 1, SK), bc3),
+            pl.BlockSpec((1, 1, S), bc3),
+            pl.BlockSpec((1, 1, S), bc3),
+            pl.BlockSpec((1, KP), nb2),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, S, d), bc4),
+            pl.BlockSpec((1, 1, SK, d), bc4),
+            pl.BlockSpec((1, KP, d), nb3),
+            pl.BlockSpec((1, 1, S), bc3),
+            pl.BlockSpec((1, 1, SK), bc3),
+            pl.BlockSpec((1, KP), nb2),
+            pl.BlockSpec((1, 2), lambda i, j: (0, 0)),
+        ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((B, C, S, d), jnp.float32),
+        jax.ShapeDtypeStruct((B, C, SK, d), jnp.float32),
+        jax.ShapeDtypeStruct((NB, KP, d), jnp.float32),
+        jax.ShapeDtypeStruct((B, C, S), jnp.float32),
+        jax.ShapeDtypeStruct((B, C, SK), jnp.float32),
+        jax.ShapeDtypeStruct((NB, KP), jnp.float32),
+        jax.ShapeDtypeStruct((1, 2), jnp.float32),
+    ]
+    kernel = functools.partial(
+        _band_kernel, W=W, K=K, cdt=cdt, neg_shared=neg_shared
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(
+        jnp.asarray(alpha, jnp.float32).reshape(1, 1),
+        a, bk, en,
+        tok_c, tok_k,
+        keep_c.astype(jnp.float32), w_c.astype(jnp.float32),
+        negs,
+    )

@@ -23,12 +23,18 @@ run_item default      900 "$TPU" $B
 # the best-guess stacks right after the headline default, in case the live
 # window is short: these items alone give the 50x shots + their baseline
 run_item fused_kp32_c96       900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96
-run_item full_stack           900 "$TPU" $B --fused 1 --chunk-cap 96 --neg-scope batch --kp 256 --table-dtype bfloat16 --sr 1
+# (full_stack wedged >900s on its first attempt and the kill coincided
+# with a tunnel outage; retried at the END of tpu_queue4b.sh with 1800s)
+# the fused Pallas band kernel: the single most informative new item —
+# measured early in case the live window is short
+run_item pallas       900 "$TPU" $B --band-backend pallas
+run_item b512         900 "$TPU" $B --batch-rows 512
+run_item chunk96      900 "$TPU" $B --chunk-cap 96
 run_item fused        900 "$TPU" $B --fused 1
 run_item kp32         900 "$TPU" $B --kp 32
-run_item chunk96      900 "$TPU" $B --chunk-cap 96
-run_item b512         900 "$TPU" $B --batch-rows 512
 run_item rbg          900 "$TPU" $B --prng rbg
+run_item slab_sorted  900 "$TPU" $B --slab-scatter 1
+run_item pallas_b512_c96      900 "$TPU" $B --band-backend pallas --batch-rows 512 --chunk-cap 96
 # combos (each lever is independent machinery; measure the stack)
 run_item fused_kp32           900 "$TPU" $B --fused 1 --kp 32
 run_item fused_kp32_c96_rbg   900 "$TPU" $B --fused 1 --kp 32 --chunk-cap 96 --prng rbg

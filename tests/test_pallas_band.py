@@ -113,3 +113,23 @@ def test_pallas_rejects_unsupported_routes():
     )
     with pytest.raises(ValueError, match="cbow"):
         make_band_train_step(cfg, _tables(cfg))
+
+
+def test_pallas_rejected_by_sharded_factories():
+    """shard_map cannot host the kernel (see _reject_pallas): every sharded
+    step factory must fail up front with the real reason — even on a 1x1x1
+    mesh, where the per-axis guards in make_band_train_step all pass but
+    the interpreter crashes mid-step with an internal vma error."""
+    from word2vec_tpu.parallel.mesh import make_mesh
+    from word2vec_tpu.parallel.trainer import (
+        make_sharded_chunk, make_sharded_step,
+    )
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=D,
+        window=3, min_count=1, band_backend="pallas",
+    )
+    t = _tables(cfg)
+    for factory in (make_sharded_step, make_sharded_chunk):
+        with pytest.raises(ValueError, match="single-chip"):
+            factory(cfg, t, make_mesh(1, 1))

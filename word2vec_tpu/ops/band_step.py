@@ -174,6 +174,10 @@ def make_band_train_step(
                 (fused, "fused_tables"),
                 (tp_axis is not None, "tensor parallelism"),
                 (sp_axis is not None, "sequence parallelism"),
+                # defense in depth: sharded trainers already reject pallas
+                # up front (parallel/trainer._reject_pallas — shard_map
+                # cannot host the kernel, see ops/pallas_band.py scope note)
+                (dp_axis is not None, "data-parallel sharding"),
                 (config.dtype != "float32", f"table dtype {config.dtype}"),
             ] if cond
         ]
@@ -531,8 +535,6 @@ def make_band_train_step(
     def step_pallas(
         params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
     ) -> Tuple[Params, Metrics]:
-        if dp_axis is not None:
-            key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
         B, L = tokens.shape
         k_sub, k_win, k_neg = jax.random.split(key, 3)
 

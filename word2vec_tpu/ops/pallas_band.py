@@ -17,8 +17,11 @@ XLA kernel by tests/test_pallas_band.py.
 
 Scope (config.band_backend="pallas"; band_step falls back to the XLA chain
 otherwise): skip-gram + negative sampling, per-row or batch negative scope,
-unfused f32 tables, chunked band representation (S > 0), no tensor/sequence
-axis inside the step (dp sharding is outside and unaffected). The context
+unfused f32 tables, chunked band representation (S > 0), SINGLE-CHIP ONLY
+(plain Trainer; sharded trainers reject it up front — pallas_call under
+shard_map is unvalidatable here: the interpreter's internals are not
+vma-aware, and no multi-chip hardware exists to compile the real thing;
+parallel/trainer._reject_pallas). The context
 gradient is emitted in SLAB space and flows through the sorted slab scatter
 (band_step.py v2), so the overlap-add never exists anywhere on the pallas
 path.
@@ -210,6 +213,9 @@ def band_core(
     NB, KP = negs.shape
     neg_shared = NB == 1
 
+    def sds(shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
     def bc4(i, j):
         return (i, j, 0, 0)
 
@@ -247,13 +253,13 @@ def band_core(
         ],
     )
     out_shape = [
-        jax.ShapeDtypeStruct((B, C, S, d), jnp.float32),
-        jax.ShapeDtypeStruct((B, C, SK, d), jnp.float32),
-        jax.ShapeDtypeStruct((NB, KP, d), jnp.float32),
-        jax.ShapeDtypeStruct((B, C, S), jnp.float32),
-        jax.ShapeDtypeStruct((B, C, SK), jnp.float32),
-        jax.ShapeDtypeStruct((NB, KP), jnp.float32),
-        jax.ShapeDtypeStruct((1, 2), jnp.float32),
+        sds((B, C, S, d)),
+        sds((B, C, SK, d)),
+        sds((NB, KP, d)),
+        sds((B, C, S)),
+        sds((B, C, SK)),
+        sds((NB, KP)),
+        sds((1, 2)),
     ]
     kernel = functools.partial(
         _band_kernel, W=W, K=K, cdt=cdt, neg_shared=neg_shared

@@ -95,8 +95,23 @@ def assemble_local_replica(v: jax.Array) -> np.ndarray:
     return np.concatenate([parts[k] for k in sorted(parts)], axis=1)
 
 
+def _reject_pallas(config: Word2VecConfig) -> None:
+    """shard_map cannot host the pallas band kernel yet: the Pallas
+    interpreter's internal dynamic_slices are not vma-aware (crashes even
+    on a 1x1x1 mesh on the CPU test backend), and no multi-chip hardware
+    exists here to validate a real-TPU compile. Reject up front with the
+    real reason instead of an internal JAX error mid-step."""
+    if config.band_backend == "pallas":
+        raise ValueError(
+            "band_backend='pallas' is single-chip only (plain Trainer); "
+            "sharded trainers run the XLA band chain — see the scope note "
+            "in ops/pallas_band.py"
+        )
+
+
 def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
     """Jitted global-array step over the mesh (donates params)."""
+    _reject_pallas(config)
     dp = mesh.shape[DATA_AXIS]
     sp = mesh.shape[SEQ_AXIS]
     tp = mesh.shape[MODEL_AXIS]
@@ -148,6 +163,7 @@ def make_sharded_chunk(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh)
     ShardedTrainer._resolve_chunk_len caps S at the sync dispatch interval
     so chunking never coarsens the reconciliation cadence.
     """
+    _reject_pallas(config)
     dp = mesh.shape[DATA_AXIS]
     sp = mesh.shape[SEQ_AXIS]
     tp = mesh.shape[MODEL_AXIS]
@@ -216,6 +232,7 @@ def make_sharded_resident_chunk(
     token traffic. Single-process meshes only: multi-host runs feed
     per-process corpus SHARDS, which have no shared global row order.
     """
+    _reject_pallas(config)
     from ..ops.resident import assemble_batch
 
     dp = mesh.shape[DATA_AXIS]

@@ -35,6 +35,8 @@ run_item c192                 900 "$TPU" $B --chunk-cap 192
 run_item pallas_c96           900 "$TPU" $B --band-backend pallas --chunk-cap 96
 run_item pallas_b512          900 "$TPU" $B --band-backend pallas --batch-rows 512
 run_item pallas_b512_c96      900 "$TPU" $B --band-backend pallas --batch-rows 512 --chunk-cap 96
+# BASELINE config 2 (cbow dim=100) through the fused kernel's cbow branch
+run_item cbow_dim100_pallas   900 "$TPU" $B --model cbow --dim 100 --band-backend pallas
 
 # --- combos over queue4 singles ---------------------------------------------
 run_item b512_c96             900 "$TPU" $B --batch-rows 512 --chunk-cap 96

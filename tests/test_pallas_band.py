@@ -38,9 +38,9 @@ def _tables(cfg):
     )
 
 
-def _build(backend, scatter_mean, scope, clip=0.0):
+def _build(backend, scatter_mean, scope, clip=0.0, model="sg"):
     cfg = Word2VecConfig(
-        model="sg", train_method="ns", negative=3, word_dim=D,
+        model=model, train_method="ns", negative=3, word_dim=D,
         window=3, min_count=1, subsample_threshold=0,
         compute_dtype="float32", shared_negatives=8,
         negative_scope=scope,
@@ -58,15 +58,16 @@ def _tokens():
     return tokens.at[2, 30:].set(-1)
 
 
+@pytest.mark.parametrize("model", ["sg", "cbow"])
 @pytest.mark.parametrize("scope", ["row", "batch"])
 @pytest.mark.parametrize("scatter_mean", [False, True])
-def test_pallas_band_matches_xla(scatter_mean, scope):
+def test_pallas_band_matches_xla(scatter_mean, scope, model):
     tokens = _tokens()
     key = jax.random.key(9)
     alpha = jnp.float32(0.03)
 
-    cfg_a, step_a = _build("xla", scatter_mean, scope)
-    _, step_b = _build("pallas", scatter_mean, scope)
+    cfg_a, step_a = _build("xla", scatter_mean, scope, model=model)
+    _, step_b = _build("pallas", scatter_mean, scope, model=model)
     params = init_params(cfg_a, V, jax.random.key(1))
 
     pa, ma = step_a(dict(params), tokens, key, alpha)
@@ -85,13 +86,14 @@ def test_pallas_band_matches_xla(scatter_mean, scope):
     )
 
 
-def test_pallas_band_with_row_clip_matches_xla():
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_pallas_band_with_row_clip_matches_xla(model):
     tokens = _tokens()
     key = jax.random.key(9)
     alpha = jnp.float32(0.03)
 
-    cfg_a, step_a = _build("xla", True, "row", clip=0.5)
-    _, step_b = _build("pallas", True, "row", clip=0.5)
+    cfg_a, step_a = _build("xla", True, "row", clip=0.5, model=model)
+    _, step_b = _build("pallas", True, "row", clip=0.5, model=model)
     params = init_params(cfg_a, V, jax.random.key(1))
 
     pa, ma = step_a(dict(params), tokens, key, alpha)
@@ -106,13 +108,67 @@ def test_pallas_band_with_row_clip_matches_xla():
     )
 
 
-def test_pallas_rejects_unsupported_routes():
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_pallas_band_matches_xla_bf16_compute(model):
+    """The default compute_dtype is bfloat16 — both backends must round
+    operands to the SAME grid (reviewer-caught: the cbow positive logit
+    briefly skipped the cast). Tolerance is wider than the f32 tests only
+    for reduction-order reassociation on bf16-rounded products."""
+    tokens = _tokens()
+    import dataclasses
+
+    cfg_a, _ = _build("xla", True, "row", model=model)
+    cfg_a = dataclasses.replace(cfg_a, compute_dtype="bfloat16")
+    step_a = jax.jit(make_band_train_step(cfg_a, _tables(cfg_a)))
+    cfg_b = dataclasses.replace(cfg_a, band_backend="pallas")
+    step_b = jax.jit(make_band_train_step(cfg_b, _tables(cfg_b)))
+    params = init_params(cfg_a, V, jax.random.key(1))
+
+    pa, _ = step_a(dict(params), tokens, jax.random.key(9), jnp.float32(0.03))
+    pb, _ = step_b(dict(params), tokens, jax.random.key(9), jnp.float32(0.03))
+    for k in pa:
+        np.testing.assert_allclose(
+            np.asarray(pa[k]), np.asarray(pb[k]), rtol=1e-4, atol=1e-5,
+            err_msg=k,
+        )
+
+
+def test_pallas_cbow_sum_projection_matches_xla():
+    """cbow_mean=False (sum projection, no double divide) is its own
+    static kernel branch — pin it too."""
+    tokens = _tokens()
     cfg = Word2VecConfig(
         model="cbow", train_method="ns", negative=3, word_dim=D,
-        window=3, min_count=1, band_backend="pallas",
+        window=3, min_count=1, subsample_threshold=0,
+        compute_dtype="float32", shared_negatives=8,
+        max_sentence_len=40, band_chunk=10, cbow_mean=False,
+        scatter_mean=True,
     )
-    with pytest.raises(ValueError, match="cbow"):
-        make_band_train_step(cfg, _tables(cfg))
+    import dataclasses
+
+    params = init_params(cfg, V, jax.random.key(1))
+    pa, _ = jax.jit(make_band_train_step(cfg, _tables(cfg)))(
+        dict(params), tokens, jax.random.key(9), jnp.float32(0.03)
+    )
+    cfg_p = dataclasses.replace(cfg, band_backend="pallas")
+    pb, _ = jax.jit(make_band_train_step(cfg_p, _tables(cfg_p)))(
+        dict(params), tokens, jax.random.key(9), jnp.float32(0.03)
+    )
+    for k in pa:
+        np.testing.assert_allclose(
+            np.asarray(pa[k]), np.asarray(pb[k]), rtol=2e-5, atol=2e-6,
+            err_msg=k,
+        )
+
+
+def test_pallas_rejects_unsupported_routes():
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=D,
+        window=3, min_count=1, band_backend="pallas",
+        fused_tables=True,
+    )
+    with pytest.raises(ValueError, match="fused"):
+        make_band_train_step(cfg, _tables(cfg), fused=True)
 
 
 def test_pallas_rejected_by_sharded_factories():

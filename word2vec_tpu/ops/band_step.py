@@ -170,7 +170,6 @@ def make_band_train_step(
         # the XLA chain would bank a mislabeled measurement.
         unsupported = [
             why for cond, why in [
-                (config.model == "cbow", "model=cbow"),
                 (fused, "fused_tables"),
                 (tp_axis is not None, "tensor parallelism"),
                 (sp_axis is not None, "sequence parallelism"),
@@ -183,8 +182,8 @@ def make_band_train_step(
         ]
         if unsupported:
             raise ValueError(
-                "band_backend='pallas' covers the sg+ns fp32 unfused "
-                "single-axis step (ops/pallas_band.py); unsupported here: "
+                "band_backend='pallas' covers the sg/cbow ns fp32 unfused "
+                "single-chip step (ops/pallas_band.py); unsupported here: "
                 + ", ".join(unsupported)
             )
     W = config.window
@@ -563,11 +562,17 @@ def make_band_train_step(
         )
         en = emb_out[negs]  # [B, KP, d] | [KP, d]
 
-        ein = emb_in[tok]
-        eout = emb_out[tok]
+        # matrix roles (Word2Vec.cpp:300-315 vs :330-351): sg scores
+        # emb_in centers against emb_out context slabs; cbow scores the
+        # emb_in context projection against the center's emb_out row
+        center_tbl, ctx_tbl = (
+            (emb_out, emb_in) if is_cbow else (emb_in, emb_out)
+        )
         pad_c = C * S - L
-        a_c = jnp.pad(ein, ((0, 0), (0, pad_c), (0, 0))).reshape(B, C, S, d)
-        bk = banded._slabs(banded._pad_ctx(eout, W, P), C, S, 2 * W)
+        a_c = jnp.pad(
+            center_tbl[tok], ((0, 0), (0, pad_c), (0, 0))
+        ).reshape(B, C, S, d)
+        bk = banded._slabs(banded._pad_ctx(ctx_tbl[tok], W, P), C, S, 2 * W)
         tok_c = jnp.pad(
             tokens, ((0, 0), (0, pad_c)), constant_values=-1
         ).reshape(B, C, S)
@@ -588,7 +593,8 @@ def make_band_train_step(
                 tok_c, tok_k, keep_c, w_c,
                 negs if per_row else negs[None],
                 alpha,
-                W=W, K=K, cdt=cdt, interpret=interpret,
+                W=W, K=K, cdt=cdt, is_cbow=is_cbow, cbow_mean=cbow_mean,
+                interpret=interpret,
             )
         )
         d_h = d_h4.reshape(B, C * S, d)[:, :L]
@@ -618,47 +624,64 @@ def make_band_train_step(
         d_ctx_flat = d_ctx_slab.reshape(-1, d)[slab_order]
         ctx_w_flat = ctx_w_slab.reshape(-1)[slab_order]
 
+        # Routing mirrors the gather roles, bound ONCE like the XLA tail:
+        # sg puts center grads on emb_in and slab grads + negatives on
+        # emb_out; cbow swaps the first two (negatives always live on
+        # emb_out). active = per-center update gate, the XLA path's
+        # (keep & n_ctx > 0). Each (idx, vals, weight) triple stays
+        # aligned through scatter_mean / clip / the scatter itself.
+        active_flat = (n_ctx > 0).astype(jnp.float32).reshape(-1)
+        center_side = (sorted_idx, d_in_flat, active_flat[order])
+        slab_side = (slab_sorted, d_ctx_flat, ctx_w_flat)
+        if not is_cbow:
+            (in_idx, in_vals, in_w) = center_side
+            (out_idx, out_vals, out_w) = slab_side
+            pos_pairs = jnp.sum(n_ctx)
+        else:
+            (in_idx, in_vals, in_w) = slab_side
+            (out_idx, out_vals, out_w) = center_side
+            pos_pairs = jnp.sum(active_flat)
+
         if scatter_mean:
-            in_weight = (keep & (n_ctx > 0)).astype(jnp.float32)
-            d_in_flat = d_in_flat * _dup_mean_scale(
-                emb_in.shape[0], sorted_idx, in_weight.reshape(-1)[order]
+            in_vals = in_vals * _dup_mean_scale(
+                emb_in.shape[0], in_idx, in_w
             )[:, None]
             cnt = (
                 jnp.zeros((emb_out.shape[0],), jnp.float32)
-                .at[slab_sorted].add(ctx_w_flat)
+                .at[out_idx].add(out_w)
                 .at[flat_negs].add(w_neg_flat)
             )
             inv = 1.0 / jnp.maximum(cnt, 1.0)
-            d_ctx_flat = d_ctx_flat * inv[slab_sorted][:, None]
+            out_vals = out_vals * inv[out_idx][:, None]
             d_neg_flat = d_neg_flat * inv[flat_negs][:, None]
 
         clip_count = jnp.float32(0.0)
         if clip_tau > 0.0:
             in_scale = _row_clip_scale(
-                emb_in.shape[0], clip_tau, (sorted_idx, d_in_flat)
+                emb_in.shape[0], clip_tau, (in_idx, in_vals)
             )
             out_scale = _row_clip_scale(
                 emb_out.shape[0], clip_tau,
-                (slab_sorted, d_ctx_flat), (flat_negs, d_neg_flat),
+                (out_idx, out_vals), (flat_negs, d_neg_flat),
             )
             clip_count = jnp.sum(
                 (in_scale < 1.0).astype(jnp.float32)
             ) + jnp.sum((out_scale < 1.0).astype(jnp.float32))
-            d_in_flat = d_in_flat * in_scale[sorted_idx][:, None]
-            d_ctx_flat = d_ctx_flat * out_scale[slab_sorted][:, None]
+            in_vals = in_vals * in_scale[in_idx][:, None]
+            out_vals = out_vals * out_scale[out_idx][:, None]
             d_neg_flat = d_neg_flat * out_scale[flat_negs][:, None]
 
         new_params = dict(params)
-        new_params["emb_in"] = emb_in.at[sorted_idx].add(
-            d_in_flat, indices_are_sorted=True
+        new_params["emb_in"] = emb_in.at[in_idx].add(
+            in_vals, indices_are_sorted=True
         )
         new_params["emb_out_ns"] = (
-            emb_out.at[slab_sorted].add(d_ctx_flat, indices_are_sorted=True)
+            emb_out.at[out_idx].add(out_vals, indices_are_sorted=True)
             .at[flat_negs].add(d_neg_flat)
         )
         metrics = {
             "loss_sum": losses[0, 0] + losses[0, 1],
-            "pairs": jnp.sum(n_ctx) + jnp.sum(w_neg_flat),
+            "pairs": pos_pairs + jnp.sum(w_neg_flat),
             "clip_engaged": clip_count,
         }
         return new_params, metrics

@@ -16,7 +16,7 @@ the shared-negative reformulation documented there) — pinned against the
 XLA kernel by tests/test_pallas_band.py.
 
 Scope (config.band_backend="pallas"; band_step falls back to the XLA chain
-otherwise): skip-gram + negative sampling, per-row or batch negative scope,
+otherwise): sg or cbow + negative sampling, per-row or batch negative scope,
 unfused f32 tables, chunked band representation (S > 0), SINGLE-CHIP ONLY
 (plain Trainer; sharded trainers reject it up front — pallas_call under
 shard_map is unvalidatable here: the interpreter's internals are not
@@ -27,8 +27,11 @@ gradient is emitted in SLAB space and flows through the sorted slab scatter
 path.
 
 Layout contract (all pre-chunked by the caller with ops/banded helpers):
-  a      [B, C, S, d]     center rows (ein chunks; zero rows past L)
-  bk     [B, C, S+2W, d]  context slabs (eout; zero rows outside)
+  a      [B, C, S, d]     center rows (ein chunks for sg, eout for cbow;
+                          zero rows past L)
+  bk     [B, C, S+2W, d]  context slabs (eout for sg, ein for cbow — the
+                          matrix-role swap of Word2Vec.cpp:300-315 vs
+                          :330-351; zero rows outside)
   en     [B, KP, d]       shared negative rows ([1, KP, d] batch scope)
   tok_c  [B, C, S]        center token ids, -1 past row end
   tok_k  [B, C, S+2W]     slab token ids, -1 outside (banded.slab_token_ids)
@@ -38,8 +41,10 @@ Layout contract (all pre-chunked by the caller with ops/banded helpers):
   alpha  scalar           learning rate
 
 Outputs:
-  d_h        [B, C, S, d]     center-row gradient (positives + negatives)
-  d_ctx      [B, C, S+2W, d]  context-row gradient, slab space
+  d_h        [B, C, S, d]     center-row gradient (positives + negatives
+                              for sg; the center's emb_out update for cbow)
+  d_ctx      [B, C, S+2W, d]  context-row gradient, slab space (onto
+                              emb_out for sg, emb_in for cbow)
   d_neg      [B, KP, d]       negative-row gradient (accumulated over C;
                               [1, KP, d] batch scope, accumulated over B too)
   n_ctx      [B, C, S]        active contexts per center (band row sums)
@@ -84,11 +89,20 @@ def _band_kernel(
     K: int,
     cdt,
     neg_shared: bool,
+    is_cbow: bool,
+    cbow_mean: bool,
 ):
     b = pl.program_id(0)
     c = pl.program_id(1)
     S = a_ref.shape[2]
     SK = bk_ref.shape[2]  # S + 2W
+
+    def dot(x, y, dims):
+        return jax.lax.dot_general(
+            x.astype(cdt), y.astype(cdt), (dims, ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
     alpha = alpha_ref[0, 0]
 
     # ---- band mask [S, S+2W]: keep_i & valid_j & 0 < |i-j| <= w_eff_i
@@ -107,29 +121,22 @@ def _band_kernel(
     nctx_ref[0, 0, :] = n_ctx
     ctxw_ref[0, 0, :] = jnp.sum(mask, axis=0)
 
-    # ---- positive side: band logits + both gradient contractions, in VMEM
     a = a_ref[0, 0]
     bk = bk_ref[0, 0]
-    plog = jax.lax.dot_general(
-        a.astype(cdt), bk.astype(cdt),
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [S, S+2W]
-    gp = (1.0 - jax.nn.sigmoid(plog)) * mask * alpha
-    d_h = jax.lax.dot_general(
-        gp.astype(cdt), bk.astype(cdt),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [S, d]
-    d_ctx_ref[0, 0] = jax.lax.dot_general(
-        gp.astype(cdt), a.astype(cdt),
-        (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [S+2W, d]
-    pos_loss = -jnp.sum(mask * jax.nn.log_sigmoid(plog))
+
+    # ---- projection h per center (Word2Vec.cpp:300-302 vs :330) and the
+    # reference draw count k_i each shared draw stands in for
+    if not is_cbow:
+        h = a  # center row of emb_in
+        k_i = n_ctx * float(K)
+    else:
+        h = dot(mask, bk, ((1,), (0,)))  # sum of context rows of emb_in
+        if cbow_mean:
+            h = h / jnp.maximum(n_ctx, 1.0)[:, None]
+        k_i = jnp.where(n_ctx > 0.0, float(K), 0.0)
 
     # ---- negative side: shared draws, collision-masked per center
-    # (center/context-collision semantics of band_step.py lines 233-252)
+    # (center/context-collision semantics of band_step.py)
     en = en_ref[0]
     negs = negs_ref[0, :]
     center_hit = (tokc_ref[0, 0, :][:, None] == negs[None, :]).astype(
@@ -138,31 +145,44 @@ def _band_kernel(
     hit_k = (tokk_ref[0, 0, :][:, None] == negs[None, :]).astype(
         jnp.float32
     )  # [S+2W, KP]
-    ctx_hit = jax.lax.dot_general(
-        mask.astype(cdt), hit_k.astype(cdt),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [S, KP]
+    ctx_hit = dot(mask, hit_k, ((1,), (0,)))  # [S, KP]
     neg_ok = 1.0 - jnp.clip(center_hit + ctx_hit, 0.0, 1.0)
     KP = neg_ok.shape[1]
-    w_neg = (n_ctx * (float(K) / float(KP)))[:, None] * neg_ok  # [S, KP]
-    nlog = jax.lax.dot_general(
-        a.astype(cdt), en.astype(cdt),
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [S, KP]
+    w_neg = (k_i / float(KP))[:, None] * neg_ok  # [S, KP]
+    nlog = dot(h, en, ((1,), (1,)))  # [S, KP]
     gn = (0.0 - jax.nn.sigmoid(nlog)) * w_neg * alpha
-    d_h_ref[0, 0] = d_h + jax.lax.dot_general(
-        gn.astype(cdt), en.astype(cdt),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    d_neg_c = jax.lax.dot_general(
-        gn.astype(cdt), a.astype(cdt),
-        (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [KP, d]
+    d_hid = dot(gn, en, ((1,), (0,)))  # [S, d] hidden grad, negatives
+    d_neg_c = dot(gn, h, ((0,), (0,)))  # [KP, d]
     neg_loss = -jnp.sum(w_neg * (jax.nn.log_sigmoid(nlog) - nlog))
+
+    # ---- positive side + gradient routing
+    if not is_cbow:
+        plog = dot(a, bk, ((1,), (1,)))  # [S, S+2W] band logits
+        gp = (1.0 - jax.nn.sigmoid(plog)) * mask * alpha
+        # center rows accumulate positive + negative hidden grads
+        d_h_ref[0, 0] = d_hid + dot(gp, bk, ((1,), (0,)))
+        # context rows of emb_out, slab space
+        d_ctx_ref[0, 0] = dot(gp, a, ((0,), (0,)))
+        pos_loss = -jnp.sum(mask * jax.nn.log_sigmoid(plog))
+    else:
+        # positive target = center word on the OUT matrix (a), scored
+        # against the projection (Word2Vec.cpp:304-311). Operands round
+        # to the compute dtype exactly like the XLA einsum (products and
+        # accumulation stay f32 — MXU semantics).
+        plog_c = jnp.sum(
+            h.astype(cdt).astype(jnp.float32)
+            * a.astype(cdt).astype(jnp.float32),
+            axis=1,
+        )  # [S]
+        active = (n_ctx > 0.0).astype(jnp.float32)
+        gp = (1.0 - jax.nn.sigmoid(plog_c)) * active * alpha  # [S]
+        d_h_ref[0, 0] = gp[:, None] * h  # center's emb_out update
+        d_hid = d_hid + gp[:, None] * a
+        if cbow_mean:  # second divide (Word2Vec.cpp:313-315 semantics)
+            d_hid = d_hid / jnp.maximum(n_ctx, 1.0)[:, None]
+        # fan the hidden grad to contributing context rows of emb_in
+        d_ctx_ref[0, 0] = dot(mask, d_hid, ((0,), (0,)))
+        pos_loss = -jnp.sum(active * jax.nn.log_sigmoid(plog_c))
 
     # ---- accumulations across the sequential grid
     fresh = jnp.logical_and(b == 0, c == 0) if neg_shared else (c == 0)
@@ -184,7 +204,8 @@ def _band_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("W", "K", "cdt", "interpret")
+    jax.jit,
+    static_argnames=("W", "K", "cdt", "is_cbow", "cbow_mean", "interpret"),
 )
 def band_core(
     a: jnp.ndarray,       # [B, C, S, d]
@@ -200,6 +221,8 @@ def band_core(
     W: int,
     K: int,
     cdt=jnp.bfloat16,
+    is_cbow: bool = False,
+    cbow_mean: bool = True,
     interpret: bool = False,
 ):
     """One fused pass over the band; see the module docstring contract.
@@ -262,7 +285,8 @@ def band_core(
         sds((1, 2)),
     ]
     kernel = functools.partial(
-        _band_kernel, W=W, K=K, cdt=cdt, neg_shared=neg_shared
+        _band_kernel, W=W, K=K, cdt=cdt, neg_shared=neg_shared,
+        is_cbow=is_cbow, cbow_mean=cbow_mean,
     )
     return pl.pallas_call(
         kernel,

@@ -161,6 +161,43 @@ def test_pallas_cbow_sum_projection_matches_xla():
         )
 
 
+@pytest.mark.parametrize("model,scope,window", [
+    ("sg", "row", 5), ("cbow", "row", 5),
+    ("sg", "batch", 5), ("sg", "row", 10),
+])
+def test_kernel_lowers_to_mosaic(model, scope, window):
+    """Cross-platform AOT export runs the REAL Mosaic TPU pass on the CPU
+    host, so kernel/compiler incompatibilities (block-tiling rules, scalar
+    VMEM stores, float iota — each caught this way on 2026-07-31) surface
+    in CI instead of burning a live-tunnel measurement window. Shapes are
+    the flagship bench geometry (dim=300, S=118 at w=5 / S=108 at w=10)."""
+    import functools
+
+    from word2vec_tpu.ops.pallas_band import band_core
+
+    B, C, d, KP = 2, 2, 300, 8
+    S = 128 - 2 * window
+    SK = S + 2 * window
+    NB = 1 if scope == "batch" else B
+    args = (
+        jnp.zeros((B, C, S, d), jnp.float32),
+        jnp.zeros((B, C, SK, d), jnp.float32),
+        jnp.zeros((NB, KP, d), jnp.float32),
+        jnp.zeros((B, C, S), jnp.int32),
+        jnp.zeros((B, C, SK), jnp.int32),
+        jnp.zeros((B, C, S), jnp.float32),
+        jnp.ones((B, C, S), jnp.float32),
+        jnp.zeros((NB, KP), jnp.int32),
+        jnp.float32(0.025),
+    )
+    fn = functools.partial(
+        band_core, W=window, K=5, cdt=jnp.bfloat16,
+        is_cbow=model == "cbow", interpret=False,
+    )
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    assert len(exp.mlir_module_serialized) > 0
+
+
 def test_pallas_rejects_unsupported_routes():
     cfg = Word2VecConfig(
         model="sg", train_method="ns", negative=3, word_dim=D,

@@ -72,17 +72,17 @@ def _band_kernel(
     a_ref,      # [1, 1, S, d]
     bk_ref,     # [1, 1, S+2W, d]
     en_ref,     # [1, KP, d]
-    tokc_ref,   # [1, 1, S] int32
-    tokk_ref,   # [1, 1, S+2W] int32
-    keep_ref,   # [1, 1, S] f32
-    wc_ref,     # [1, 1, S] f32
-    negs_ref,   # [1, KP] int32
+    tokc_ref,   # [1, 1, 1, S] int32
+    tokk_ref,   # [1, 1, 1, S+2W] int32
+    keep_ref,   # [1, 1, 1, S] f32
+    wc_ref,     # [1, 1, 1, S] f32
+    negs_ref,   # [1, 1, KP] int32
     d_h_ref,    # [1, 1, S, d]
     d_ctx_ref,  # [1, 1, S+2W, d]
     d_neg_ref,  # [1, KP, d]
-    nctx_ref,   # [1, 1, S]
-    ctxw_ref,   # [1, 1, S+2W]
-    wns_ref,    # [1, KP]
+    nctx_ref,   # [1, 1, 1, S]
+    ctxw_ref,   # [1, 1, 1, S+2W]
+    wns_ref,    # [1, 1, KP]
     loss_ref,   # [1, 2]
     *,
     W: int,
@@ -107,19 +107,20 @@ def _band_kernel(
 
     # ---- band mask [S, S+2W]: keep_i & valid_j & 0 < |i-j| <= w_eff_i
     # (Word2Vec.cpp:282,285-287,332,335-337 gates, as in banded.band_mask)
-    s_iota = jax.lax.broadcasted_iota(jnp.float32, (S, SK), 0)
-    k_iota = jax.lax.broadcasted_iota(jnp.float32, (S, SK), 1)
-    dist = jnp.abs(s_iota + float(W) - k_iota)
-    valid_k = (tokk_ref[0, 0, :] >= 0).astype(jnp.float32)
+    # int32 iota (Mosaic rejects float iota), |i + W - j| exact in i32
+    s_iota = jax.lax.broadcasted_iota(jnp.int32, (S, SK), 0)
+    k_iota = jax.lax.broadcasted_iota(jnp.int32, (S, SK), 1)
+    dist = jnp.abs(s_iota + W - k_iota).astype(jnp.float32)
+    valid_k = (tokk_ref[0, 0, 0, :] >= 0).astype(jnp.float32)
     mask = (
-        keep_ref[0, 0, :][:, None]
+        keep_ref[0, 0, 0, :][:, None]
         * valid_k[None, :]
-        * (dist <= wc_ref[0, 0, :][:, None]).astype(jnp.float32)
+        * (dist <= wc_ref[0, 0, 0, :][:, None]).astype(jnp.float32)
         * (dist > 0.0).astype(jnp.float32)
     )
     n_ctx = jnp.sum(mask, axis=1)  # [S]
-    nctx_ref[0, 0, :] = n_ctx
-    ctxw_ref[0, 0, :] = jnp.sum(mask, axis=0)
+    nctx_ref[0, 0, 0, :] = n_ctx
+    ctxw_ref[0, 0, 0, :] = jnp.sum(mask, axis=0)
 
     a = a_ref[0, 0]
     bk = bk_ref[0, 0]
@@ -138,11 +139,11 @@ def _band_kernel(
     # ---- negative side: shared draws, collision-masked per center
     # (center/context-collision semantics of band_step.py)
     en = en_ref[0]
-    negs = negs_ref[0, :]
-    center_hit = (tokc_ref[0, 0, :][:, None] == negs[None, :]).astype(
+    negs = negs_ref[0, 0, :]
+    center_hit = (tokc_ref[0, 0, 0, :][:, None] == negs[None, :]).astype(
         jnp.float32
     )  # [S, KP]
-    hit_k = (tokk_ref[0, 0, :][:, None] == negs[None, :]).astype(
+    hit_k = (tokk_ref[0, 0, 0, :][:, None] == negs[None, :]).astype(
         jnp.float32
     )  # [S+2W, KP]
     ctx_hit = dot(mask, hit_k, ((1,), (0,)))  # [S, KP]
@@ -193,14 +194,14 @@ def _band_kernel(
         wns_ref[...] = jnp.zeros_like(wns_ref)
 
     d_neg_ref[0] += d_neg_c
-    wns_ref[0, :] += jnp.sum(w_neg, axis=0)
+    wns_ref[0, 0, :] += jnp.sum(w_neg, axis=0)
 
     @pl.when(jnp.logical_and(b == 0, c == 0))
     def _():
         loss_ref[...] = jnp.zeros_like(loss_ref)
 
-    loss_ref[0, 0] += pos_loss
-    loss_ref[0, 1] += neg_loss
+    # vector store: Mosaic cannot store scalars to VMEM
+    loss_ref[0, :] = loss_ref[0, :] + jnp.stack([pos_loss, neg_loss])
 
 
 @functools.partial(
@@ -239,17 +240,15 @@ def band_core(
     def sds(shape):
         return jax.ShapeDtypeStruct(shape, jnp.float32)
 
+    # Rank-3 payloads (tok/keep/w/n_ctx/ctx_w) are passed with a singleton
+    # axis before their last dim so every block's trailing two dims equal
+    # the array's (Mosaic tiling rule: last two block dims must divide
+    # (8, 128) or equal the array dims).
     def bc4(i, j):
         return (i, j, 0, 0)
 
-    def bc3(i, j):
-        return (i, j, 0)
-
     def nb3(i, j):
         return (0 if neg_shared else i, 0, 0)
-
-    def nb2(i, j):
-        return (0 if neg_shared else i, 0)
 
     grid_spec = pl.GridSpec(
         grid=(B, C),
@@ -259,19 +258,19 @@ def band_core(
             pl.BlockSpec((1, 1, S, d), bc4),
             pl.BlockSpec((1, 1, SK, d), bc4),
             pl.BlockSpec((1, KP, d), nb3),
-            pl.BlockSpec((1, 1, S), bc3),
-            pl.BlockSpec((1, 1, SK), bc3),
-            pl.BlockSpec((1, 1, S), bc3),
-            pl.BlockSpec((1, 1, S), bc3),
-            pl.BlockSpec((1, KP), nb2),
+            pl.BlockSpec((1, 1, 1, S), bc4),
+            pl.BlockSpec((1, 1, 1, SK), bc4),
+            pl.BlockSpec((1, 1, 1, S), bc4),
+            pl.BlockSpec((1, 1, 1, S), bc4),
+            pl.BlockSpec((1, 1, KP), nb3),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, S, d), bc4),
             pl.BlockSpec((1, 1, SK, d), bc4),
             pl.BlockSpec((1, KP, d), nb3),
-            pl.BlockSpec((1, 1, S), bc3),
-            pl.BlockSpec((1, 1, SK), bc3),
-            pl.BlockSpec((1, KP), nb2),
+            pl.BlockSpec((1, 1, 1, S), bc4),
+            pl.BlockSpec((1, 1, 1, SK), bc4),
+            pl.BlockSpec((1, 1, KP), nb3),
             pl.BlockSpec((1, 2), lambda i, j: (0, 0)),
         ],
     )
@@ -279,24 +278,31 @@ def band_core(
         sds((B, C, S, d)),
         sds((B, C, SK, d)),
         sds((NB, KP, d)),
-        sds((B, C, S)),
-        sds((B, C, SK)),
-        sds((NB, KP)),
+        sds((B, C, 1, S)),
+        sds((B, C, 1, SK)),
+        sds((NB, 1, KP)),
         sds((1, 2)),
     ]
     kernel = functools.partial(
         _band_kernel, W=W, K=K, cdt=cdt, neg_shared=neg_shared,
         is_cbow=is_cbow, cbow_mean=cbow_mean,
     )
-    return pl.pallas_call(
+    pl_call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
-    )(
+    )
+    outs = pl_call(
         jnp.asarray(alpha, jnp.float32).reshape(1, 1),
         a, bk, en,
-        tok_c, tok_k,
-        keep_c.astype(jnp.float32), w_c.astype(jnp.float32),
-        negs,
+        tok_c[:, :, None], tok_k[:, :, None],
+        keep_c.astype(jnp.float32)[:, :, None],
+        w_c.astype(jnp.float32)[:, :, None],
+        negs[:, None],
+    )
+    d_h, d_ctx, d_neg, nctx, ctxw, wns, losses = outs
+    return (
+        d_h, d_ctx, d_neg,
+        nctx[:, :, 0], ctxw[:, :, 0], wns[:, 0], losses,
     )

@@ -37,6 +37,10 @@ run_item pallas_b512          900 "$TPU" $B --band-backend pallas --batch-rows 5
 run_item pallas_b512_c96      900 "$TPU" $B --band-backend pallas --batch-rows 512 --chunk-cap 96
 # BASELINE config 2 (cbow dim=100) through the fused kernel's cbow branch
 run_item cbow_dim100_pallas   900 "$TPU" $B --model cbow --dim 100 --band-backend pallas
+# bf16 tables + SR through the kernel: pallas shrinks the step's middle,
+# bf16 halves the gather/scatter edges that remain outside it
+run_item pallas_bf16sr        900 "$TPU" $B --band-backend pallas --table-dtype bfloat16 --sr 1
+run_item pallas_bf16sr_b512   900 "$TPU" $B --band-backend pallas --table-dtype bfloat16 --sr 1 --batch-rows 512
 
 # --- combos over queue4 singles ---------------------------------------------
 run_item b512_c96             900 "$TPU" $B --batch-rows 512 --chunk-cap 96

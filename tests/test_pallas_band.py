@@ -161,11 +161,49 @@ def test_pallas_cbow_sum_projection_matches_xla():
         )
 
 
-@pytest.mark.parametrize("model,scope,window", [
-    ("sg", "row", 5), ("cbow", "row", 5),
-    ("sg", "batch", 5), ("sg", "row", 10),
+@pytest.mark.parametrize("sr", [False, True])
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_pallas_bf16_tables_match_xla_slab_path(model, sr):
+    """bf16 table storage (± destination-grid stochastic rounding): the
+    pallas tail mirrors the XLA SLAB path's value orderings and SR stream
+    indices (0=in, 1=out, 2=negatives), so given the same key the two
+    backends quantize the same deltas against the same dest rows in the
+    same order. Tolerance = one bf16 ulp class: the kernel's f32 deltas
+    differ from the XLA chain's by reassociation (~1e-7), which can flip
+    an SR draw sitting exactly at its threshold."""
+    import dataclasses
+
+    tokens = _tokens()
+    cfg = Word2VecConfig(
+        model=model, train_method="ns", negative=3, word_dim=D,
+        window=3, min_count=1, subsample_threshold=0,
+        compute_dtype="float32", shared_negatives=8,
+        max_sentence_len=40, band_chunk=10, scatter_mean=True,
+        dtype="bfloat16", stochastic_rounding=sr,
+        slab_scatter=True,  # the XLA path with matching SR value order
+    )
+    step_a = jax.jit(make_band_train_step(cfg, _tables(cfg)))
+    cfg_p = dataclasses.replace(cfg, slab_scatter=False,
+                                band_backend="pallas")
+    step_b = jax.jit(make_band_train_step(cfg_p, _tables(cfg_p)))
+    params = init_params(cfg, V, jax.random.key(1))
+
+    pa, _ = step_a(dict(params), tokens, jax.random.key(9), jnp.float32(0.03))
+    pb, _ = step_b(dict(params), tokens, jax.random.key(9), jnp.float32(0.03))
+    for k in pa:
+        va, vb = np.asarray(pa[k], np.float32), np.asarray(pb[k], np.float32)
+        ulp = np.spacing(np.abs(va).astype(np.float32)) * 2.0**16  # bf16 ulp
+        assert np.all(np.abs(va - vb) <= np.maximum(2 * ulp, 1e-6)), (
+            k, float(np.max(np.abs(va - vb)))
+        )
+
+
+@pytest.mark.parametrize("model,scope,window,tdt", [
+    ("sg", "row", 5, jnp.float32), ("cbow", "row", 5, jnp.float32),
+    ("sg", "batch", 5, jnp.float32), ("sg", "row", 10, jnp.float32),
+    ("sg", "row", 5, jnp.bfloat16),
 ])
-def test_kernel_lowers_to_mosaic(model, scope, window):
+def test_kernel_lowers_to_mosaic(model, scope, window, tdt):
     """Cross-platform AOT export runs the REAL Mosaic TPU pass on the CPU
     host, so kernel/compiler incompatibilities (block-tiling rules, scalar
     VMEM stores, float iota — each caught this way on 2026-07-31) surface
@@ -180,9 +218,9 @@ def test_kernel_lowers_to_mosaic(model, scope, window):
     SK = S + 2 * window
     NB = 1 if scope == "batch" else B
     args = (
-        jnp.zeros((B, C, S, d), jnp.float32),
-        jnp.zeros((B, C, SK, d), jnp.float32),
-        jnp.zeros((NB, KP, d), jnp.float32),
+        jnp.zeros((B, C, S, d), tdt),
+        jnp.zeros((B, C, SK, d), tdt),
+        jnp.zeros((NB, KP, d), tdt),
         jnp.zeros((B, C, S), jnp.int32),
         jnp.zeros((B, C, SK), jnp.int32),
         jnp.zeros((B, C, S), jnp.float32),

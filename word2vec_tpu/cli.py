@@ -140,7 +140,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--band-backend", choices=["xla", "pallas"],
                    default="xla",
                    help="band step compute: XLA chain or the fused Pallas "
-                        "kernel (config.band_backend; sg+ns fp32 unfused)")
+                        "kernel (config.band_backend; sg/cbow + ns, "
+                        "f32 or bf16 tables, unfused, single-chip)")
     p.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                    help="band kernel: scatter context grads from slab space "
                         "(skips the overlap-add; config.slab_scatter)")

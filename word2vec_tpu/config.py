@@ -110,8 +110,9 @@ class Word2VecConfig:
     band_chunk: int = 0
     # Band-step compute backend: "xla" (ops/band_step.py chain of band
     # matmuls; every route/axis/dtype) or "pallas" (ops/pallas_band.py —
-    # one fused VMEM-resident kernel per (row, chunk); sg+ns fp32 unfused
-    # single-axis only, A/B perf lever for the on-chip sweep).
+    # one fused VMEM-resident kernel per (row, chunk); sg/cbow + ns,
+    # f32/bf16 tables ± SR, unfused, single-chip only; A/B perf lever
+    # for the on-chip sweep).
     band_backend: str = "xla"
 
     # Batched-update stabilizer. The reference's Hogwild updates are sequential:

@@ -177,12 +177,15 @@ def make_band_train_step(
                 # up front (parallel/trainer._reject_pallas — shard_map
                 # cannot host the kernel, see ops/pallas_band.py scope note)
                 (dp_axis is not None, "data-parallel sharding"),
-                (config.dtype != "float32", f"table dtype {config.dtype}"),
+                # only the dtypes whose Mosaic tiling the kernel's block
+                # specs were validated for
+                (config.dtype not in ("float32", "bfloat16"),
+                 f"table dtype {config.dtype}"),
             ] if cond
         ]
         if unsupported:
             raise ValueError(
-                "band_backend='pallas' covers the sg/cbow ns fp32 unfused "
+                "band_backend='pallas' covers the sg/cbow ns unfused "
                 "single-chip step (ops/pallas_band.py); unsupported here: "
                 + ", ".join(unsupported)
             )
@@ -536,6 +539,8 @@ def make_band_train_step(
     ) -> Tuple[Params, Metrics]:
         B, L = tokens.shape
         k_sub, k_win, k_neg = jax.random.split(key, 3)
+        # same stream indices as the XLA tail (0=in, 1=out, 2=negatives)
+        k_sr = _sr_streams(key, sr)
 
         valid = tokens >= 0
         tok = jnp.where(valid, tokens, 0)
@@ -673,11 +678,27 @@ def make_band_train_step(
 
         new_params = dict(params)
         new_params["emb_in"] = emb_in.at[in_idx].add(
-            in_vals, indices_are_sorted=True
+            _cast_update(
+                in_vals, emb_in.dtype, k_sr(0),
+                emb_in[in_idx] if sr else None,
+            ),
+            indices_are_sorted=True,
         )
-        new_params["emb_out_ns"] = (
-            emb_out.at[out_idx].add(out_vals, indices_are_sorted=True)
-            .at[flat_negs].add(d_neg_flat)
+        new_out = emb_out.at[out_idx].add(
+            _cast_update(
+                out_vals, emb_out.dtype, k_sr(1),
+                emb_out[out_idx] if sr else None,
+            ),
+            indices_are_sorted=True,
+        )
+        # SR dest rows for the negative scatter come from NEW_out — the
+        # scatter above may have moved a shared row across a binade
+        # (band_step XLA tail, same note)
+        new_params["emb_out_ns"] = new_out.at[flat_negs].add(
+            _cast_update(
+                d_neg_flat, emb_out.dtype, k_sr(2),
+                new_out[flat_negs] if sr else None,
+            )
         )
         metrics = {
             "loss_sum": losses[0, 0] + losses[0, 1],

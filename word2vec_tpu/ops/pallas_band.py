@@ -17,7 +17,9 @@ XLA kernel by tests/test_pallas_band.py.
 
 Scope (config.band_backend="pallas"; band_step falls back to the XLA chain
 otherwise): sg or cbow + negative sampling, per-row or batch negative scope,
-unfused f32 tables, chunked band representation (S > 0), SINGLE-CHIP ONLY
+unfused tables (f32 or bf16, with or without stochastic rounding — the SR
+quantization happens in the caller's scatters, outside the kernel),
+chunked band representation (S > 0), SINGLE-CHIP ONLY
 (plain Trainer; sharded trainers reject it up front — pallas_call under
 shard_map is unvalidatable here: the interpreter's internals are not
 vma-aware, and no multi-chip hardware exists to compile the real thing;

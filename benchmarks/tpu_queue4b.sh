@@ -41,6 +41,9 @@ run_item cbow_dim100_pallas   900 "$TPU" $B --model cbow --dim 100 --band-backen
 # bf16 halves the gather/scatter edges that remain outside it
 run_item pallas_bf16sr        900 "$TPU" $B --band-backend pallas --table-dtype bfloat16 --sr 1
 run_item pallas_bf16sr_b512   900 "$TPU" $B --band-backend pallas --table-dtype bfloat16 --sr 1 --batch-rows 512
+# batch-scoped negatives through the kernel (NB=1 block sharing): one
+# [KP,d] negative block revisited across the whole grid
+run_item pallas_negbatch      900 "$TPU" $B --band-backend pallas --neg-scope batch --kp 256
 
 # --- combos over queue4 singles ---------------------------------------------
 run_item b512_c96             900 "$TPU" $B --batch-rows 512 --chunk-cap 96

@@ -23,15 +23,25 @@ fi
 
 echo "$(date -u +%FT%TZ) queue started pid=$$" >> "$LOG"
 
+# Per-OPERATION chip lock, distinct from the lifetime instance guard above:
+# held only while something actually touches the TPU (a probe, one bench
+# item, a trace). bench.py acquires the same lock with a bounded wait when
+# invoked OUTSIDE the queue (the round-end driver run), so the official
+# BENCH artifact never races a queue item on the one chip — and the queue's
+# probes block while such a run holds it, instead of perturbing it.
+CHIP=benchmarks/.chip.lock
+
 # -k 10: the axon tunnel's failure mode is a HANG in an uninterruptible read;
 # without a kill-after, `timeout`'s SIGTERM is ignored and the queue (and its
 # heartbeat) wedges behind the child forever.
-probe() { timeout -k 10 75 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1 9>&-; }
+probe() { flock -w 3600 "$CHIP" timeout -k 10 75 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1 9>&-; }
 
-# Heartbeat cadence: a failed-probe iteration costs up to 85 s (probe
-# timeout+kill on a hung tunnel) + 110 s sleep ~= 195 s, so
+# Heartbeat cadence: a failed-probe iteration normally costs up to 85 s
+# (probe timeout+kill on a hung tunnel) + 110 s sleep ~= 195 s, so
 # HEARTBEAT_EVERY=20 logs one line per ~65 min of dead tunnel (worst case;
-# ~40 min if probes fail fast).
+# ~40 min if probes fail fast). A probe can also block on the chip lock
+# behind an outside bench run (up to 3600 s), so a failed probe means
+# "tunnel down OR chip busy elsewhere" — the heartbeat says so.
 HEARTBEAT_EVERY=${HEARTBEAT_EVERY:-20}
 FAILED_PROBES=0
 wait_for_chip() {
@@ -39,7 +49,7 @@ wait_for_chip() {
   until probe; do
     FAILED_PROBES=$((FAILED_PROBES + 1)); waited=$((waited + 1))
     if [ $((FAILED_PROBES % HEARTBEAT_EVERY)) -eq 0 ]; then
-      echo "$(date -u +%FT%TZ) heartbeat: $FAILED_PROBES probes failed so far, tunnel still down" >> "$LOG"
+      echo "$(date -u +%FT%TZ) heartbeat: $FAILED_PROBES probes failed so far (tunnel down or chip held elsewhere)" >> "$LOG"
     fi
     sleep 110 9>&-
   done
@@ -56,10 +66,25 @@ run_item() {
   [ -s "$OUT/$name.json" ] && return 0
   wait_for_chip
   echo "$(date -u +%FT%TZ) start $name: $*" >> "$LOG"
-  # the 9>&- covers the whole pipeline group: tail must not inherit the lock
-  # fd either, or a wedged bench holding the pipe keeps tail (and the flock)
-  # alive after the queue itself is killed
-  { timeout -k 10 "$tmo" "$@" 2>>"$OUT/$name.stderr" | tail -1 > "$OUT/$name.tmp"; } 9>&-
+  # Chip lock on fd 8, held by THIS shell for the item's duration (closed
+  # for children like fd 9). The wait covers a full outside bench run
+  # (bench.py holds the lock until exit, run-timeout 3600 s) with slack; a
+  # timeout leaves the item UNBANKED (no .failed) so the next queue
+  # launch retries it, and logs the distinct reason.
+  exec 8>"$CHIP"
+  if ! flock -w 4500 8; then
+    echo "$(date -u +%FT%TZ) chip lock busy >4500s; leaving $name for retry" >> "$LOG"
+    exec 8>&-
+    return 0
+  fi
+  # the 9>&- 8>&- covers the whole pipeline group: tail must not inherit
+  # the lock fds, or a wedged bench holding the pipe keeps tail (and the
+  # locks) alive after the queue itself is killed. W2V_CHIP_LOCK_HELD
+  # tells the item's own bench.py not to re-acquire the chip lock its
+  # parent already holds.
+  { W2V_CHIP_LOCK_HELD=1 timeout -k 10 "$tmo" "$@" 2>>"$OUT/$name.stderr" \
+      | tail -1 > "$OUT/$name.tmp"; } 9>&- 8>&-
+  exec 8>&-
   if grep -q "$marker" "$OUT/$name.tmp" 2>/dev/null \
      && python -c "import json,sys; json.loads(sys.stdin.read())" < "$OUT/$name.tmp" 2>/dev/null; then
     mv "$OUT/$name.tmp" "$OUT/$name.json"
@@ -81,7 +106,8 @@ run_trace() {
   [ -s "$OUT/trace_report.txt" ] && return 0
   wait_for_chip
   echo "$(date -u +%FT%TZ) start trace" >> "$LOG"
-  timeout -k 10 900 python benchmarks/trace_tools.py capture --out "$tmpdir" \
+  flock -w 4500 "$CHIP" timeout -k 10 900 \
+    python benchmarks/trace_tools.py capture --out "$tmpdir" \
     >> "$OUT/trace_capture.out" 2>&1 9>&-
   timeout -k 10 300 python benchmarks/trace_tools.py report "$tmpdir" \
     > "$OUT/trace_report.tmp" 2>&1 9>&-

@@ -201,7 +201,8 @@ def test_pallas_bf16_tables_match_xla_slab_path(model, sr):
 @pytest.mark.parametrize("model,scope,window,tdt", [
     ("sg", "row", 5, jnp.float32), ("cbow", "row", 5, jnp.float32),
     ("sg", "batch", 5, jnp.float32), ("sg", "row", 10, jnp.float32),
-    ("sg", "row", 5, jnp.bfloat16),
+    ("sg", "row", 5, jnp.bfloat16), ("cbow", "row", 5, jnp.bfloat16),
+    ("sg", "batch", 5, jnp.bfloat16),
 ])
 def test_kernel_lowers_to_mosaic(model, scope, window, tdt):
     """Cross-platform AOT export runs the REAL Mosaic TPU pass on the CPU

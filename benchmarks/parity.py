@@ -158,6 +158,9 @@ def main() -> None:
                     default="row", help="negative pool scope for OUR side")
     ap.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                     help="band-kernel slab-space context scatter for OUR side")
+    ap.add_argument("--band-backend", choices=["xla", "pallas"],
+                    default="xla",
+                    help="band-step compute backend for OUR side")
     ap.add_argument("--prng", choices=["threefry", "rbg"], default="threefry",
                     help="jax PRNG impl for OUR side (CLI --prng)")
     ap.add_argument("--table-dtype", choices=["float32", "bfloat16"],
@@ -196,7 +199,10 @@ def main() -> None:
         "config": f"{args.model}+{args.train_method} k={args.negative} "
         f"dim={args.dim} w={args.window} iter={args.iters} "
         f"subsample={args.subsample} kernel={args.kernel} "
-        f"kp={args.shared_negatives} prng={args.prng}",
+        f"backend={args.band_backend} "
+        f"kp={args.shared_negatives} scope={args.negative_scope} "
+        f"dtype={args.table_dtype} sr={args.sr} "
+        f"slab={args.slab_scatter} prng={args.prng}",
         "corpus": corpus_name,
     }
     with tempfile.TemporaryDirectory() as tmp:
@@ -227,6 +233,7 @@ def main() -> None:
                 "--shared-negatives", str(args.shared_negatives),
                 "--negative-scope", args.negative_scope,
                 "--slab-scatter", str(args.slab_scatter),
+                "--band-backend", args.band_backend,
                 "--prng", args.prng,
                 "--table-dtype", args.table_dtype,
                 "--stochastic-rounding", str(args.sr),

@@ -34,7 +34,12 @@ CHIP=benchmarks/.chip.lock
 # -k 10: the axon tunnel's failure mode is a HANG in an uninterruptible read;
 # without a kill-after, `timeout`'s SIGTERM is ignored and the queue (and its
 # heartbeat) wedges behind the child forever.
-probe() { flock -w 3600 "$CHIP" timeout -k 10 75 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1 9>&-; }
+# 60 s probe budget: a LIVE tunnel initializes the backend in ~5-15 s
+# (measured; first-compile cost comes later, not at init), so 60 s only
+# bounds the hang case — and with the 50 s sleep below the dead-tunnel
+# detection cycle is ~2 min instead of ~3.5, which matters when the
+# tunnel surfaces for short windows (round 4's was 17 minutes total).
+probe() { flock -w 3600 "$CHIP" timeout -k 10 60 python -c "import jax; assert jax.devices()[0].platform=='tpu'" >/dev/null 2>&1 9>&-; }
 
 # Heartbeat cadence: a failed-probe iteration normally costs up to 85 s
 # (probe timeout+kill on a hung tunnel) + 110 s sleep ~= 195 s, so
@@ -51,7 +56,7 @@ wait_for_chip() {
     if [ $((FAILED_PROBES % HEARTBEAT_EVERY)) -eq 0 ]; then
       echo "$(date -u +%FT%TZ) heartbeat: $FAILED_PROBES probes failed so far (tunnel down or chip held elsewhere)" >> "$LOG"
     fi
-    sleep 110 9>&-
+    sleep 50 9>&-
   done
   [ "$waited" -gt 0 ] && echo "$(date -u +%FT%TZ) chip live after $waited failed probes" >> "$LOG"
 }

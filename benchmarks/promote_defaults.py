@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Decide lever defaults from banked evidence (VERDICT r3 item 6).
+
+Reads every banked on-chip bench record (benchmarks/TPU_R*/{name}.json)
+AND the round's full-budget parity matrix, then prints one decision line
+per lever: best banked words/sec vs the default config's, the lever's
+parity delta_margin vs the compiled reference, and a verdict. The
+PROMOTION RULE is mechanical and recorded here so a human (or the next
+round's builder) applies it rather than re-litigating:
+
+  promote a lever to default iff
+    (a) its banked on-chip words/sec >= the default config's on the SAME
+        metric/corpus scale (throughput not worse), AND
+    (b) its full-budget parity delta_margin vs the reference is within
+        the calibrated +-0.02 noise band or positive (quality not worse;
+        noise calibration: benchmarks/PARITY_CALIB_r4.jsonl), AND
+    (c) it needs no route/scope restriction a default must not have
+        (e.g. band_backend=pallas is single-chip only, so it can be the
+        BENCH default but not the library default).
+
+Usage: python benchmarks/promote_defaults.py
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+NOISE = 0.02  # calibrated reference run-to-run band (PARITY_CALIB_r4.jsonl)
+
+# lever item name -> (config substrings identifying its PARITY_MATRIX_r4
+# row, library-default eligibility note). Substrings match the matrix's
+# self-describing config field (backend/scope/dtype/sr/slab).
+LEVERS = {
+    "pallas": (("backend=pallas", "scope=row", "dtype=float32"),
+               "bench default only (single-chip; sharded trainers reject)"),
+    "pallas_b512": (("backend=pallas", "scope=row", "dtype=float32"),
+                    "bench default only (single-chip)"),
+    "pallas_c96": (("backend=pallas", "scope=row", "dtype=float32"),
+                   "bench default only (single-chip)"),
+    "pallas_b512_c96": (("backend=pallas", "scope=row", "dtype=float32"),
+                        "bench default only (single-chip)"),
+    "pallas_bf16sr": (("backend=pallas", "dtype=bfloat16", "sr=1"),
+                      "bench default only (single-chip)"),
+    "pallas_bf16sr_b512": (("backend=pallas", "dtype=bfloat16", "sr=1"),
+                           "bench default only (single-chip)"),
+    "pallas_negbatch": (("backend=pallas", "scope=batch"),
+                        "bench default only (single-chip)"),
+    "slab_sorted": (("backend=xla", "slab=1"),
+                    "library-eligible (all band routes)"),
+    "b512": (None, "library-eligible (geometry; parity-invariant)"),
+    "b1024": (None, "library-eligible (geometry; parity-invariant)"),
+    "chunk96": (None, "library-eligible (dispatch; trajectory-identical)"),
+    "c192": (None, "library-eligible (dispatch; trajectory-identical)"),
+    "b512_c96": (None, "library-eligible (geometry+dispatch)"),
+    "rbg": (None, "library-eligible (prng; r3 matrix delta +0.0081)"),
+    "negbatch_kp256": (("backend=xla", "scope=batch"),
+                       "library-eligible (quality-positive every budget)"),
+    "bf16sr": (("backend=xla", "dtype=bfloat16", "sr=1"),
+               "library-eligible (margin-neutral)"),
+    "fused": (None, "library-eligible (ns band only; bitwise-identical)"),
+    "kp32": (None, "library-eligible (r3 matrix delta +0.0139)"),
+}
+
+
+def load_parity_rows() -> list:
+    rows = []
+    path = os.path.join(HERE, "PARITY_MATRIX_r4.txt")
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        rows.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    except OSError:
+        pass
+    return rows
+
+
+def parity_delta(rows: list, selectors) -> float | None:
+    if selectors is None:
+        return None
+    for r in rows:
+        cfg = r.get("config", "")
+        if all(s in cfg for s in selectors) and "delta_margin" in r:
+            return r["delta_margin"]
+    return None
+
+
+def main() -> None:
+    records: dict = {}
+    for path in sorted(glob.glob(os.path.join(HERE, "TPU_R*", "*.json"))):
+        name = os.path.splitext(os.path.basename(path))[0]
+        try:
+            with open(path) as f:
+                rec = json.loads(f.read().strip().splitlines()[-1])
+        except (OSError, json.JSONDecodeError, IndexError):
+            continue
+        if rec.get("platform") != "tpu" or not isinstance(
+            rec.get("value"), (int, float)
+        ):
+            continue
+        key = (name, rec.get("metric"))
+        if key not in records or rec["value"] > records[key]["value"]:
+            records[key] = rec
+
+    base = records.get(
+        ("default", "sg+ns-dim300-w5-k5 words/sec (zipf-synthetic-17M, tpu)")
+    )
+    if base is None:
+        # fall back to any record named 'default'
+        cands = [r for (n, _), r in records.items() if n == "default"]
+        base = max(cands, key=lambda r: r["value"]) if cands else None
+    if base is None:
+        print("no banked on-chip 'default' record — nothing to compare")
+        return
+    print(
+        f"default: {base['value']:,.0f} words/sec "
+        f"({base.get('vs_baseline')}x baseline), metric "
+        f"{base.get('metric')!r} — the bar to beat\n"
+    )
+    parity = load_parity_rows()
+    for (name, metric), rec in sorted(records.items()):
+        if name == "default":
+            continue
+        selectors, note = LEVERS.get(name, (None, "unclassified"))
+        dm = parity_delta(parity, selectors)
+        q = (
+            "no parity row" if dm is None
+            else f"delta_margin {dm:+.4f} "
+            + ("OK" if dm >= -NOISE else "QUALITY-NEGATIVE")
+        )
+        if metric != base.get("metric"):
+            verdict = f"INCOMPARABLE (metric {metric!r})"
+        else:
+            ratio = rec["value"] / base["value"]
+            if ratio < 1.0:
+                verdict = f"{ratio:5.2f}x default -> KEEP default"
+            elif dm is not None and dm < -NOISE:
+                verdict = f"{ratio:5.2f}x default -> BLOCKED by quality"
+            else:
+                verdict = f"{ratio:5.2f}x default -> PROMOTE ({note})"
+        print(f"{name:22s} {rec['value']:>12,.0f} w/s  [{q}]  {verdict}")
+
+
+if __name__ == "__main__":
+    main()

@@ -237,6 +237,45 @@ def test_kernel_lowers_to_mosaic(model, scope, window, tdt):
     assert len(exp.mlir_module_serialized) > 0
 
 
+def test_full_resident_runner_lowers_to_mosaic_with_pallas():
+    """The whole bench-path program — resident batch assembly, the pallas
+    step inside lax.scan, sorted scatters, metrics — must lower for TPU,
+    not just the kernel in isolation. Same cross-platform AOT trick as
+    test_kernel_lowers_to_mosaic, at the flagship geometry."""
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.models.params import init_params
+    from word2vec_tpu.ops import resident as res
+
+    Vv, d = 1000, 300
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=5, word_dim=d,
+        window=5, min_count=1, subsample_threshold=1e-4,
+        batch_rows=256, max_sentence_len=192,
+        band_backend="pallas", chunk_steps=8,
+    )
+    t = _tables(cfg)
+    # _tables builds for V=60; rebuild keep_probs at this vocab size
+    import dataclasses as _dc
+
+    t = _dc.replace(t, keep_probs=jnp.ones(Vv, jnp.float32))
+    rng = np.random.default_rng(0)
+    corpus = PackedCorpus.from_flat(
+        rng.integers(0, Vv, size=200_000).astype(np.int32),
+        cfg.max_sentence_len,
+    )
+    params = init_params(cfg, Vv, jax.random.key(0))
+    fn = res.make_resident_chunk_runner(cfg, t)
+    corpus_dev = {
+        k: jnp.asarray(v) for k, v in res.corpus_arrays(corpus).items()
+    }
+    order = jnp.arange(corpus.num_rows, dtype=jnp.int32)
+    alphas = jnp.full((8,), 0.025, jnp.float32)
+    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(
+        params, corpus_dev, order, jax.random.key(7), 0, 9999, alphas
+    )
+    assert len(exp.mlir_module_serialized) > 0
+
+
 def test_pallas_rejects_unsupported_routes():
     cfg = Word2VecConfig(
         model="sg", train_method="ns", negative=3, word_dim=D,

@@ -94,6 +94,10 @@ def main() -> None:
                     help="record into benchmarks/reference_baselines.json "
                     "keyed by config (the flagship single-file record is "
                     "left untouched)")
+    ap.add_argument("--force", action="store_true",
+                    help="replace the banked record even if it is faster "
+                    "or on a different corpus/config spec (intentional "
+                    "re-baseline)")
     args = ap.parse_args()
 
     k = args.negative if args.train_method == "ns" else 0
@@ -118,17 +122,52 @@ def main() -> None:
         "host_cpus": os.cpu_count(),
         "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
     }
+    def keep_reason(prev: dict | None) -> str | None:
+        """Why a banked baseline must NOT be replaced (None = replace).
+
+        A banked record is only replaceable by a run of the IDENTICAL
+        measurement spec (corpus AND config — config encodes
+        model/dim/window/k/threads) that measured FASTER. Guarded failure
+        modes: a slower re-measurement on a weaker host must not lower
+        the denominator (vs_baseline divides by the FASTEST measured
+        reference — the r4 host measured 22% below the banked r2-host
+        number, reference_baseline_r4host.json); and a different corpus
+        scale or config must never replace the record at all (a
+        200k-token corpus is cache-resident and measures ~2x faster —
+        not comparable). --force overrides for an intentional
+        re-baseline."""
+        if args.force or not prev:
+            return None
+        if prev.get("corpus") != out["corpus"]:
+            return "kept_existing_corpus_mismatch"
+        if prev.get("config") != out["config"]:
+            return "kept_existing_config_mismatch"
+        if prev.get("words_per_sec", 0) >= out["words_per_sec"]:
+            return "kept_existing_faster"
+        return None
+
     if args.multi:
         path = os.path.join(REPO, "benchmarks", "reference_baselines.json")
         table = {}
         if os.path.exists(path):
             with open(path) as f:
                 table = json.load(f)
+        prev = table.get(key)
+    else:
+        path = os.path.join(REPO, "benchmarks", "reference_baseline.json")
+        prev = None
+        if os.path.exists(path):
+            with open(path) as f:
+                prev = json.load(f)
+    reason = keep_reason(prev)
+    if reason is not None:
+        print(json.dumps({key: out, reason: prev}))
+        return
+    if args.multi:
         table[key] = out
         with open(path, "w") as f:
             json.dump(table, f, indent=2)
     else:
-        path = os.path.join(REPO, "benchmarks", "reference_baseline.json")
         with open(path, "w") as f:
             json.dump(out, f, indent=2)
     print(json.dumps({key: out}))

@@ -34,6 +34,21 @@ run_item fused        900 "$TPU" $B --fused 1
 run_item kp32         900 "$TPU" $B --kp 32
 run_item rbg          900 "$TPU" $B --prng rbg
 run_item slab_sorted  900 "$TPU" $B --slab-scatter 1
+
+# Fresh step trace with round-4 defaults, hoisted ahead of the combos: with
+# the tunnel surfacing in minutes-long windows, the trace is the one item
+# that tells us WHERE the 11.4 ms step goes (pallas tied default on-chip,
+# so the r2 cost model is stale) — it must not sit behind ~2 h of items.
+run_trace /tmp/tr_r4
+
+# BASELINE configs 2 & 3 + the w=10 shape (VERDICT r3 item 3), also hoisted:
+# per-config coverage beats combo resolution if the tunnel dies early.
+# vs the measured 672k / 426k / 87.4k reference baselines
+# (benchmarks/reference_baselines.json)
+run_item cbow_dim100  900 "$TPU" $B --model cbow --dim 100
+run_item hs_dim200    900 "$TPU" $B --train-method hs --dim 200
+run_item sg_w10       900 "$TPU" $B --window 10
+
 run_item pallas_b512_c96      900 "$TPU" $B --band-backend pallas --batch-rows 512 --chunk-cap 96
 # combos (each lever is independent machinery; measure the stack)
 run_item fused_kp32           900 "$TPU" $B --fused 1 --kp 32
@@ -49,14 +64,7 @@ run_item negbatch_kp256_fused_c96 900 "$TPU" $B --neg-scope batch --kp 256 --fus
 run_item bf16sr               900 "$TPU" $B --table-dtype bfloat16 --sr 1
 run_item bf16sr_fused_kp32_c96 900 "$TPU" $B --table-dtype bfloat16 --sr 1 --fused 1 --kp 32 --chunk-cap 96
 
-# --- phase 2: BASELINE configs 2 & 3 + the w=10 shape (VERDICT r3 item 3) ----
-# vs the measured 672k / 426k / 87.4k reference baselines
-# (benchmarks/reference_baselines.json)
-run_item cbow_dim100  900 "$TPU" $B --model cbow --dim 100
-run_item hs_dim200    900 "$TPU" $B --train-method hs --dim 200
-run_item sg_w10       900 "$TPU" $B --window 10
-
-# --- phase 3: quality at scale on chip (VERDICT r3 item 5) -------------------
+# --- phase 2: quality at scale on chip (VERDICT r3 item 5) -------------------
 # marker is the platform field (cli --emit-device → quality_full JSON): a
 # silent CPU fallback must not bank as an on-chip quality result
 run_item quality_hs_dim300 2400 "$TPU" \
@@ -66,10 +74,7 @@ run_item quality_sg_dim300 2400 "$TPU" \
 run_item quality_analogy_dim300 2400 "$TPU" \
   python benchmarks/quality_full.py --analogy --tokens 4000000
 
-# --- phase 4: enwik9-shape scale run (VERDICT r3 item 4) ---------------------
+# --- phase 3: enwik9-shape scale run (VERDICT r3 item 4) ---------------------
 run_item enwik9_100M 3600 "$TPU" $B --tokens 100000000 --window 10 --run-timeout 3000
-
-# --- phase 5: fresh step trace with round-4 defaults -------------------------
-run_trace /tmp/tr_r4
 
 echo "$(date -u +%FT%TZ) QUEUE COMPLETE after $FAILED_PROBES failed probes total" >> "$LOG"

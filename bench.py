@@ -173,6 +173,10 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         negative_scope=args.neg_scope,
         band_chunk=args.band_chunk,
         band_backend=args.band_backend,
+        hs_dense_top=args.hs_dense_top,  # config raises on ns+dense-top:
+                                         # a misconfigured item must fail
+                                         # loudly, not bank mislabeled
+        hs_tail_slots=args.hs_tail_slots,
         prng_impl=args.prng,
         dtype=args.table_dtype,
         stochastic_rounding=bool(args.sr),
@@ -329,6 +333,11 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     }
     if platform_note:
         record["tpu_fallback_reason"] = platform_note
+    if tables.hs_msig is not None:
+        # two-tier hs observability: the banked record shows what share of
+        # token-weighted path entries the measured dense tier covered
+        record["hs_dense_top"] = int(tables.hs_msig.shape[1])
+        record["hs_dense_coverage"] = round(tables.hs_dense_coverage, 4)
     return record
 
 
@@ -359,6 +368,11 @@ def build_parser() -> argparse.ArgumentParser:
                     "batch (single dense matmul, KP-row update scatter)")
     ap.add_argument("--band-chunk", type=int, default=0,
                     help="band slab row-chunk S (0 = auto; ops/banded.py)")
+    ap.add_argument("--hs-dense-top", type=int, default=0,
+                    help="two-tier hs: top-P dense tier (config.hs_dense_top)")
+    ap.add_argument("--hs-tail-slots", type=int, default=-1,
+                    help="two-tier hs tail compaction bound "
+                         "(config.hs_tail_slots)")
     ap.add_argument("--band-backend", choices=["xla", "pallas"],
                     default="xla",
                     help="band step compute: XLA chain or the fused Pallas "
@@ -517,6 +531,9 @@ def main() -> None:
         ("--chunk-cap", args.chunk_cap), ("--slab-scatter", args.slab_scatter),
         ("--kp", args.kp), ("--neg-scope", args.neg_scope),
         ("--band-chunk", args.band_chunk),
+        ("--band-backend", args.band_backend),
+        ("--hs-dense-top", args.hs_dense_top),
+        ("--hs-tail-slots", args.hs_tail_slots),
         ("--resident", args.resident), ("--fused", args.fused),
         ("--prng", args.prng), ("--table-dtype", args.table_dtype),
         ("--sr", args.sr),

@@ -28,6 +28,13 @@ TPU='"platform": "tpu"'
 #   - pallas: the fused VMEM-resident band kernel (ops/pallas_band.py) —
 #     replaces the whole matmul/copy/elementwise middle of the step, the
 #     segment the round-2 trace put at ~4.7 of 7.97 ms.
+# Two-tier hs update (config.hs_dense_top, built this round): dense-matmul
+# top-P tier + compacted tail scatter — A/B vs queue4's one-tier hs_dim200.
+# Early in the list: it is a brand-new lever with a ~3x step-time model
+# behind it (PERF.md "two-tier hs"), so its first on-chip number decides
+# whether to promote it for the hs configs.
+run_item hs_dim200_dense512   900 "$TPU" $B --train-method hs --dim 200 --hs-dense-top 512
+run_item hs_dim200_dense1024  900 "$TPU" $B --train-method hs --dim 200 --hs-dense-top 1024
 run_item pallas               900 "$TPU" $B --band-backend pallas
 run_item slab_sorted          900 "$TPU" $B --slab-scatter 1
 run_item b1024                900 "$TPU" $B --batch-rows 1024

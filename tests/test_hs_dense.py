@@ -1,0 +1,315 @@
+"""Two-tier hs update (config.hs_dense_top; ops/hs_step.py, data/huffman.py
+split_dense_tier).
+
+Pins, per SURVEY §4 "Numerics":
+
+1. The table split is lossless: dense prefix (signed multi-hot over the
+   top-P node slice) + tail arrays reconstruct every word's exact
+   codes/points, and the prefix property (node ids decrease along paths)
+   holds by construction.
+2. Two-tier vs one-tier kernel agreement to f32-reassociation tolerance —
+   the tiers partition syn1's rows, so sum, scatter_mean, and loss/pair
+   metrics must all agree. Covers sg and cbow, partial and full (P >= V-1,
+   empty-tail) dense tiers, chunked band, and compaction bounds that cover
+   every touched slot.
+3. Compaction accounting: an undersized hs_tail_slots drops updates and
+   reports them in hs_tail_dropped; a covering bound drops nothing and is
+   bit-identical to no-compaction.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.huffman import build_huffman, split_dense_tier
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.ops.tables import DeviceTables
+from word2vec_tpu.ops.train_step import make_train_step
+
+V, D = 24, 8
+ALPHA = 0.02
+# zipf-ish: skewed counts so the tree is deep and the top-P tier is partial
+COUNTS = (1000 / np.arange(1, V + 1)).astype(np.int64) + 1
+
+
+def build_tables(hs_dense_top=0):
+    hc = build_huffman(COUNTS)
+    base = dict(
+        keep_probs=jnp.ones(V, jnp.float32),
+        alias_accept=None,
+        alias_idx=None,
+        hs_codes=jnp.asarray(hc.codes.astype(np.int8)),
+        hs_points=jnp.asarray(hc.points),
+        hs_len=jnp.asarray(hc.code_len),
+    )
+    if hs_dense_top:
+        sp = split_dense_tier(hc, COUNTS, hs_dense_top)
+        base.update(
+            hs_msig=jnp.asarray(sp.msig),
+            hs_tail_codes=jnp.asarray(sp.tail_codes.astype(np.int8)),
+            hs_tail_points=jnp.asarray(sp.tail_points),
+            hs_tail_len=jnp.asarray(sp.tail_len),
+            hs_tail_mean=sp.tail_mean,
+            hs_tail_var=sp.tail_var,
+            hs_dense_coverage=sp.coverage,
+        )
+    return DeviceTables(**base), hc
+
+
+def make_params(rng):
+    return {
+        "emb_in": rng.normal(0, 0.1, (V, D)).astype(np.float32),
+        "emb_out_hs": rng.normal(0, 0.1, (V - 1, D)).astype(np.float32),
+    }
+
+
+TOKENS = np.array(
+    [
+        [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 15, 22, 7, -1],
+        [0, 7, 1, 0, 8, 10, 11, 2, 23, 19, -1, -1, -1, -1],
+    ],
+    dtype=np.int32,
+)
+
+
+@pytest.mark.parametrize("top_p", [1, 3, 8, V - 1, 4 * V])
+def test_split_dense_tier_lossless(top_p):
+    hc = build_huffman(COUNTS)
+    sp = split_dense_tier(hc, COUNTS, top_p)
+    P = sp.msig.shape[1]
+    assert P == min(top_p, V - 1)
+    thresh = (V - 1) - P
+    for w in range(V):
+        n = int(hc.code_len[w])
+        plen = n - int(sp.tail_len[w])
+        # prefix: reconstruct (point, code) pairs from the multi-hot row —
+        # order recovers from the monotone-decreasing id property
+        ps = np.nonzero(sp.msig[w])[0]
+        assert len(ps) == plen
+        pts = np.sort(ps)[::-1] + thresh
+        np.testing.assert_array_equal(pts, hc.points[w, :plen])
+        codes = np.where(sp.msig[w][pts - thresh] > 0, 0, 1)
+        np.testing.assert_array_equal(codes, hc.codes[w, :plen])
+        # every prefix node is in the top slice, every tail node below it
+        assert (hc.points[w, :plen] >= thresh).all()
+        assert (hc.points[w, plen:n] < thresh).all()
+        # tail: exact remainder
+        np.testing.assert_array_equal(
+            sp.tail_points[w, : n - plen], hc.points[w, plen:n]
+        )
+        np.testing.assert_array_equal(
+            sp.tail_codes[w, : n - plen], hc.codes[w, plen:n]
+        )
+    if P >= V - 1:
+        assert sp.tail_codes.shape[1] == 0
+        assert sp.coverage == pytest.approx(1.0)
+    else:
+        assert 0.0 < sp.coverage < 1.0
+        assert sp.tail_mean > 0.0
+
+
+def _run(cfg_kw, tables, params_np, tokens=TOKENS, key=7):
+    cfg = Word2VecConfig(
+        word_dim=D, train_method="hs", negative=0, compute_dtype="float32",
+        subsample_threshold=0.01, kernel="band", **cfg_kw
+    )
+    step = jax.jit(make_train_step(cfg, tables))
+    params = {k: jnp.asarray(v) for k, v in params_np.items()}
+    return step(
+        params, jnp.asarray(tokens), jax.random.key(key), jnp.float32(ALPHA)
+    )
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+@pytest.mark.parametrize("scatter_mean", [False, True])
+@pytest.mark.parametrize("window", [1, 3])
+@pytest.mark.parametrize("top_p", [4, V - 1])
+def test_two_tier_matches_one_tier(model, scatter_mean, window, top_p):
+    """hs_dense_top restructures aggregation only: same per-pair math, same
+    RNG streams, row-disjoint tiers => one-tier agreement to f32 tolerance.
+    hs_tail_slots=0 (no compaction) isolates the tier split itself."""
+    t1, _ = build_tables()
+    t2, _ = build_tables(hs_dense_top=top_p)
+    rng = np.random.default_rng(5)
+    params = make_params(rng)
+    kw = dict(model=model, scatter_mean=scatter_mean, window=window)
+    new1, m1 = _run(kw, t1, params)
+    new2, m2 = _run(
+        dict(hs_dense_top=top_p, hs_tail_slots=0, **kw), t2, params
+    )
+    for k in new1:
+        np.testing.assert_allclose(
+            np.asarray(new1[k]), np.asarray(new2[k]), atol=2e-5, err_msg=k
+        )
+    assert float(m1["pairs"]) == pytest.approx(float(m2["pairs"]))
+    assert float(m1["loss_sum"]) == pytest.approx(
+        float(m2["loss_sum"]), rel=1e-5
+    )
+    assert float(m2["hs_tail_dropped"]) == 0.0
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_two_tier_chunked_band(model):
+    """Chunked band representation under the two-tier kernel (the A/N window
+    sums ride banded.band_sv) matches the dense representation."""
+    t2, _ = build_tables(hs_dense_top=6)
+    rng = np.random.default_rng(11)
+    params = make_params(rng)
+    tokens = rng.integers(-1, V, size=(3, 21)).astype(np.int32)
+    kw = dict(model=model, window=2, hs_dense_top=6, hs_tail_slots=0)
+    new_d, _ = _run(dict(band_chunk=0, **kw), t2, params, tokens)
+    new_c, _ = _run(dict(band_chunk=5, **kw), t2, params, tokens)
+    for k in new_d:
+        np.testing.assert_allclose(
+            np.asarray(new_d[k]), np.asarray(new_c[k]), atol=2e-5, err_msg=k
+        )
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+@pytest.mark.parametrize("slots", [-1, 10_000, "almost_all"])
+def test_tail_compaction_covering_bound_is_exact(model, slots):
+    """A compaction bound that covers every touched slot must match
+    no-compaction and drop nothing. -1/10_000 resolve to T=0 (bound >=
+    slot count => the sort/gather is skipped outright — bit-identical);
+    "almost_all" (slot count - 1) forces the compaction machinery to
+    actually run while still covering every touched slot (padded slots
+    guarantee headroom), pinning the sort/gather path itself — allclose,
+    since the scatter order differs."""
+    t2, _ = build_tables(hs_dense_top=4)
+    Ct = t2.hs_tail_codes.shape[1]
+    L, W = TOKENS.shape[1], 2
+    if slots == "almost_all":
+        slots = (L + (2 * W if model == "sg" else 0)) * Ct - 1
+    rng = np.random.default_rng(3)
+    params = make_params(rng)
+    kw = dict(model=model, window=2, hs_dense_top=4)
+    new0, m0 = _run(dict(hs_tail_slots=0, **kw), t2, params)
+    newc, mc = _run(dict(hs_tail_slots=slots, **kw), t2, params)
+    for k in new0:
+        np.testing.assert_allclose(
+            np.asarray(new0[k]), np.asarray(newc[k]), atol=2e-6, err_msg=k
+        )
+    assert float(mc["hs_tail_dropped"]) == 0.0
+
+
+def test_tail_compaction_undersized_drops_and_reports():
+    t2, _ = build_tables(hs_dense_top=4)
+    rng = np.random.default_rng(3)
+    params = make_params(rng)
+    new, m = _run(
+        dict(model="sg", window=2, hs_dense_top=4, hs_tail_slots=2), t2, params
+    )
+    assert float(m["hs_tail_dropped"]) > 0.0
+    for k in new:
+        assert np.isfinite(np.asarray(new[k])).all()
+    # the dense tier and center rows still update
+    assert not np.array_equal(np.asarray(new["emb_in"]), params["emb_in"])
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_two_tier_clip_engages_and_caps(model):
+    """With a tiny trust region the dense tier's per-pair-entry bound must
+    engage (clip_engaged > 0) and cap every top row's update to ~tau."""
+    tau = 1e-3
+    t2, _ = build_tables(hs_dense_top=6)
+    rng = np.random.default_rng(13)
+    params = make_params(rng)
+    base = {k: jnp.asarray(v) for k, v in params.items()}
+    kw = dict(model=model, window=2, hs_dense_top=6, hs_tail_slots=0,
+              clip_row_update=tau)
+    new, m = _run(kw, t2, params)
+    assert float(m["clip_engaged"]) > 0.0
+    upd = np.asarray(new["emb_out_hs"]) - np.asarray(base["emb_out_hs"])
+    norms = np.linalg.norm(upd, axis=-1)
+    assert (norms <= tau * 1.01).all()
+
+
+def test_two_tier_bf16_sr_smoke():
+    t2, _ = build_tables(hs_dense_top=6)
+    rng = np.random.default_rng(17)
+    params = {
+        "emb_in": rng.normal(0, 0.1, (V, D)).astype(jnp.bfloat16),
+        "emb_out_hs": rng.normal(0, 0.1, (V - 1, D)).astype(jnp.bfloat16),
+    }
+    cfg = Word2VecConfig(
+        word_dim=D, train_method="hs", negative=0, model="sg", window=2,
+        hs_dense_top=6, dtype="bfloat16", stochastic_rounding=True,
+        kernel="band", subsample_threshold=0.01,
+    )
+    step = jax.jit(make_train_step(cfg, t2))
+    params_j = {k: jnp.asarray(v) for k, v in params.items()}
+    new, m = step(
+        params_j, jnp.asarray(TOKENS), jax.random.key(3), jnp.float32(ALPHA)
+    )
+    for k in new:
+        assert new[k].dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(new[k], dtype=np.float32)).all()
+    assert float(m["pairs"]) > 0
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_two_tier_tensor_parallel_matches_single_chip(model):
+    """tp=4 under the two-tier kernel: the dense tier's F/||h|| psums must
+    reproduce single-chip numerics like every other logit psum."""
+    from word2vec_tpu.models.params import init_params
+    from word2vec_tpu.parallel import (
+        make_mesh, make_sharded_step, replicate_params,
+    )
+
+    cfg = Word2VecConfig(
+        model=model, train_method="hs", negative=0, word_dim=D, window=3,
+        min_count=1, subsample_threshold=0, hs_dense_top=6, hs_tail_slots=0,
+        kernel="band",
+    )
+    vocab = Vocab.from_counter(
+        {f"w{i}": int(c) for i, c in enumerate(COUNTS)}, min_count=1
+    )
+    tables = DeviceTables.build(vocab, cfg)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, V, size=(8, 24)).astype(np.int32)
+    key = jax.random.key(42)
+    params = init_params(cfg, V, jax.random.key(7))
+
+    single = jax.jit(make_train_step(cfg, tables))
+    ref_out, ref_m = single(params, jnp.asarray(tokens), key, jnp.float32(ALPHA))
+
+    mesh = make_mesh(dp=1, tp=4)
+    sharded = make_sharded_step(cfg, tables, mesh)
+    repl = replicate_params(params, mesh)
+    out, m = sharded(repl, jnp.asarray(tokens), key, jnp.float32(ALPHA))
+
+    for k in ref_out:
+        np.testing.assert_allclose(
+            np.asarray(out[k][0]), np.asarray(ref_out[k]), atol=5e-5, err_msg=k
+        )
+    assert float(m["pairs"]) == pytest.approx(float(ref_m["pairs"]))
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="hierarchical softmax"):
+        Word2VecConfig(train_method="ns", hs_dense_top=8)
+    with pytest.raises(ValueError, match="positional"):
+        Word2VecConfig(
+            train_method="hs", negative=0, hs_dense_top=8, kernel="pair"
+        )
+    with pytest.raises(ValueError, match="hs_tail_slots"):
+        Word2VecConfig(train_method="hs", negative=0, hs_tail_slots=-2)
+
+
+def test_tables_build_wires_split():
+    cfg = Word2VecConfig(
+        train_method="hs", negative=0, hs_dense_top=6, word_dim=D,
+        kernel="band",
+    )
+    vocab = Vocab.from_counter(
+        {f"w{i}": int(c) for i, c in enumerate(COUNTS)}, min_count=1
+    )
+    t = DeviceTables.build(vocab, cfg)
+    assert t.hs_msig is not None and t.hs_msig.shape == (V, 6)
+    assert t.hs_tail_codes is not None
+    assert 0.0 < t.hs_dense_coverage <= 1.0
+    assert t.hs_tail_mean > 0.0

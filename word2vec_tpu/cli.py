@@ -145,6 +145,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                    help="band kernel: scatter context grads from slab space "
                         "(skips the overlap-add; config.slab_scatter)")
+    p.add_argument("--hs-dense-top", type=int, default=0, metavar="P",
+                   help="two-tier hs update: handle the top-P Huffman nodes "
+                        "(a contiguous slice + per-path prefix) with dense "
+                        "matmuls, gather/scatter only the short path tails "
+                        "(config.hs_dense_top; 0 = single-tier)")
+    p.add_argument("--hs-tail-slots", type=int, default=-1, metavar="T",
+                   help="two-tier hs tail-scatter compaction bound per batch "
+                        "row: -1 auto (+6 sigma), 0 off, >0 explicit "
+                        "(config.hs_tail_slots)")
     p.add_argument("--resident", choices=["auto", "on", "off"], default="auto",
                    help="device-resident corpus: keep the packed corpus in "
                         "HBM and assemble batches on device (single-chip "
@@ -274,6 +283,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         scatter_mean=bool(args.scatter_mean),
         slab_scatter=bool(args.slab_scatter),
         band_backend=args.band_backend,
+        hs_dense_top=args.hs_dense_top,
+        hs_tail_slots=args.hs_tail_slots,
         resident=args.resident,
         clip_row_update=args.clip_row_update,
         prng_impl=args.prng,

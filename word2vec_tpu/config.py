@@ -115,6 +115,27 @@ class Word2VecConfig:
     # for the on-chip sweep).
     band_backend: str = "xla"
 
+    # Two-tier hierarchical-softmax update (ops/hs_step.py, data/huffman.py
+    # split_dense_tier). Huffman node ids decrease along every root->leaf
+    # path, so the hs_dense_top LARGEST ids — the top of the tree, covering
+    # ~73% of token-weighted path entries at 512 on a zipf-71k vocab — form
+    # a per-word path PREFIX and a CONTIGUOUS top slice of the hs output
+    # matrix. The kernel then scores/updates that whole tier with dense
+    # matmuls (logits F = h @ top^T; window-summed multi-hot counts A/N
+    # give the summed per-pair gradient G = alpha*(A - sigmoid(F)*N)) and a
+    # slice add — no gather/scatter — leaving only each word's short path
+    # TAIL (~13 padded slots vs ~25) for the positional gather/scatter
+    # path. 0 = off (single-tier positional kernel). Perf lever for the
+    # hs on-chip sweep; update semantics are one-tier-exact (same per-pair
+    # math, different aggregation order) — pinned by tests/test_hs_dense.py.
+    hs_dense_top: int = 0
+    # Tail-scatter compaction bound: -1 = auto (E[touched slots] + 6 sigma
+    # from the vocab's tail-length stats — statistically never overflows;
+    # overflow drops the excess slots' updates and reports them in the
+    # hs_tail_dropped metric), 0 = no compaction (every padded slot is
+    # scattered, exact), > 0 = explicit slot budget per batch row.
+    hs_tail_slots: int = -1
+
     # Batched-update stabilizer. The reference's Hogwild updates are sequential:
     # after each update to a row, the next sigmoid sees the moved row, so
     # frequent rows self-correct (Word2Vec.cpp:239-246,262-268). A batched
@@ -262,6 +283,28 @@ class Word2VecConfig:
             raise ValueError(
                 f"band_chunk={self.band_chunk} < 2*window={2 * self.window} "
                 "(slab overlap-add requires S >= 2W; see ops/banded.py)"
+            )
+        if self.hs_dense_top < 0:
+            raise ValueError("hs_dense_top must be >= 0 (0 = off)")
+        if self.hs_dense_top and self.train_method != "hs":
+            raise ValueError(
+                "hs_dense_top applies to hierarchical softmax only "
+                "(train_method='hs')"
+            )
+        if self.hs_dense_top and self.kernel == "pair":
+            raise ValueError(
+                "hs_dense_top applies to the positional hs kernel only "
+                "(ops/hs_step.py); kernel='pair' keeps single-tier updates"
+            )
+        if self.hs_tail_slots < -1:
+            raise ValueError(
+                "hs_tail_slots must be -1 (auto), 0 (no compaction), or > 0"
+            )
+        if self.hs_tail_slots != -1 and not self.hs_dense_top:
+            raise ValueError(
+                "hs_tail_slots applies to the two-tier hs update only — "
+                "set hs_dense_top > 0 (a lever flag that silently measures "
+                "the default path must fail loudly instead)"
             )
         if self.micro_steps < 1:
             raise ValueError("micro_steps must be >= 1")

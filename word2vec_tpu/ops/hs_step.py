@@ -19,6 +19,37 @@ every center that predicts it, so this kernel:
             logit einsum, one scatter; the projection h is the banded
             context sum/mean exactly as in ops/band_step.py.
 
+Two-tier update (config.hs_dense_top = P > 0): Huffman node ids decrease
+monotonically along every root->leaf path (data/huffman.py), so the top-P
+ids — the most-frequented top of the tree, ~73% of token-weighted path
+entries at P=512 on a zipf-71k vocab — are simultaneously (a) a PREFIX of
+every path and (b) a CONTIGUOUS top slice syn1[V-1-P:]. The kernel exploits
+both:
+
+  dense tier — all prefix entries collapse into matmuls. The per-pair-entry
+    gradient g = (label - sigmoid(logit)) * alpha has a logit h_i . n_p that
+    depends only on (center, node), so summing over the window/batch
+    linearizes in the label: with F[b,i,p] = h_i . top_p (one matmul),
+    A/N = window-summed counts of positive-label/any activations of node p
+    around center i (two band matmuls over the per-word signed multi-hot
+    tables.hs_msig), the SUMMED gradient is G = alpha * (A - sigmoid(F)*N).
+    d_h and the tier's table update are two more matmuls, and the update
+    lands as ONE contiguous slice add — the tier needs no gather, no
+    scatter, and no per-offset work at all.
+  tail tier — the short per-word remainders (tables.hs_tail_*, ~13 padded
+    slots vs ~25 full-path) run through the SAME positional sweep/scatter
+    machinery as the one-tier path (the helpers below are parameterized by
+    the path tables), optionally compacting the scatter to the slots that
+    actually received gradient (config.hs_tail_slots; overflow beyond the
+    +6-sigma auto bound drops those slots' updates and reports
+    hs_tail_dropped).
+
+  The tiers PARTITION the rows of syn1 (a node id is either in the top
+  slice or not), so the per-row trust region, scatter_mean normalization,
+  and SR destination grids each see complete per-row updates in exactly
+  one tier — semantics stay one-tier-exact, pinned by
+  tests/test_hs_dense.py.
+
 Update-rule semantics are reference-exact (same per-pair math as the pair
 kernel, Word2Vec.cpp:232-249): only the gather/scatter aggregation is
 restructured, so this kernel must agree with the pair kernel bitwise-modulo
@@ -36,6 +67,7 @@ implemented for hs (ShardedTrainer validates sp requires the ns band kernel).
 
 from __future__ import annotations
 
+import math
 from typing import Callable, Dict, Tuple
 
 import jax
@@ -50,6 +82,30 @@ from .train_step import (
 )
 
 Metrics = Dict[str, jnp.ndarray]
+
+
+def resolve_tail_slots(
+    config: Word2VecConfig, tables: DeviceTables, L: int, slots: int
+) -> int:
+    """Compacted tail-scatter bound T for a batch row of L positions with
+    `slots` padded tail slots; 0 = compaction off (scatter every slot).
+
+    Auto (-1): E[touched slots] + 6 sigma under the vocab's unigram
+    tail-length stats — at most L positions contribute tail_len slots each,
+    so mean L*mu and (independence approximation) variance L*var. The +Ct
+    headroom covers tiny-L cases where the normal approximation is poor.
+    """
+    if config.hs_tail_slots == 0 or slots == 0:
+        return 0
+    if config.hs_tail_slots > 0:
+        # a bound covering every slot can't drop anything — skip the
+        # compaction sort/gather entirely, like the auto path below
+        return 0 if config.hs_tail_slots >= slots else config.hs_tail_slots
+    Ct = tables.hs_tail_codes.shape[1]
+    exp = L * tables.hs_tail_mean
+    sd = math.sqrt(max(L * tables.hs_tail_var, 0.0))
+    T = int(math.ceil(exp + 6.0 * sd)) + Ct
+    return 0 if T >= slots else T
 
 
 def make_hs_train_step(
@@ -74,9 +130,260 @@ def make_hs_train_step(
     clip_tau = config.clip_row_update
     sr = config.stochastic_rounding
     cdt = jnp.dtype(config.compute_dtype)
+    two_tier = tables.hs_msig is not None
+    P = tables.hs_msig.shape[1] if two_tier else 0
+    Ct = tables.hs_tail_codes.shape[1] if two_tier else 0
 
     def psum(x):
         return jax.lax.psum(x, tp_axis) if tp_axis is not None else x
+
+    def dense_tier(h, A, N, syn1, alpha):
+        """The top-slice tier: logits, gradients, loss — all matmuls.
+
+        h [B,L,d] projections; A/N [B,L,P] summed positive-label/any
+        activation counts of each top node over h's training pairs (already
+        gated by keep/valid/window/active). Returns (d_h_dense [B,L,d],
+        d_top [P,d] scaled by clip/scatter_mean, loss, pairs, clip_count).
+        """
+        top0 = syn1.shape[0] - P
+        syn1_top = syn1[top0:]
+        F = psum(
+            jnp.einsum(
+                "bid,pd->bip",
+                h.astype(cdt),
+                syn1_top.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        sigF = jax.nn.sigmoid(F)
+        G = (A - sigF * N) * alpha
+        d_h = jnp.einsum(
+            "bip,pd->bid",
+            G.astype(cdt),
+            syn1_top.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+        d_top = jnp.einsum(
+            "bip,bid->pd",
+            G.astype(cdt),
+            h.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+        lsF = jax.nn.log_sigmoid(F)
+        loss = -(jnp.sum(A * lsF) + jnp.sum((N - A) * (lsF - F)))
+        pairs = jnp.sum(N)
+        clip_count = jnp.float32(0.0)
+        mean_inv = None
+        if scatter_mean:  # mean before clip, same order as the scatter paths
+            cnt = jnp.sum(N, axis=(0, 1))  # contributions per top row
+            mean_inv = 1.0 / jnp.maximum(cnt, 1.0)
+            d_top = d_top * mean_inv[:, None]
+        if clip_tau > 0.0:
+            # triangle bound at PER-PAIR-ENTRY granularity (the pair
+            # kernel's _row_clip_scale contribution set): S_p =
+            # sum_entries ||g * h_i|| = sum |g| * ||h_i||, with
+            # sum_entries |g| linearizing exactly like G does — label-1
+            # entries contribute (1-sigF), label-0 entries sigF. The
+            # positional one-tier kernel sums per SLOT (across-offset sums
+            # taken before the norm), a coarser bound; the per-pair bound
+            # is >= it, so the dense tier engages no later — differences
+            # appear only when the trust region is actively reshaping a row
+            hsq = jnp.sum(h.astype(jnp.float32) ** 2, axis=-1)
+            if tp_axis is not None:
+                hsq = jax.lax.psum(hsq, tp_axis)
+            absg = (A * (1.0 - sigF) + (N - A) * sigF) * alpha
+            s_p = jnp.einsum(
+                "bip,bi->p", absg, jnp.sqrt(hsq),
+                preferred_element_type=jnp.float32,
+            )
+            if mean_inv is not None:
+                s_p = s_p * mean_inv
+            scale = clip_tau / jnp.maximum(s_p, clip_tau)
+            clip_count = jnp.sum((scale < 1.0).astype(jnp.float32))
+            d_top = d_top * scale[:, None]
+        return d_h, d_top, loss, pairs, clip_count
+
+    def sg_sweep(h, tokens, keep, w_eff, syn1, alpha, pts, cds, lens, Cx):
+        """The sg positional offset sweep over one set of path tables
+        (full-path or tail-tier): per offset o, score/update every active
+        (center i, context i+o) pair against the context's path entries.
+
+        Returns (paths [B,Q,Cx], d_rows [B,Q,Cx,d], touched, out_touch,
+        d_h [B,L,d], loss, pairs, ctx_hit [B,L]).
+        """
+        B, L = tokens.shape
+        tok_pad = jnp.pad(tokens, ((0, 0), (W, W)), constant_values=-1)
+        vpad = tok_pad >= 0
+        tpad = jnp.where(vpad, tok_pad, 0)
+        paths = pts[tpad]  # [B, Q, Cx]
+        codes = cds[tpad]
+        cmask = (
+            jnp.arange(Cx, dtype=jnp.int32)[None, None, :]
+            < lens[tpad][:, :, None]
+        ) & vpad[:, :, None]
+        rows = syn1[paths]  # [B, Q, Cx, d] — ONE gather
+
+        d_h = jnp.zeros(h.shape, jnp.float32)
+        d_rows = jnp.zeros(rows.shape, jnp.float32)
+        loss = jnp.float32(0.0)
+        pairs = jnp.float32(0.0)
+        ctx_hit = jnp.zeros((B, L), bool)  # any active pair per center
+        touched = jnp.zeros(paths.shape, bool)
+        out_touch = jnp.zeros(paths.shape, jnp.float32)
+        for o in [o for o in range(-W, W + 1) if o != 0]:
+            sl = slice(W + o, W + o + L)  # context j = i + o, padded coords
+            pair_ok = keep & vpad[:, sl] & (abs(o) <= w_eff)  # [B, L]
+            m = (pair_ok[:, :, None] & cmask[:, sl]).astype(jnp.float32)
+            logit = psum(
+                jnp.einsum(
+                    "bid,bicd->bic",
+                    h.astype(cdt),
+                    rows[:, sl].astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+            )  # [B, L, Cx]
+            # g = (1 - code - f) * alpha (Word2Vec.cpp:241-242)
+            label = 1.0 - codes[:, sl].astype(jnp.float32)
+            g = (label - jax.nn.sigmoid(logit)) * m * alpha
+            d_h = d_h + jnp.einsum(
+                "bic,bicd->bid",
+                g.astype(cdt),
+                rows[:, sl].astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            d_rows = d_rows.at[:, sl].add(
+                jnp.einsum(
+                    "bic,bid->bicd",
+                    g.astype(cdt),
+                    h.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+            ls = jax.nn.log_sigmoid(logit)
+            loss += -jnp.sum(m * jnp.where(label > 0.5, ls, ls - logit))
+            pairs += jnp.sum(m)
+            ctx_hit = ctx_hit | pair_ok
+            # unused outputs (touched in one-tier, out_touch without
+            # scatter_mean) are dead code XLA eliminates under jit
+            touched = touched.at[:, sl].set(touched[:, sl] | (m > 0))
+            if scatter_mean:
+                out_touch = out_touch.at[:, sl].add(m)
+        return paths, d_rows, touched, out_touch, d_h, loss, pairs, ctx_hit
+
+    def cbow_path_block(h, tok, gate, syn1, alpha, pts, cds, lens, Cx):
+        """One cbow sigmoid-SGD block against one set of path tables:
+        targets are the center's own path entries (no offset sweep).
+
+        Returns (paths [B,L,Cx], d_rows, m, d_h_add, loss, pairs).
+        """
+        paths = pts[tok]  # [B, L, Cx]
+        codes = cds[tok]
+        cmask = (
+            jnp.arange(Cx, dtype=jnp.int32)[None, None, :]
+            < lens[tok][:, :, None]
+        ) & gate[:, :, None]
+        rows = syn1[paths]             # [B, L, Cx, d]
+        logit = psum(
+            jnp.einsum(
+                "bid,bicd->bic",
+                h.astype(cdt),
+                rows.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        m = cmask.astype(jnp.float32)
+        label = 1.0 - codes.astype(jnp.float32)
+        g = (label - jax.nn.sigmoid(logit)) * m * alpha
+        d_h_add = jnp.einsum(
+            "bic,bicd->bid",
+            g.astype(cdt),
+            rows.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+        d_rows = jnp.einsum(
+            "bic,bid->bicd",
+            g.astype(cdt),
+            h.astype(cdt),
+            preferred_element_type=jnp.float32,
+        )
+        ls = jax.nn.log_sigmoid(logit)
+        loss = -jnp.sum(m * jnp.where(label > 0.5, ls, ls - logit))
+        return paths, d_rows, m, d_h_add, loss, jnp.sum(m)
+
+    def path_scatter(
+        syn1, flat_p, vals, weights, touched, T, k_sr, clip_state
+    ):
+        """Sorted (optionally compacted) scatter of path rows into syn1.
+
+        flat_p/weights/touched are [B, Sl]-shaped (vals [B, Sl, d]); T = 0
+        scatters every slot (the one-tier path); T > 0 compacts each batch
+        row to its first T touched slots (stable argsort keeps slot order),
+        dropping any overflow — counted and returned so the quality impact
+        is observable. Returns (new_syn1, clip_count, dropped).
+        """
+        B = flat_p.shape[0]
+        dropped = jnp.float32(0.0)
+        if T > 0:
+            order = jnp.argsort(~touched, axis=1)[:, :T]
+            bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+            flat_p = flat_p[bidx, order]
+            vals = vals[bidx, order]
+            if weights is not None:
+                weights = weights[bidx, order]
+            n_touched = jnp.sum(touched.astype(jnp.int32), axis=1)
+            dropped = jnp.sum(
+                jnp.maximum(n_touched - T, 0).astype(jnp.float32)
+            )
+        flat_p = flat_p.reshape(-1)
+        vals = vals.reshape(-1, vals.shape[-1])
+        order = jnp.argsort(flat_p)
+        flat_p = flat_p[order]
+        vals = vals[order]
+        if scatter_mean:
+            vals = vals * _dup_mean_scale(
+                syn1.shape[0], flat_p, weights.reshape(-1)[order]
+            )[:, None]
+        clip_count = clip_state
+        if clip_tau > 0.0:
+            scale = _row_clip_scale(
+                syn1.shape[0], clip_tau, (flat_p, vals), tp_axis=tp_axis
+            )
+            clip_count = clip_count + jnp.sum(
+                (scale < 1.0).astype(jnp.float32)
+            )
+            vals = vals * scale[flat_p][:, None]
+        new_syn1 = syn1.at[flat_p].add(
+            _cast_update(
+                vals, syn1.dtype, k_sr(1), syn1[flat_p] if sr else None
+            ),
+            indices_are_sorted=True,
+        )
+        return new_syn1, clip_count, dropped
+
+    def center_scatter(emb_in, tok, d_h, ctx_weight, k_sr, clip_state):
+        """sg center-row update: W.row(center) += accumulated grad (:351)."""
+        B, L = tok.shape
+        flat_c = tok.reshape(-1)
+        vals = d_h.reshape(B * L, -1)
+        if scatter_mean:
+            vals = vals * _dup_mean_scale(
+                emb_in.shape[0], flat_c, ctx_weight.reshape(-1)
+            )[:, None]
+        clip_count = clip_state
+        if clip_tau > 0.0:
+            scale = _row_clip_scale(
+                emb_in.shape[0], clip_tau, (flat_c, vals), tp_axis=tp_axis
+            )
+            clip_count = clip_count + jnp.sum(
+                (scale < 1.0).astype(jnp.float32)
+            )
+            vals = vals * scale[flat_c][:, None]
+        new_in = emb_in.at[flat_c].add(
+            _cast_update(
+                vals, emb_in.dtype, k_sr(0), emb_in[flat_c] if sr else None
+            )
+        )
+        return new_in, clip_count
 
     def step(
         params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
@@ -96,107 +403,79 @@ def make_hs_train_step(
         syn1 = params["emb_out_hs"]
         C = tables.hs_points.shape[1]
         clip_count = jnp.float32(0.0)  # rows the trust region engaged on
+        dropped = jnp.float32(0.0)
+        Q = L + 2 * W
 
         if not is_cbow:
             # ---- skip-gram: h = center row; targets = each context's path.
             h = emb_in[tok]  # [B, L, d]
-            # padded position axis: q = j + W for context position j
-            tok_pad = jnp.pad(tokens, ((0, 0), (W, W)), constant_values=-1)
-            vpad = tok_pad >= 0
-            tpad = jnp.where(vpad, tok_pad, 0)
-            paths = tables.hs_points[tpad]  # [B, L+2W, C]
-            codes = tables.hs_codes[tpad]   # [B, L+2W, C]
-            cmask = (
-                jnp.arange(C, dtype=jnp.int32)[None, None, :]
-                < tables.hs_len[tpad][:, :, None]
-            ) & vpad[:, :, None]            # [B, L+2W, C]
-            rows = syn1[paths]              # [B, L+2W, C, d] — ONE gather
-
-            d_h = jnp.zeros(h.shape, jnp.float32)
-            d_rows = jnp.zeros(rows.shape, jnp.float32)
-            loss = jnp.float32(0.0)
-            pairs = jnp.float32(0.0)
-            ctx_hit = jnp.zeros((B, L), bool)  # any active pair per center
-            out_touch = jnp.zeros((B, L + 2 * W, C), jnp.float32)
-            for o in [o for o in range(-W, W + 1) if o != 0]:
-                sl = slice(W + o, W + o + L)  # context j = i + o, padded coords
-                pair_ok = keep & vpad[:, sl] & (abs(o) <= w_eff)  # [B, L]
-                m = (pair_ok[:, :, None] & cmask[:, sl]).astype(jnp.float32)
-                logit = psum(
-                    jnp.einsum(
-                        "bid,bicd->bic",
-                        h.astype(cdt),
-                        rows[:, sl].astype(cdt),
-                        preferred_element_type=jnp.float32,
+            if two_tier:
+                S = banded.resolve_chunk(L, W, config.band_chunk)
+                # keep_i & valid_j & 0 < |i-j| <= w_eff_i: exactly the
+                # pair_ok mask of the sg_sweep offset loop
+                band_f = banded.band_mask(keep, valid, w_eff, W, S).astype(
+                    jnp.float32
+                )
+                M = tables.hs_msig[tok]  # [B, L, P] i8
+                # counts fit bf16's 8 mantissa bits exactly, and the einsum
+                # accumulates in f32 — A/N are exact integers in any cdt
+                A = banded.band_sv(
+                    band_f, (M > 0).astype(jnp.float32), W, S, cdt
+                )
+                N = banded.band_sv(
+                    band_f, (M != 0).astype(jnp.float32), W, S, cdt
+                )
+                d_h, d_top, loss, pairs, c_cnt = dense_tier(
+                    h, A, N, syn1, alpha
+                )
+                clip_count += c_cnt
+                ctx_hit = banded.band_row_sum(band_f, L) > 0
+                if Ct:
+                    (paths, d_rows, touched, out_touch, d_h_tail, t_loss,
+                     t_pairs, ctx_hit) = sg_sweep(
+                        h, tokens, keep, w_eff, syn1, alpha,
+                        tables.hs_tail_points, tables.hs_tail_codes,
+                        tables.hs_tail_len, Ct,
                     )
-                )  # [B, L, C]
-                # g = (1 - code - f) * alpha (Word2Vec.cpp:241-242)
-                label = 1.0 - codes[:, sl].astype(jnp.float32)
-                g = (label - jax.nn.sigmoid(logit)) * m * alpha
-                d_h = d_h + jnp.einsum(
-                    "bic,bicd->bid",
-                    g.astype(cdt),
-                    rows[:, sl].astype(cdt),
-                    preferred_element_type=jnp.float32,
-                )
-                d_rows = d_rows.at[:, sl].add(
-                    jnp.einsum(
-                        "bic,bid->bicd",
-                        g.astype(cdt),
-                        h.astype(cdt),
-                        preferred_element_type=jnp.float32,
+                    d_h = d_h + d_h_tail
+                    loss += t_loss
+                    pairs += t_pairs
+                    T = resolve_tail_slots(config, tables, L, Q * Ct)
+                    new_out, clip_count, dropped = path_scatter(
+                        syn1,
+                        paths.reshape(B, Q * Ct),
+                        d_rows.reshape(B, Q * Ct, -1),
+                        out_touch.reshape(B, Q * Ct) if scatter_mean else None,
+                        touched.reshape(B, Q * Ct),
+                        T, k_sr, clip_count,
+                    )
+                else:
+                    new_out = syn1
+                # dense-tier slice add — rows disjoint from every tail id
+                top0 = syn1.shape[0] - P
+                new_out = new_out.at[top0:].add(
+                    _cast_update(
+                        d_top, syn1.dtype, k_sr(2),
+                        new_out[top0:] if sr else None,
                     )
                 )
-                ls = jax.nn.log_sigmoid(logit)
-                loss += -jnp.sum(m * jnp.where(label > 0.5, ls, ls - logit))
-                pairs += jnp.sum(m)
-                ctx_hit = ctx_hit | pair_ok
-                if scatter_mean:
-                    out_touch = out_touch.at[:, sl].add(m)
+            else:
+                (paths, d_rows, _touched, out_touch, d_h, loss, pairs,
+                 ctx_hit) = sg_sweep(
+                    h, tokens, keep, w_eff, syn1, alpha,
+                    tables.hs_points, tables.hs_codes, tables.hs_len, C,
+                )
+                new_out, clip_count, _ = path_scatter(
+                    syn1,
+                    paths.reshape(B, Q * C),
+                    d_rows.reshape(B, Q * C, -1),
+                    out_touch.reshape(B, Q * C) if scatter_mean else None,
+                    None, 0, k_sr, clip_count,
+                )
 
-            # center rows: W.row(center) += accumulated grad (:351)
-            flat_c = tok.reshape(-1)
-            vals = d_h.reshape(B * L, -1)
-            if scatter_mean:
-                vals = vals * _dup_mean_scale(
-                    emb_in.shape[0], flat_c,
-                    ctx_hit.reshape(-1).astype(jnp.float32),
-                )[:, None]
-            if clip_tau > 0.0:
-                scale = _row_clip_scale(
-                    emb_in.shape[0], clip_tau, (flat_c, vals),
-                    tp_axis=tp_axis,
-                )
-                clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
-                vals = vals * scale[flat_c][:, None]
-            new_in = emb_in.at[flat_c].add(
-                _cast_update(
-                    vals, emb_in.dtype, k_sr(0),
-                    emb_in[flat_c] if sr else None,
-                )
-            )
-
-            # path rows: one aggregated scatter over the padded positions
-            flat_p = paths.reshape(-1)
-            order = jnp.argsort(flat_p)
-            d_rows_flat = d_rows.reshape(-1, d_rows.shape[-1])[order]
-            if scatter_mean:
-                d_rows_flat = d_rows_flat * _dup_mean_scale(
-                    syn1.shape[0], flat_p[order], out_touch.reshape(-1)[order]
-                )[:, None]
-            if clip_tau > 0.0:
-                scale = _row_clip_scale(
-                    syn1.shape[0], clip_tau, (flat_p[order], d_rows_flat),
-                    tp_axis=tp_axis,
-                )
-                clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
-                d_rows_flat = d_rows_flat * scale[flat_p[order]][:, None]
-            new_out = syn1.at[flat_p[order]].add(
-                _cast_update(
-                    d_rows_flat, syn1.dtype, k_sr(1),
-                    syn1[flat_p[order]] if sr else None,
-                ),
-                indices_are_sorted=True,
+            new_in, clip_count = center_scatter(
+                emb_in, tok, d_h, ctx_hit.astype(jnp.float32), k_sr,
+                clip_count,
             )
         else:
             # ---- CBOW: h = (mean of) context rows; targets = center's path.
@@ -211,41 +490,59 @@ def make_hs_train_step(
             h = banded.band_sv(band_f, ein, W, S, cdt)
             if cbow_mean:
                 h = h / jnp.maximum(n_ctx, 1.0)[:, :, None]
-
-            paths = tables.hs_points[tok]  # [B, L, C]
-            codes = tables.hs_codes[tok]
             active = keep & (n_ctx > 0)    # skip centers without context, :289
-            cmask = (
-                jnp.arange(C, dtype=jnp.int32)[None, None, :]
-                < tables.hs_len[tok][:, :, None]
-            ) & active[:, :, None]
-            rows = syn1[paths]             # [B, L, C, d]
-            logit = psum(
-                jnp.einsum(
-                    "bid,bicd->bic",
-                    h.astype(cdt),
-                    rows.astype(cdt),
-                    preferred_element_type=jnp.float32,
+
+            if two_tier:
+                # dense tier on the center's OWN path (no offset sweep)
+                M = tables.hs_msig[tok]  # [B, L, P] i8
+                act = active[:, :, None].astype(jnp.float32)
+                A = (M > 0).astype(jnp.float32) * act
+                N = (M != 0).astype(jnp.float32) * act
+                d_h, d_top, loss, pairs, c_cnt = dense_tier(
+                    h, A, N, syn1, alpha
                 )
-            )
-            m = cmask.astype(jnp.float32)
-            label = 1.0 - codes.astype(jnp.float32)
-            g = (label - jax.nn.sigmoid(logit)) * m * alpha
-            d_h = jnp.einsum(
-                "bic,bicd->bid",
-                g.astype(cdt),
-                rows.astype(cdt),
-                preferred_element_type=jnp.float32,
-            )
-            d_rows = jnp.einsum(
-                "bic,bid->bicd",
-                g.astype(cdt),
-                h.astype(cdt),
-                preferred_element_type=jnp.float32,
-            )
-            ls = jax.nn.log_sigmoid(logit)
-            loss = -jnp.sum(m * jnp.where(label > 0.5, ls, ls - logit))
-            pairs = jnp.sum(m)
+                clip_count += c_cnt
+                if Ct:
+                    paths, d_rows, m, d_h_add, t_loss, t_pairs = (
+                        cbow_path_block(
+                            h, tok, active, syn1, alpha,
+                            tables.hs_tail_points, tables.hs_tail_codes,
+                            tables.hs_tail_len, Ct,
+                        )
+                    )
+                    d_h = d_h + d_h_add
+                    loss += t_loss
+                    pairs += t_pairs
+                    T = resolve_tail_slots(config, tables, L, L * Ct)
+                    new_out, clip_count, dropped = path_scatter(
+                        syn1,
+                        paths.reshape(B, L * Ct),
+                        d_rows.reshape(B, L * Ct, -1),
+                        m.reshape(B, L * Ct) if scatter_mean else None,
+                        (m > 0).reshape(B, L * Ct),
+                        T, k_sr, clip_count,
+                    )
+                else:
+                    new_out = syn1
+                top0 = syn1.shape[0] - P
+                new_out = new_out.at[top0:].add(
+                    _cast_update(
+                        d_top, syn1.dtype, k_sr(2),
+                        new_out[top0:] if sr else None,
+                    )
+                )
+            else:
+                paths, d_rows, m, d_h, loss, pairs = cbow_path_block(
+                    h, tok, active, syn1, alpha,
+                    tables.hs_points, tables.hs_codes, tables.hs_len, C,
+                )
+                new_out, clip_count, _ = path_scatter(
+                    syn1,
+                    paths.reshape(B, L * C),
+                    d_rows.reshape(B, L * C, -1),
+                    m.reshape(B, L * C) if scatter_mean else None,
+                    None, 0, k_sr, clip_count,
+                )
 
             # fan d_h to context rows (second /n under cbow_mean, :313-315)
             if cbow_mean:
@@ -310,28 +607,6 @@ def make_hs_train_step(
                     indices_are_sorted=True,
                 )
 
-            flat_p = paths.reshape(-1)
-            porder = jnp.argsort(flat_p)
-            d_rows_flat = d_rows.reshape(-1, d_rows.shape[-1])[porder]
-            if scatter_mean:
-                d_rows_flat = d_rows_flat * _dup_mean_scale(
-                    syn1.shape[0], flat_p[porder], m.reshape(-1)[porder]
-                )[:, None]
-            if clip_tau > 0.0:
-                scale = _row_clip_scale(
-                    syn1.shape[0], clip_tau, (flat_p[porder], d_rows_flat),
-                    tp_axis=tp_axis,
-                )
-                clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
-                d_rows_flat = d_rows_flat * scale[flat_p[porder]][:, None]
-            new_out = syn1.at[flat_p[porder]].add(
-                _cast_update(
-                    d_rows_flat, syn1.dtype, k_sr(1),
-                    syn1[flat_p[porder]] if sr else None,
-                ),
-                indices_are_sorted=True,
-            )
-
         new_params = dict(params)
         new_params["emb_in"] = new_in
         new_params["emb_out_hs"] = new_out
@@ -339,6 +614,7 @@ def make_hs_train_step(
             "loss_sum": loss,
             "pairs": pairs,
             "clip_engaged": clip_count,
+            "hs_tail_dropped": dropped,
         }
 
     return step

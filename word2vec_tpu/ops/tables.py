@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Word2VecConfig
-from ..data.huffman import build_huffman
+from ..data.huffman import build_huffman, split_dense_tier
 from ..data.negative import build_alias_table
 from ..data.vocab import Vocab
 
@@ -31,6 +31,17 @@ class DeviceTables:
     hs_codes: Optional[jnp.ndarray]      # [V, Lc] i8  (hs only)
     hs_points: Optional[jnp.ndarray]     # [V, Lc] i32 (hs only)
     hs_len: Optional[jnp.ndarray]        # [V] i32     (hs only)
+    # two-tier hs split (config.hs_dense_top > 0; data/huffman.py
+    # split_dense_tier): signed multi-hot over the top-P node slice, padded
+    # per-word path tails, and host-side tail-length stats for sizing
+    # compacted tail buffers
+    hs_msig: Optional[jnp.ndarray] = None         # [V, P] i8 in {-1,0,+1}
+    hs_tail_codes: Optional[jnp.ndarray] = None   # [V, Ct] i8
+    hs_tail_points: Optional[jnp.ndarray] = None  # [V, Ct] i32
+    hs_tail_len: Optional[jnp.ndarray] = None     # [V] i32
+    hs_tail_mean: float = 0.0
+    hs_tail_var: float = 0.0
+    hs_dense_coverage: float = 0.0
 
     @property
     def vocab_size(self) -> int:
@@ -49,9 +60,28 @@ class DeviceTables:
             at = build_alias_table(vocab.unigram_probs(config.ns_power))
             alias_accept = jnp.asarray(at.accept)
             alias_idx = jnp.asarray(at.alias)
+        msig = tail_codes = tail_points = tail_len = None
+        tail_mean = tail_var = coverage = 0.0
         if config.use_hs:
             hc = build_huffman(np.asarray(vocab.counts))
             hs_codes = jnp.asarray(hc.codes.astype(np.int8))
             hs_points = jnp.asarray(hc.points)
             hs_len = jnp.asarray(hc.code_len)
-        return cls(keep, alias_accept, alias_idx, hs_codes, hs_points, hs_len)
+            if config.hs_dense_top > 0:
+                split = split_dense_tier(
+                    hc, np.asarray(vocab.counts), config.hs_dense_top
+                )
+                msig = jnp.asarray(split.msig)
+                tail_codes = jnp.asarray(split.tail_codes.astype(np.int8))
+                tail_points = jnp.asarray(split.tail_points)
+                tail_len = jnp.asarray(split.tail_len)
+                tail_mean = split.tail_mean
+                tail_var = split.tail_var
+                coverage = split.coverage
+        return cls(
+            keep, alias_accept, alias_idx, hs_codes, hs_points, hs_len,
+            hs_msig=msig, hs_tail_codes=tail_codes,
+            hs_tail_points=tail_points, hs_tail_len=tail_len,
+            hs_tail_mean=tail_mean, hs_tail_var=tail_var,
+            hs_dense_coverage=coverage,
+        )

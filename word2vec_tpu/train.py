@@ -266,6 +266,8 @@ class Trainer:
                         }
                         if "clip_engaged" in m:
                             rec["clip_engaged_rows"] = float(m["clip_engaged"])
+                        if "hs_tail_dropped" in m:
+                            rec["hs_tail_dropped"] = float(m["hs_tail_dropped"])
                         self.log_fn(rec)
                 if checkpoint_every and checkpoint_cb and state.step % checkpoint_every == 0:
                     checkpoint_cb(state)
@@ -594,4 +596,9 @@ class Trainer:
                 # healthy runs; a persistently large value means the cap is
                 # reshaping training, not just catching spikes
                 rec["clip_engaged_rows"] = float(np.sum(m["clip_engaged"]))
+            if "hs_tail_dropped" in m:
+                # two-tier hs tail-compaction observability
+                # (config.hs_tail_slots): slots whose updates were dropped
+                # by the +6-sigma bound — statistically 0 on real corpora
+                rec["hs_tail_dropped"] = float(np.sum(m["hs_tail_dropped"]))
             self.log_fn(rec)

@@ -2,7 +2,8 @@
 # Round-4 follow-up measurement queue — runs AFTER tpu_queue4.sh (the
 # chip flock in tpu_queue_lib.sh serializes them: launched while queue4
 # holds the lock this script just exits; benchmarks/tpu_supervisor4.sh
-# keeps re-launching it until its COMPLETE line lands in queue.log).
+# keeps re-launching it until every run_item here has a banked JSON in
+# benchmarks/TPU_R4/ — the COMPLETE log lines are informational only).
 #
 # Items here are the levers invented or re-designed mid-round plus the
 # combo escalations that depend on the queue4 singles:
@@ -38,6 +39,10 @@ run_item hs_dim200_dense1024  900 "$TPU" $B --train-method hs --dim 200 --hs-den
 run_item pallas               900 "$TPU" $B --band-backend pallas
 run_item slab_sorted          900 "$TPU" $B --slab-scatter 1
 run_item b1024                900 "$TPU" $B --batch-rows 1024
+# b512 measured BELOW default-256 (27.2x vs 30.4x): the optimum may sit
+# under 256 — sweep downward too
+run_item b128                 900 "$TPU" $B --batch-rows 128
+run_item b192                 900 "$TPU" $B --batch-rows 192
 run_item c192                 900 "$TPU" $B --chunk-cap 192
 run_item pallas_c96           900 "$TPU" $B --band-backend pallas --chunk-cap 96
 run_item pallas_b512          900 "$TPU" $B --band-backend pallas --batch-rows 512

@@ -2,27 +2,42 @@
 # Round-4 queue supervisor: make sure the measurement queues run to
 # completion no matter how the tunnel or their processes behave.
 #
-#   1. While tpu_queue4.sh hasn't logged its COMPLETE line, relaunch it
-#      whenever no instance is running (the flock guard makes a redundant
-#      launch a no-op, so the only cost of a race is one refused-launch
-#      log line).
-#   2. Then do the same for tpu_queue4b.sh.
-#
-# The queues themselves are restart-safe (banked items skip, failed items
-# retry), so the supervisor's only job is existence, not ordering.
+# The queues are restart-safe (banked items skip instantly, failed items
+# retry on the next launch) and mutually exclusive (the chip flock in
+# tpu_queue_lib.sh makes a second concurrent instance exit), so the
+# supervisor simply keeps relaunching a queue until every item has banked.
+# This fixes the v1 supervisor's gap: it stopped relaunching a queue once
+# its COMPLETE line appeared in the log, so items that FAILED during that
+# pass (e.g. the tunnel dying mid-item) never retried. queue4 is always
+# relaunched while it has unbanked items — they take priority over
+# queue4b's, matching the items' intended ordering.
 #
 # Usage: nohup bash benchmarks/tpu_supervisor4.sh >/dev/null 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
-LOG=benchmarks/TPU_R4/queue.log
+OUT=benchmarks/TPU_R4
+LOG=$OUT/queue.log
 
-while ! grep -qs "QUEUE COMPLETE" "$LOG"; do
-  pgrep -f "bash benchmarks/tpu_queue4.sh" >/dev/null \
-    || nohup bash benchmarks/tpu_queue4.sh >/dev/null 2>&1 &
+items_banked() {  # items_banked <queue-script>...
+  local n
+  for n in $(grep -hoE '^run_item +[A-Za-z0-9_]+' "$@" | awk '{print $2}'); do
+    [ -s "$OUT/$n.json" ] || return 1
+  done
+  return 0
+}
+
+# Priority: queue4 items > queue4b items > the trace (a persistently
+# failing trace capture must not starve the ~20 queue4b items — when only
+# the trace is left, queue4 relaunches skip straight to run_trace anyway).
+until items_banked benchmarks/tpu_queue4.sh benchmarks/tpu_queue4b.sh \
+      && [ -s "$OUT/trace_report.txt" ]; do
+  if ! pgrep -f "bash benchmarks/tpu_queue4" >/dev/null; then
+    if items_banked benchmarks/tpu_queue4.sh \
+       && ! items_banked benchmarks/tpu_queue4b.sh; then
+      nohup bash benchmarks/tpu_queue4b.sh >/dev/null 2>&1 &
+    else
+      nohup bash benchmarks/tpu_queue4.sh >/dev/null 2>&1 &
+    fi
+  fi
   sleep 600
 done
-while ! grep -qs "QUEUE4B COMPLETE" "$LOG"; do
-  pgrep -f "bash benchmarks/tpu_queue4b.sh" >/dev/null \
-    || nohup bash benchmarks/tpu_queue4b.sh >/dev/null 2>&1 &
-  sleep 600
-done
-echo "$(date -u +%FT%TZ) supervisor: all round-4 queues complete" >> "$LOG"
+echo "$(date -u +%FT%TZ) supervisor: every round-4 queue item banked" >> "$LOG"

@@ -360,6 +360,18 @@ def make_hs_train_step(
         )
         return new_syn1, clip_count, dropped
 
+    def dense_slice_add(new_out, d_top, k_sr):
+        """The dense tier's table update: one contiguous slice add onto the
+        top-P rows — disjoint from every tail id, and applied AFTER the tail
+        scatter so the SR destination grid reads the latest table state."""
+        top0 = new_out.shape[0] - P
+        return new_out.at[top0:].add(
+            _cast_update(
+                d_top, new_out.dtype, k_sr(2),
+                new_out[top0:] if sr else None,
+            )
+        )
+
     def center_scatter(emb_in, tok, d_h, ctx_weight, k_sr, clip_state):
         """sg center-row update: W.row(center) += accumulated grad (:351)."""
         B, L = tok.shape
@@ -451,14 +463,7 @@ def make_hs_train_step(
                     )
                 else:
                     new_out = syn1
-                # dense-tier slice add — rows disjoint from every tail id
-                top0 = syn1.shape[0] - P
-                new_out = new_out.at[top0:].add(
-                    _cast_update(
-                        d_top, syn1.dtype, k_sr(2),
-                        new_out[top0:] if sr else None,
-                    )
-                )
+                new_out = dense_slice_add(new_out, d_top, k_sr)
             else:
                 (paths, d_rows, _touched, out_touch, d_h, loss, pairs,
                  ctx_hit) = sg_sweep(
@@ -524,13 +529,7 @@ def make_hs_train_step(
                     )
                 else:
                     new_out = syn1
-                top0 = syn1.shape[0] - P
-                new_out = new_out.at[top0:].add(
-                    _cast_update(
-                        d_top, syn1.dtype, k_sr(2),
-                        new_out[top0:] if sr else None,
-                    )
-                )
+                new_out = dense_slice_add(new_out, d_top, k_sr)
             else:
                 paths, d_rows, m, d_h, loss, pairs = cbow_path_block(
                     h, tok, active, syn1, alpha,

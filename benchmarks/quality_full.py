@@ -54,6 +54,8 @@ def main() -> None:
                     default="float32", help="table storage dtype (passthrough)")
     ap.add_argument("--sr", type=int, default=0, choices=[0, 1],
                     help="stochastic rounding (bf16 tables; passthrough)")
+    ap.add_argument("--hs-dense-top", type=int, default=0,
+                    help="two-tier hs dense tier (config.hs_dense_top)")
     ap.add_argument("--analogy", action="store_true",
                     help="analogy mode: train on the compositional-grid "
                     "corpus (utils/synthetic.analogy_corpus) and score "
@@ -118,6 +120,8 @@ def main() -> None:
         if args.table_dtype != "float32":
             cmd += ["--table-dtype", args.table_dtype,
                     "--stochastic-rounding", str(args.sr)]
+        if args.hs_dense_top:
+            cmd += ["--hs-dense-top", str(args.hs_dense_top)]
         env = {
             **os.environ,
             "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -183,6 +187,8 @@ def main() -> None:
             kernel += f" kp={args.shared_negatives}"
     if args.table_dtype != "float32":
         kernel += f", {args.table_dtype} tables" + (" +sr" if args.sr else "")
+    if args.hs_dense_top:
+        kernel += f", dense-top={args.hs_dense_top}"
     print(json.dumps({
         "platform": platform,
         "device_kind": device_kind,

@@ -64,6 +64,11 @@ run_item negbatch_b512        900 "$TPU" $B --neg-scope batch --kp 256 --batch-r
 run_item bf16sr_negbatch      900 "$TPU" $B --table-dtype bfloat16 --sr 1 --neg-scope batch --kp 256
 run_item slab_rbg_b512        900 "$TPU" $B --slab-scatter 1 --prng rbg --batch-rows 512
 
+# on-chip at-scale quality of the two-tier hs update (CPU row in
+# QUALITY_FULL_r4_cpu.txt; this is the on-chip counterpart)
+run_item quality_hs_dense512 2400 "$TPU" \
+  python benchmarks/quality_full.py --tokens 4000000 --train-method hs --dim 300 --hs-dense-top 512
+
 # --- deferred retry: wedged once at 900s, tunnel died around the kill --------
 run_item full_stack          1800 "$TPU" $B --fused 1 --chunk-cap 96 --neg-scope batch --kp 256 --table-dtype bfloat16 --sr 1
 

@@ -62,7 +62,19 @@ LEVERS = {
                "library-eligible (margin-neutral)"),
     "fused": (None, "library-eligible (ns band only; bitwise-identical)"),
     "kp32": (None, "library-eligible (r3 matrix delta +0.0139)"),
+    "b128": (None, "library-eligible (geometry; parity-invariant)"),
+    "b192": (None, "library-eligible (geometry; parity-invariant)"),
+    "hs_dim200_dense512": (
+        None, "library-eligible for hs (one-tier-exact semantics, "
+        "tests/test_hs_dense.py; at-scale quality: QUALITY_FULL_r4 rows)"),
+    "hs_dim200_dense1024": (
+        None, "library-eligible for hs (one-tier-exact semantics)"),
 }
+
+# Each un-levered config item defines the words/sec bar for ITS metric;
+# every lever item is compared against the bar sharing its metric string
+# (hs_dim200_dense512 vs hs_dim200, etc.). "default" is the flagship bar.
+BASE_ITEMS = ("default", "hs_dim200", "cbow_dim100", "sg_w10")
 
 
 def load_parity_rows() -> list:
@@ -109,24 +121,23 @@ def main() -> None:
         if key not in records or rec["value"] > records[key]["value"]:
             records[key] = rec
 
-    base = records.get(
-        ("default", "sg+ns-dim300-w5-k5 words/sec (zipf-synthetic-17M, tpu)")
-    )
-    if base is None:
-        # fall back to any record named 'default'
-        cands = [r for (n, _), r in records.items() if n == "default"]
-        base = max(cands, key=lambda r: r["value"]) if cands else None
-    if base is None:
-        print("no banked on-chip 'default' record — nothing to compare")
+    bars: dict = {}  # metric -> (bar item name, record)
+    for bn in BASE_ITEMS:
+        for (name, metric), rec in records.items():
+            if name == bn and metric not in bars:
+                bars[metric] = (bn, rec)
+    if not bars:
+        print("no banked on-chip un-levered config record — nothing to compare")
         return
-    print(
-        f"default: {base['value']:,.0f} words/sec "
-        f"({base.get('vs_baseline')}x baseline), metric "
-        f"{base.get('metric')!r} — the bar to beat\n"
-    )
+    for metric, (bn, rec) in sorted(bars.items()):
+        print(
+            f"bar [{bn}]: {rec['value']:,.0f} words/sec "
+            f"({rec.get('vs_baseline')}x baseline) on {metric!r}"
+        )
+    print()
     parity = load_parity_rows()
     for (name, metric), rec in sorted(records.items()):
-        if name == "default":
+        if name in BASE_ITEMS:
             continue
         selectors, note = LEVERS.get(name, (None, "unclassified"))
         dm = parity_delta(parity, selectors)
@@ -135,16 +146,17 @@ def main() -> None:
             else f"delta_margin {dm:+.4f} "
             + ("OK" if dm >= -NOISE else "QUALITY-NEGATIVE")
         )
-        if metric != base.get("metric"):
-            verdict = f"INCOMPARABLE (metric {metric!r})"
+        if metric not in bars:
+            verdict = f"INCOMPARABLE (no bar for metric {metric!r})"
         else:
+            bn, base = bars[metric]
             ratio = rec["value"] / base["value"]
             if ratio < 1.0:
-                verdict = f"{ratio:5.2f}x default -> KEEP default"
+                verdict = f"{ratio:5.2f}x {bn} -> KEEP default"
             elif dm is not None and dm < -NOISE:
-                verdict = f"{ratio:5.2f}x default -> BLOCKED by quality"
+                verdict = f"{ratio:5.2f}x {bn} -> BLOCKED by quality"
             else:
-                verdict = f"{ratio:5.2f}x default -> PROMOTE ({note})"
+                verdict = f"{ratio:5.2f}x {bn} -> PROMOTE ({note})"
         print(f"{name:22s} {rec['value']:>12,.0f} w/s  [{q}]  {verdict}")
 
 

@@ -41,3 +41,6 @@ until items_banked benchmarks/tpu_queue4.sh benchmarks/tpu_queue4b.sh \
   sleep 600
 done
 echo "$(date -u +%FT%TZ) supervisor: every round-4 queue item banked" >> "$LOG"
+# leave the mechanical promotion verdicts next to the evidence they rest on
+python benchmarks/promote_defaults.py > "$OUT/promotion_report.txt" 2>&1 \
+  && echo "$(date -u +%FT%TZ) promotion report written" >> "$LOG"

@@ -269,10 +269,13 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     words = 0
     steps = 0
     chunk_metrics = []
+    dropped_metrics = []
     t0 = time.perf_counter()
     for chunk_words, dispatch in dispatches():
         params, m = dispatch(params, steps)
         chunk_metrics.append(m["pairs"])
+        if "hs_tail_dropped" in m:
+            dropped_metrics.append(m["hs_tail_dropped"])
         words += chunk_words
         steps += S
         if args.measure_steps and steps >= args.measure_steps:
@@ -280,7 +283,10 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     jax.block_until_ready(params)
     dt = time.perf_counter() - t0
     wps = words / dt
-    pairs = float(sum(float(np.sum(jax.device_get(p))) for p in chunk_metrics))
+    def sum_device(xs):
+        return float(sum(float(np.sum(jax.device_get(x))) for x in xs))
+
+    pairs = sum_device(chunk_metrics)
 
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
@@ -335,9 +341,12 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         record["tpu_fallback_reason"] = platform_note
     if tables.hs_msig is not None:
         # two-tier hs observability: the banked record shows what share of
-        # token-weighted path entries the measured dense tier covered
+        # token-weighted path entries the measured dense tier covered, and
+        # whether the tail-compaction bound dropped ANY updates during the
+        # timed epoch — a throughput number must not hide dropped work
         record["hs_dense_top"] = int(tables.hs_msig.shape[1])
         record["hs_dense_coverage"] = round(tables.hs_dense_coverage, 4)
+        record["hs_tail_dropped"] = sum_device(dropped_metrics)
     return record
 
 

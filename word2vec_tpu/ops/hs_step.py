@@ -310,16 +310,46 @@ def make_hs_train_step(
         loss = -jnp.sum(m * jnp.where(label > 0.5, ls, ls - logit))
         return paths, d_rows, m, d_h_add, loss, jnp.sum(m)
 
+    def sorted_scatter(table, flat_idx, vals, weights, sr_key, clip_state):
+        """THE table-update tail every scatter in this kernel shares:
+        argsort by destination row (XLA's sorted-indices fast path), then
+        scatter_mean normalization, per-row trust region, and the
+        SR-aware accumulate. flat_idx [N], vals [N, d], weights [N] (only
+        read under scatter_mean). Returns (new_table, clip_count).
+        """
+        order = jnp.argsort(flat_idx)
+        flat_idx = flat_idx[order]
+        vals = vals[order]
+        if scatter_mean:
+            vals = vals * _dup_mean_scale(
+                table.shape[0], flat_idx, weights[order]
+            )[:, None]
+        clip_count = clip_state
+        if clip_tau > 0.0:
+            scale = _row_clip_scale(
+                table.shape[0], clip_tau, (flat_idx, vals), tp_axis=tp_axis
+            )
+            clip_count = clip_count + jnp.sum(
+                (scale < 1.0).astype(jnp.float32)
+            )
+            vals = vals * scale[flat_idx][:, None]
+        new_table = table.at[flat_idx].add(
+            _cast_update(
+                vals, table.dtype, sr_key, table[flat_idx] if sr else None
+            ),
+            indices_are_sorted=True,
+        )
+        return new_table, clip_count
+
     def path_scatter(
         syn1, flat_p, vals, weights, touched, T, k_sr, clip_state
     ):
-        """Sorted (optionally compacted) scatter of path rows into syn1.
-
-        flat_p/weights/touched are [B, Sl]-shaped (vals [B, Sl, d]); T = 0
-        scatters every slot (the one-tier path); T > 0 compacts each batch
-        row to its first T touched slots (stable argsort keeps slot order),
-        dropping any overflow — counted and returned so the quality impact
-        is observable. Returns (new_syn1, clip_count, dropped).
+        """Path-row scatter, optionally compacted. flat_p/weights/touched
+        are [B, Sl]-shaped (vals [B, Sl, d]); T = 0 scatters every slot
+        (the one-tier path); T > 0 compacts each batch row to its first T
+        touched slots (stable argsort keeps slot order), dropping any
+        overflow — counted and returned so the quality impact is
+        observable. Returns (new_syn1, clip_count, dropped).
         """
         B = flat_p.shape[0]
         dropped = jnp.float32(0.0)
@@ -334,29 +364,12 @@ def make_hs_train_step(
             dropped = jnp.sum(
                 jnp.maximum(n_touched - T, 0).astype(jnp.float32)
             )
-        flat_p = flat_p.reshape(-1)
-        vals = vals.reshape(-1, vals.shape[-1])
-        order = jnp.argsort(flat_p)
-        flat_p = flat_p[order]
-        vals = vals[order]
-        if scatter_mean:
-            vals = vals * _dup_mean_scale(
-                syn1.shape[0], flat_p, weights.reshape(-1)[order]
-            )[:, None]
-        clip_count = clip_state
-        if clip_tau > 0.0:
-            scale = _row_clip_scale(
-                syn1.shape[0], clip_tau, (flat_p, vals), tp_axis=tp_axis
-            )
-            clip_count = clip_count + jnp.sum(
-                (scale < 1.0).astype(jnp.float32)
-            )
-            vals = vals * scale[flat_p][:, None]
-        new_syn1 = syn1.at[flat_p].add(
-            _cast_update(
-                vals, syn1.dtype, k_sr(1), syn1[flat_p] if sr else None
-            ),
-            indices_are_sorted=True,
+        new_syn1, clip_count = sorted_scatter(
+            syn1,
+            flat_p.reshape(-1),
+            vals.reshape(-1, vals.shape[-1]),
+            weights.reshape(-1) if weights is not None else None,
+            k_sr(1), clip_state,
         )
         return new_syn1, clip_count, dropped
 
@@ -373,29 +386,17 @@ def make_hs_train_step(
         )
 
     def center_scatter(emb_in, tok, d_h, ctx_weight, k_sr, clip_state):
-        """sg center-row update: W.row(center) += accumulated grad (:351)."""
+        """sg center-row update: W.row(center) += accumulated grad (:351).
+
+        Pre-sorted like every other table scatter in this kernel; the
+        reorder only reassociates the f32 duplicate-row sums, inside the
+        goldens' tolerance.
+        """
         B, L = tok.shape
-        flat_c = tok.reshape(-1)
-        vals = d_h.reshape(B * L, -1)
-        if scatter_mean:
-            vals = vals * _dup_mean_scale(
-                emb_in.shape[0], flat_c, ctx_weight.reshape(-1)
-            )[:, None]
-        clip_count = clip_state
-        if clip_tau > 0.0:
-            scale = _row_clip_scale(
-                emb_in.shape[0], clip_tau, (flat_c, vals), tp_axis=tp_axis
-            )
-            clip_count = clip_count + jnp.sum(
-                (scale < 1.0).astype(jnp.float32)
-            )
-            vals = vals * scale[flat_c][:, None]
-        new_in = emb_in.at[flat_c].add(
-            _cast_update(
-                vals, emb_in.dtype, k_sr(0), emb_in[flat_c] if sr else None
-            )
+        return sorted_scatter(
+            emb_in, tok.reshape(-1), d_h.reshape(B * L, -1),
+            ctx_weight.reshape(-1), k_sr(0), clip_state,
         )
-        return new_in, clip_count
 
     def step(
         params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
@@ -554,56 +555,26 @@ def make_hs_train_step(
                 d_in_slab = banded.band_vs_slab(band_f, d_h, W, S, cdt)
                 slab_ids = banded.slab_token_ids(tok, W, S)
                 ok = slab_ids >= 0
-                slab_flat = jnp.where(ok, slab_ids, 0).reshape(-1)
-                sorder = jnp.argsort(slab_flat)
-                sflat = slab_flat[sorder]
-                vals = jnp.where(ok[..., None], d_in_slab, 0.0).reshape(
-                    -1, d_in_slab.shape[-1]
-                )[sorder]
-                if scatter_mean:
-                    w = jnp.where(
-                        ok, banded.band_col_sum_slab(band_f), 0.0
-                    ).reshape(-1)[sorder]
-                    vals = vals * _dup_mean_scale(
-                        emb_in.shape[0], sflat, w
-                    )[:, None]
-                if clip_tau > 0.0:
-                    scale = _row_clip_scale(
-                        emb_in.shape[0], clip_tau, (sflat, vals),
-                        tp_axis=tp_axis,
-                    )
-                    clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
-                    vals = vals * scale[sflat][:, None]
-                new_in = emb_in.at[sflat].add(
-                    _cast_update(
-                        vals, emb_in.dtype, k_sr(0),
-                        emb_in[sflat] if sr else None,
+                new_in, clip_count = sorted_scatter(
+                    emb_in,
+                    jnp.where(ok, slab_ids, 0).reshape(-1),
+                    jnp.where(ok[..., None], d_in_slab, 0.0).reshape(
+                        -1, d_in_slab.shape[-1]
                     ),
-                    indices_are_sorted=True,
+                    jnp.where(
+                        ok, banded.band_col_sum_slab(band_f), 0.0
+                    ).reshape(-1) if scatter_mean else None,
+                    k_sr(0), clip_count,
                 )
             else:
                 d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
-                flat_c = tok.reshape(-1)
-                order = jnp.argsort(flat_c)
-                d_in_flat = d_in_pos.reshape(-1, d_in_pos.shape[-1])[order]
-                if scatter_mean:
-                    d_in_flat = d_in_flat * _dup_mean_scale(
-                        emb_in.shape[0], flat_c[order],
-                        banded.band_col_sum(band_f, L, W, S).reshape(-1)[order],
-                    )[:, None]
-                if clip_tau > 0.0:
-                    scale = _row_clip_scale(
-                        emb_in.shape[0], clip_tau, (flat_c[order], d_in_flat),
-                        tp_axis=tp_axis,
-                    )
-                    clip_count += jnp.sum((scale < 1.0).astype(jnp.float32))
-                    d_in_flat = d_in_flat * scale[flat_c[order]][:, None]
-                new_in = emb_in.at[flat_c[order]].add(
-                    _cast_update(
-                        d_in_flat, emb_in.dtype, k_sr(0),
-                        emb_in[flat_c[order]] if sr else None,
-                    ),
-                    indices_are_sorted=True,
+                new_in, clip_count = sorted_scatter(
+                    emb_in,
+                    tok.reshape(-1),
+                    d_in_pos.reshape(-1, d_in_pos.shape[-1]),
+                    banded.band_col_sum(band_f, L, W, S).reshape(-1)
+                    if scatter_mean else None,
+                    k_sr(0), clip_count,
                 )
 
         new_params = dict(params)

@@ -289,6 +289,79 @@ def test_two_tier_tensor_parallel_matches_single_chip(model):
     assert float(m["pairs"]) == pytest.approx(float(ref_m["pairs"]))
 
 
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 virtual devices")
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+@pytest.mark.parametrize("top_p", [0, 6])
+def test_hs_sequence_parallel_conserves_single_chip_update(model, top_p):
+    """sp=2 on the hs kernel (one- and two-tier): the halo exchange must
+    preserve every window pair across the shard boundary with each directed
+    pair trained exactly once, so the SUM of the two shards' update deltas
+    equals the single-chip update. window=1 pins w_eff, subsample off pins
+    keep, and hs draws no negatives — the comparison is exact, not
+    statistical."""
+    from word2vec_tpu.models.params import init_params
+    from word2vec_tpu.parallel import (
+        make_mesh, make_sharded_step, replicate_params,
+    )
+
+    kw = dict(hs_dense_top=top_p, hs_tail_slots=0) if top_p else {}
+    cfg = Word2VecConfig(
+        model=model, train_method="hs", negative=0, word_dim=D, window=1,
+        min_count=1, subsample_threshold=0.0, compute_dtype="float32",
+        max_sentence_len=24, kernel="band", **kw
+    )
+    tables, _ = build_tables(top_p)
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(0, V, size=(4, 24)).astype(np.int32)
+    params = init_params(cfg, V, jax.random.key(7))
+    key = jax.random.key(42)
+    alpha = jnp.float32(ALPHA)
+
+    from word2vec_tpu.ops.train_step import make_train_step as mts
+    single = jax.jit(mts(cfg, tables))
+    ref_new, ref_m = single(params, jnp.asarray(tokens), key, alpha)
+
+    mesh = make_mesh(dp=1, tp=1, sp=2)
+    sharded = make_sharded_step(cfg, tables, mesh)
+    repl = replicate_params(params, mesh)
+    out, m = sharded(repl, jnp.asarray(tokens), key, alpha)
+
+    for k in params:
+        ref_delta = np.asarray(ref_new[k]) - np.asarray(params[k])
+        sp_delta = (np.asarray(out[k][0]) - np.asarray(params[k])) + (
+            np.asarray(out[k][1]) - np.asarray(params[k])
+        )
+        np.testing.assert_allclose(sp_delta, ref_delta, atol=1e-4, err_msg=k)
+    assert float(m["pairs"]) == pytest.approx(float(ref_m["pairs"]))
+    np.testing.assert_allclose(
+        float(m["loss_sum"]), float(ref_m["loss_sum"]), rtol=1e-4
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_hs_two_tier_trainer_all_axes():
+    """dp=2 x sp=2 x tp=2 with the two-tier hs kernel — full trainer loop."""
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="hs", negative=0, word_dim=16, window=2,
+        min_count=1, subsample_threshold=0, iters=2, batch_rows=4,
+        max_sentence_len=12, init_alpha=0.05, dp_sync_every=4,
+        hs_dense_top=8, kernel="band",
+    )
+    rng = np.random.default_rng(3)
+    sents = [[f"w{j}" for j in rng.integers(0, 20, size=10)]
+             for _ in range(200)]
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    tr = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2, sp=2)
+    state, report = tr.train(log_every=0)
+    assert report.total_words == corpus.num_tokens * cfg.iters
+    for k, v in tr.export_params(state).items():
+        assert np.all(np.isfinite(v)), k
+
+
 def test_config_validation():
     with pytest.raises(ValueError, match="hierarchical softmax"):
         Word2VecConfig(train_method="ns", hs_dense_top=8)

@@ -231,10 +231,12 @@ def test_seq_parallel_trainer_end_to_end_all_axes():
 def test_sp_requires_band_kernel_and_divisibility():
     vocab = Vocab.from_counter({f"w{i}": 5 for i in range(10)}, min_count=1)
     corpus = PackedCorpus.pack([np.arange(10, dtype=np.int32)], 16)
-    cfg_hs = Word2VecConfig(train_method="hs", negative=0, word_dim=8,
-                            min_count=1, max_sentence_len=16)
-    with pytest.raises(ValueError, match="band kernel"):
-        ShardedTrainer(cfg_hs, vocab, corpus, sp=2)
+    # hs rides sp since round 4 (ops/hs_step.py halo exchange) — only the
+    # PAIR kernel still rejects it
+    cfg_pair = Word2VecConfig(train_method="hs", negative=0, word_dim=8,
+                              min_count=1, max_sentence_len=16, kernel="pair")
+    with pytest.raises(ValueError, match="pair"):
+        ShardedTrainer(cfg_pair, vocab, corpus, sp=2)
     cfg_odd = Word2VecConfig(negative=2, word_dim=8, min_count=1,
                              max_sentence_len=15)
     with pytest.raises(ValueError, match="divisible"):

@@ -158,7 +158,10 @@ def make_band_train_step(
     (tests/test_fused.py).
     """
     if not config.use_ns or config.use_hs:
-        raise ValueError("band kernel supports negative sampling only (use pair for hs)")
+        raise ValueError(
+            "band kernel supports negative sampling only "
+            "(hs routes through ops/hs_step.make_hs_train_step)"
+        )
     if fused and config.slab_scatter:
         raise ValueError(
             "fused_tables requires the sorted shared-index scatter "

@@ -61,8 +61,16 @@ shapes for the subsample gate and window shrink, and hs draws no negatives —
 which is what makes exact cross-kernel agreement possible at any window.
 
 Mesh axes: tp_axis shards the embedding dim (logit einsums psum'd before the
-sigmoid); dp_axis folds the PRNG key per shard. Sequence parallelism is not
-implemented for hs (ShardedTrainer validates sp requires the ns band kernel).
+sigmoid); dp_axis folds the PRNG key per shard. sp_axis adds sequence
+(context) parallelism exactly like the ns band kernel (band_step.py): tokens
+[B, L] are sharded along L, each shard halo-exchanges `window` edge tokens
+with its neighbors over ICI (band_step._halo_exchange), and halo positions
+are context-only (their center direction is owned by the neighboring shard),
+so every directed (center, context) pair — and therefore every path-entry
+update — is trained exactly once across shards. hs draws no negatives, so
+with the window shrink and subsample pinned the sum of per-shard deltas
+reproduces the single-chip update exactly (tests/test_hs_dense.py). Like ns,
+the per-row trust region under sp sees shard-local contributions only.
 """
 
 from __future__ import annotations
@@ -76,6 +84,7 @@ import jax.numpy as jnp
 from ..config import Word2VecConfig
 from ..models.params import Params
 from . import banded
+from .band_step import _halo_exchange
 from .tables import DeviceTables
 from .train_step import (
     _cast_update, _dup_mean_scale, _row_clip_scale, _sr_streams,
@@ -113,6 +122,7 @@ def make_hs_train_step(
     tables: DeviceTables,
     tp_axis: str | None = None,
     dp_axis: str | None = None,
+    sp_axis: str | None = None,
 ) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
     """step(params, tokens[B,L], key, alpha) -> (params, metrics).
 
@@ -401,15 +411,26 @@ def make_hs_train_step(
     def step(
         params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
     ) -> Tuple[Params, Metrics]:
-        B, L = tokens.shape
         if dp_axis is not None:
             key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+        center_zone = None
+        if sp_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(sp_axis))
+            Lloc = tokens.shape[1]
+            tokens = _halo_exchange(tokens, W, sp_axis)
+            pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            # halo positions are context-only: their center direction is
+            # owned (and trained) by the neighboring shard
+            center_zone = (pos >= W) & (pos < W + Lloc)
+        B, L = tokens.shape
         k_sub, k_win, _ = jax.random.split(key, 3)
         k_sr = _sr_streams(key, sr)
 
         valid = tokens >= 0
         tok = jnp.where(valid, tokens, 0)
         keep = valid & (jax.random.uniform(k_sub, (B, L)) < tables.keep_probs[tok])
+        if center_zone is not None:
+            keep = keep & center_zone[None, :]
         w_eff = W - jax.random.randint(k_win, (B, L), 0, W, dtype=jnp.int32)
 
         emb_in = params["emb_in"]

@@ -304,22 +304,23 @@ def _make_base_step(
 ):
     if config.resolved_kernel == "band":
         if config.use_hs:
-            if sp_axis is not None:
-                raise ValueError(
-                    "sequence parallelism requires the ns band kernel"
-                )
             if fused:
                 raise ValueError("fused_tables applies to the ns band kernel only")
             from .hs_step import make_hs_train_step
 
-            return make_hs_train_step(config, tables, tp_axis, dp_axis)
+            return make_hs_train_step(
+                config, tables, tp_axis, dp_axis, sp_axis
+            )
         from .band_step import make_band_train_step
 
         return make_band_train_step(
             config, tables, tp_axis, dp_axis, sp_axis, fused
         )
     if sp_axis is not None:
-        raise ValueError("sequence parallelism requires the ns band kernel")
+        raise ValueError(
+            "sequence parallelism requires a band-route kernel (ns band or "
+            "positional hs), not the pair kernel"
+        )
     if fused:
         raise ValueError("fused_tables applies to the ns band kernel only")
     return make_pair_train_step(config, tables, tp_axis, dp_axis)

@@ -385,12 +385,10 @@ class ShardedTrainer(Trainer):
                 f"shorter than window {config.window}; lower sp or raise "
                 f"max_sentence_len"
             )
-        if self.sp > 1 and not (
-            config.resolved_kernel == "band" and config.use_ns
-        ):
+        if self.sp > 1 and config.resolved_kernel != "band":
             raise ValueError(
-                "sequence parallelism (sp > 1) requires the ns band kernel "
-                "(negative sampling)"
+                "sequence parallelism (sp > 1) requires a band-route kernel "
+                "(ns band or positional hs), not the pair kernel"
             )
         if self.sp > 1 and config.scatter_mean:
             raise ValueError(

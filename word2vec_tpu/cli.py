@@ -79,7 +79,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel mesh axis (halo-exchange context "
-                        "parallelism for long rows; band kernel only)")
+                        "parallelism for long rows; band-route kernels — "
+                        "ns band or positional hs)")
     p.add_argument("--dp-sync-every", type=int, default=64)
     p.add_argument("--sync-mode", choices=["mean", "delta"], default="mean",
                    help="replica reconciliation: mean = full-table pmean; "

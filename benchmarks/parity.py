@@ -166,6 +166,8 @@ def main() -> None:
     ap.add_argument("--table-dtype", choices=["float32", "bfloat16"],
                     default="float32",
                     help="table storage dtype for OUR side")
+    ap.add_argument("--hs-dense-top", type=int, default=0,
+                    help="two-tier hs dense tier (config.hs_dense_top)")
     ap.add_argument("--sr", type=int, default=0, choices=[0, 1],
                     help="stochastic rounding for OUR side (bf16 tables)")
     ap.add_argument("--skip-reference", action="store_true",
@@ -202,7 +204,8 @@ def main() -> None:
         f"backend={args.band_backend} "
         f"kp={args.shared_negatives} scope={args.negative_scope} "
         f"dtype={args.table_dtype} sr={args.sr} "
-        f"slab={args.slab_scatter} prng={args.prng}",
+        f"slab={args.slab_scatter} prng={args.prng} "
+        f"dense-top={args.hs_dense_top}",
         "corpus": corpus_name,
     }
     with tempfile.TemporaryDirectory() as tmp:
@@ -237,6 +240,7 @@ def main() -> None:
                 "--prng", args.prng,
                 "--table-dtype", args.table_dtype,
                 "--stochastic-rounding", str(args.sr),
+                "--hs-dense-top", str(args.hs_dense_top),
             ],
             cwd=tmp, check=True, capture_output=True,
             env={**os.environ, "PYTHONPATH": REPO + os.pathsep

@@ -48,14 +48,17 @@ def free_port() -> int:
 
 
 def cli_cmd(train: str, vocab: str, out: str, dp: int, tp: int = 1,
-            iters: int = 3, extra=()) -> list:
+            iters: int = 3, extra=(), method: str = "ns",
+            dense_top: int = 0) -> list:
     return [
         sys.executable, "-m", "word2vec_tpu.cli",
         "-train", train, "-read-vocab", vocab, "-output", out,
-        "-model", "sg", "-train_method", "ns", "-negative", "5",
+        "-model", "sg", "-train_method", method,
+        "-negative", "5" if method == "ns" else "0",
         "-size", "64", "-window", "5", "-iter", str(iters),
         "-min-count", "5", "-subsample", "1e-4",
         "--backend", "cpu", "--dp", str(dp), "--tp", str(tp), "--quiet",
+        *(("--hs-dense-top", str(dense_top)) if dense_top else ()),
         *extra,
     ]
 
@@ -75,6 +78,11 @@ def main() -> None:
                     help="tensor-parallel width WITHIN each process's "
                     "devices (the data axis is the only one that spans "
                     "processes; parallel/multihost.py topology policy)")
+    ap.add_argument("--train-method", choices=["ns", "hs"], default="ns",
+                    help="objective for both runs (hs exercises the "
+                    "distributed backend on the second objective)")
+    ap.add_argument("--hs-dense-top", type=int, default=0,
+                    help="two-tier hs dense tier (config.hs_dense_top)")
     args = ap.parse_args()
 
     from word2vec_tpu.utils.synthetic import topic_corpus, topic_similarity_pairs
@@ -84,7 +92,9 @@ def main() -> None:
     dp = args.procs * args.devices_per_proc // args.tp
 
     result = {
-        "config": f"sg+ns dim=64 iters={args.iters} dp={dp} tp={args.tp} "
+        "config": f"sg+{args.train_method}"
+        f"{f'-dense{args.hs_dense_top}' if args.hs_dense_top else ''} "
+        f"dim=64 iters={args.iters} dp={dp} tp={args.tp} "
         f"over {args.procs} processes x {args.devices_per_proc} virtual "
         f"cpu devices, sync={args.sync_mode}",
         "corpus": f"topic-synthetic-{args.tokens} tokens, "
@@ -139,7 +149,9 @@ def main() -> None:
             procs.append(subprocess.Popen(
                 cli_cmd(f"shard{r}", "vocab.txt", "vec_mp.txt", dp, args.tp,
                         args.iters,
-                        ("--multihost", "--sync-mode", args.sync_mode)),
+                        ("--multihost", "--sync-mode", args.sync_mode),
+                        method=args.train_method,
+                        dense_top=args.hs_dense_top),
                 cwd=tmp, env=env,
                 stdout=log, stderr=subprocess.STDOUT, text=True,
             ))
@@ -177,7 +189,9 @@ def main() -> None:
             ).strip(),
         }
         sp = subprocess.run(
-            cli_cmd("full", "vocab.txt", "vec_sp.txt", dp, args.tp, args.iters),
+            cli_cmd("full", "vocab.txt", "vec_sp.txt", dp, args.tp,
+                    args.iters, method=args.train_method,
+                    dense_top=args.hs_dense_top),
             cwd=tmp, env=env, capture_output=True, text=True,
             timeout=args.timeout,
         )

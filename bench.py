@@ -270,6 +270,12 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     steps = 0
     chunk_metrics = []
     dropped_metrics = []
+    # 1-minute load average at measurement start: on the 1-core bench host
+    # a CPU-fallback number is only comparable across rounds at similar
+    # host load (the r4 CPU artifact dropped 24% vs r3 with the queue
+    # supervisors probing all round — VERDICT r4 weak item 1; this field
+    # lets the artifact distinguish contention from regression)
+    load_start = os.getloadavg()[0] if hasattr(os, "getloadavg") else None
     t0 = time.perf_counter()
     for chunk_words, dispatch in dispatches():
         params, m = dispatch(params, steps)
@@ -337,6 +343,10 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "mfu": round(model_fps / peak, 5) if peak else None,
         "resident_corpus": use_resident,
     }
+    if load_start is not None:
+        record["host_load_1m"] = [
+            round(load_start, 2), round(os.getloadavg()[0], 2),
+        ]
     if platform_note:
         record["tpu_fallback_reason"] = platform_note
     if tables.hs_msig is not None:

@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Bisect the full_stack compile wedge on CPU (VERDICT r4 item 2).
+
+The on-chip item `full_stack` (--fused 1 --chunk-cap 96 --neg-scope batch
+--kp 256 --table-dtype bfloat16 --sr 1) wedged >900 s in XLA compile on
+TPU (TPU_R4/queue.log 04:04 FAILED) while every constituent single
+compiled in seconds. This harness times LOWER + COMPILE (no execute) of
+the resident chunk runner for each lever subset on the CPU backend, so
+the exploding lever pair can be named without burning tunnel time.
+
+CPU and TPU run different XLA backends, so a CPU wedge is evidence, not
+proof — but a combinatorial pass-size explosion (the plausible cause:
+fused [V,2,d] tables x batch-scoped scatter x bf16 SR round-trip inside
+one scan body) shows up as a superlinear compile-time jump on any
+backend.
+
+Writes one JSON line per combo to stdout and a summary table to stderr.
+
+Usage: JAX_PLATFORMS=cpu python benchmarks/compile_bisect.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+LEVERS = {
+    "fused": {"fused_tables": True},
+    "c96": {"_chunk_cap": 96},
+    "negbatch": {"negative_scope": "batch", "shared_negatives": 256},
+    "bf16sr": {"dtype": "bfloat16", "stochastic_rounding": True},
+}
+
+
+def compile_combo(names: tuple, vocab_size: int, tokens: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.data.batcher import BatchIterator, PackedCorpus
+    from word2vec_tpu.models.params import init_params
+    from word2vec_tpu.ops import resident as res
+    from word2vec_tpu.ops.tables import DeviceTables
+    from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+    overrides: dict = {}
+    chunk_cap = 32
+    for n in names:
+        for k, v in LEVERS[n].items():
+            if k == "_chunk_cap":
+                chunk_cap = v
+            else:
+                overrides[k] = v
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=5, word_dim=300,
+        window=5, subsample_threshold=1e-4, batch_rows=256,
+        max_sentence_len=192, **overrides,
+    )
+    vocab = zipf_vocab(vocab_size, 17_000_000)
+    ids = zipf_corpus_ids(vocab, tokens, seed=0)
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    tables = DeviceTables.build(vocab, cfg)
+    params = init_params(cfg, len(vocab), jax.random.key(0))
+    batcher = BatchIterator(corpus, cfg.batch_rows, cfg.max_sentence_len, seed=1)
+    S, _ = cfg.chunk_geometry(batcher.steps_per_epoch(), cap=chunk_cap)
+    alphas = jnp.full((S,), cfg.init_alpha, jnp.float32)
+    corpus_dev = res.device_corpus(corpus)
+    order_dev = jnp.asarray(
+        res.epoch_order(1, 0, corpus.num_rows).astype(np.int32)
+    )
+    fn = jax.jit(
+        res.make_resident_chunk_runner(cfg, tables), donate_argnums=0
+    )
+
+    t0 = time.perf_counter()
+    lowered = fn.lower(
+        params, corpus_dev, order_dev, jax.random.key(7), 0, 0, alphas
+    )
+    t_lower = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t0
+    # HLO size proxies: a pass-size explosion shows up in instruction count
+    # even when this backend's pass pipeline doesn't wedge on it
+    try:
+        hlo_lines = len(compiled.as_text().splitlines())
+    except Exception:  # noqa: BLE001 — size proxy only
+        hlo_lines = -1
+    return {
+        "combo": "+".join(names) if names else "none",
+        "S": int(S),
+        "lower_s": round(t_lower, 2),
+        "compile_s": round(t_compile, 2),
+        "hlo_lines": hlo_lines,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small vocab/corpus (shape-independent wedges only)")
+    ap.add_argument("--vocab", type=int, default=0)
+    ap.add_argument("--tokens", type=int, default=0)
+    args = ap.parse_args()
+    vocab = args.vocab or (8000 if args.quick else 71000)
+    tokens = args.tokens or (400_000 if args.quick else 2_000_000)
+
+    names = list(LEVERS)
+    combos = [()]
+    combos += [(n,) for n in names]
+    combos += list(itertools.combinations(names, 2))
+    combos += list(itertools.combinations(names, 3))
+    combos += [tuple(names)]
+
+    rows = []
+    for combo in combos:
+        try:
+            rec = compile_combo(combo, vocab, tokens)
+        except Exception as e:  # noqa: BLE001 — record and continue
+            rec = {"combo": "+".join(combo) if combo else "none",
+                   "error": f"{type(e).__name__}: {e}"}
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    print("\ncombo                          lower_s  compile_s  hlo_lines",
+          file=sys.stderr)
+    for r in rows:
+        if "error" in r:
+            print(f"{r['combo']:30s} ERROR {r['error'][:60]}",
+                  file=sys.stderr)
+        else:
+            print(f"{r['combo']:30s} {r['lower_s']:7.2f} {r['compile_s']:9.2f}"
+                  f" {r['hlo_lines']:10d}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-5 TPU measurement queue — ordered for SHORT tunnel windows.
+#
+# Round 4's tunnel was alive ~21 minutes out of 12 hours, in two windows
+# (TPU_R4/queue.log). A banked bench item costs ~35-60 s, so the queue is
+# tiered by decision value per second:
+#
+#   Tier 1 — the six numbers that decide the round (VERDICT r4 items 1-2:
+#            the true Pallas number, the hs two-tier A/B pair, plus the
+#            per-config coverage rows that have never run on chip).
+#   Tier 2 — the fresh step-time trace of the CURRENT default path
+#            (resident chunked runner; the r2 trace predates it — VERDICT
+#            weak item 2). ~3-5 min, after tier 1 so a 4-minute window
+#            still banks the A/B numbers.
+#   Tier 3 — singles sweep (geometry down-sweep b128/b192, chunk caps,
+#            remaining r3/r4 levers never measured on chip).
+#   Tier 4 — combos over whichever singles win.
+#   Tier 5 — quality-at-scale + the enwik9-shape run (long items).
+#   Tier 6 — full_stack retry, LAST: wedged >900 s in compile once
+#            (being bisected on CPU this round; see PERF.md).
+#
+# Re-queued vs TPU_R4: default (the r5 number under the current tree is the
+# regression check and the vs_baseline anchor). NOT re-queued: b512 (27.19x,
+# measured loss) and fused_kp32_c96 (21.85x, measured loss).
+#
+# Usage: nohup bash benchmarks/tpu_queue5.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/TPU_R5
+. benchmarks/tpu_queue_lib.sh
+
+B='python bench.py --probe-retries 1'
+TPU='"platform": "tpu"'
+
+# --- tier 1: the decisive six -------------------------------------------------
+run_item default              900 "$TPU" $B
+run_item pallas               900 "$TPU" $B --band-backend pallas
+run_item hs_dim200            900 "$TPU" $B --train-method hs --dim 200
+run_item hs_dim200_dense512   900 "$TPU" $B --train-method hs --dim 200 --hs-dense-top 512
+run_item cbow_dim100          900 "$TPU" $B --model cbow --dim 100
+run_item sg_w10               900 "$TPU" $B --window 10
+
+# --- tier 2: fresh trace of the real default path ----------------------------
+run_trace /tmp/tr_r5
+
+# --- tier 3: singles ----------------------------------------------------------
+# b512 measured BELOW default-256 (27.2x vs 30.4x): the optimum may sit
+# under 256 — sweep down; b1024 closes the upward bracket.
+run_item b128                 900 "$TPU" $B --batch-rows 128
+run_item b192                 900 "$TPU" $B --batch-rows 192
+run_item b1024                900 "$TPU" $B --batch-rows 1024
+run_item chunk96              900 "$TPU" $B --chunk-cap 96
+run_item c192                 900 "$TPU" $B --chunk-cap 192
+run_item fused                900 "$TPU" $B --fused 1
+run_item kp32                 900 "$TPU" $B --kp 32
+run_item rbg                  900 "$TPU" $B --prng rbg
+run_item slab_sorted          900 "$TPU" $B --slab-scatter 1
+run_item bf16sr               900 "$TPU" $B --table-dtype bfloat16 --sr 1
+run_item negbatch_kp256       900 "$TPU" $B --neg-scope batch --kp 256
+run_item hs_dim200_dense1024  900 "$TPU" $B --train-method hs --dim 200 --hs-dense-top 1024
+
+# --- tier 4: combos -----------------------------------------------------------
+run_item pallas_c96           900 "$TPU" $B --band-backend pallas --chunk-cap 96
+run_item pallas_b512          900 "$TPU" $B --band-backend pallas --batch-rows 512
+run_item pallas_bf16sr        900 "$TPU" $B --band-backend pallas --table-dtype bfloat16 --sr 1
+run_item pallas_negbatch      900 "$TPU" $B --band-backend pallas --neg-scope batch --kp 256
+run_item cbow_dim100_pallas   900 "$TPU" $B --model cbow --dim 100 --band-backend pallas
+run_item negbatch_b512        900 "$TPU" $B --neg-scope batch --kp 256 --batch-rows 512
+run_item bf16sr_negbatch      900 "$TPU" $B --table-dtype bfloat16 --sr 1 --neg-scope batch --kp 256
+run_item fused_kp32           900 "$TPU" $B --fused 1 --kp 32
+
+# --- tier 5: quality at scale + enwik9 shape ---------------------------------
+run_item quality_hs_dense512 2400 "$TPU" \
+  python benchmarks/quality_full.py --tokens 4000000 --train-method hs --dim 300 --hs-dense-top 512
+run_item quality_sg_dim300   2400 "$TPU" \
+  python benchmarks/quality_full.py --tokens 4000000
+run_item quality_analogy_dim300 2400 "$TPU" \
+  python benchmarks/quality_full.py --analogy --tokens 4000000
+run_item enwik9_100M         3600 "$TPU" $B --tokens 100000000 --window 10 --run-timeout 3000
+
+# --- tier 6: the compile-wedge retry, last -----------------------------------
+run_item full_stack          1800 "$TPU" $B --fused 1 --chunk-cap 96 --neg-scope batch --kp 256 --table-dtype bfloat16 --sr 1
+
+echo "$(date -u +%FT%TZ) QUEUE5 COMPLETE after $FAILED_PROBES failed probes total" >> "$LOG"

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-5 queue supervisor: keep relaunching tpu_queue5.sh until every
+# item has banked (items skip instantly once banked; failed items retry
+# on the next launch; the chip flock in tpu_queue_lib.sh makes concurrent
+# instances exit). Same design as tpu_supervisor4.sh, pointed at the
+# round-5 queue. When everything lands, drop the mechanical promotion
+# verdicts next to the evidence.
+#
+# Usage: nohup bash benchmarks/tpu_supervisor5.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/TPU_R5
+LOG=$OUT/queue.log
+mkdir -p "$OUT"
+
+items_banked() {  # items_banked <queue-script>...
+  local n
+  for n in $(grep -hoE '^run_item +[A-Za-z0-9_]+' "$@" | awk '{print $2}'); do
+    [ -s "$OUT/$n.json" ] || return 1
+  done
+  return 0
+}
+
+until items_banked benchmarks/tpu_queue5.sh && [ -s "$OUT/trace_report.txt" ]; do
+  if ! pgrep -f "bash benchmarks/tpu_queue5" >/dev/null; then
+    nohup bash benchmarks/tpu_queue5.sh >/dev/null 2>&1 &
+  fi
+  sleep 600
+done
+echo "$(date -u +%FT%TZ) supervisor: every round-5 queue item banked" >> "$LOG"
+python benchmarks/promote_defaults.py > "$OUT/promotion_report.txt" 2>&1 \
+  && echo "$(date -u +%FT%TZ) promotion report written" >> "$LOG"

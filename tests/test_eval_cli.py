@@ -68,3 +68,57 @@ def test_analogies(vec_file, tmp_path):
     assert r.returncode == 0, r.stderr
     out = json.loads(r.stdout)
     assert out["total"] == 1
+
+
+def test_convert_simlex_style(tmp_path):
+    """SimLex-999 shape: tab-separated, header, score in column 3."""
+    src = tmp_path / "simlex.txt"
+    src.write_text(
+        "word1\tword2\tPOS\tSimLex999\tconc(w1)\n"
+        "Old\tNew\tA\t1.58\t2.72\n"
+        "smart\tintelligent\tA\t9.2\t1.75\n"
+    )
+    dst = tmp_path / "out.csv"
+    r = _run(["convert", str(src), str(dst), "--cols", "0,1,3"])
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["pairs_written"] == 2
+    assert dst.read_text() == "old,new,1.58\nsmart,intelligent,9.2\n"
+
+
+def test_convert_men_style_roundtrips_through_ws353(vec_file, tmp_path):
+    """MEN shape (space-separated, no header) -> canonical CSV -> the same
+    ws353 scorer the training gate uses."""
+    src = tmp_path / "men.txt"
+    src.write_text("king queen 45.0\nman woman 42.5\nparis germany 11.0\n")
+    dst = tmp_path / "men.csv"
+    r = _run(["convert", str(src), str(dst)])
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["pairs_written"] == 3
+    r = _run(["ws353", vec_file, str(dst)])
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["pairs_used"] == 3
+
+
+def test_convert_rejects_bad_rows(tmp_path):
+    src = tmp_path / "bad.txt"
+    src.write_text("w1,w2,3.0\nonly_two,cols\n")
+    dst = tmp_path / "out.csv"
+    r = _run(["convert", str(src), str(dst)])
+    assert r.returncode != 0
+    assert "columns" in (r.stderr or "") or "Error" in (r.stderr or "")
+
+
+def test_committed_fixture_loads_with_unique_ranks():
+    """The committed 20-pair fixture must keep UNIQUE scores: tied gold
+    scores are exactly how the synthetic eval saturated spearman at the
+    0.866 tie ceiling (VERDICT r4 weak item 5)."""
+    from word2vec_tpu.eval.similarity import load_word_pairs
+
+    fixture = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures", "wordsim_fixture_20.csv",
+    )
+    pairs = load_word_pairs(fixture)
+    assert len(pairs) == 20
+    scores = [s for _, _, s in pairs]
+    assert len(set(scores)) == 20

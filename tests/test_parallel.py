@@ -228,15 +228,63 @@ def test_seq_parallel_trainer_end_to_end_all_axes():
         assert np.all(np.isfinite(v)), k
 
 
-def test_sp_requires_band_kernel_and_divisibility():
+def test_pair_kernel_sequence_parallel_conserves_the_update():
+    """sp=2 on the PAIR kernel (r5: the last hole in the kernel x
+    parallelism matrix — ops/train_step.make_pair_train_step sp_axis).
+    Same exactness setup as the band conservation test above: window=1
+    pins w_eff, subsample off pins keep, degenerate negatives pin draws,
+    so the sum of shard deltas must equal the single-chip update."""
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=2, word_dim=D, window=1,
+        min_count=1, subsample_threshold=0.0, compute_dtype="float32",
+        max_sentence_len=24, kernel="pair",
+    )
+    tables = _degenerate_tables()
+    rng = np.random.default_rng(9)
+    tokens = rng.integers(1, V, size=(4, 24)).astype(np.int32)
+    params = init_params(cfg, V, jax.random.key(7))
+    key = jax.random.key(42)
+    alpha = jnp.float32(ALPHA)
+
+    single = jax.jit(make_train_step(cfg, tables))
+    ref_new, ref_metrics = single(params, jnp.asarray(tokens), key, alpha)
+
+    mesh = make_mesh(dp=1, tp=1, sp=2)
+    sharded = make_sharded_step(cfg, tables, mesh)
+    repl = replicate_params(params, mesh)
+    out, metrics = sharded(repl, jnp.asarray(tokens), key, alpha)
+
+    for k in params:
+        ref_delta = np.asarray(ref_new[k]) - np.asarray(params[k])
+        sp_delta = (np.asarray(out[k][0]) - np.asarray(params[k])) + (
+            np.asarray(out[k][1]) - np.asarray(params[k])
+        )
+        np.testing.assert_allclose(sp_delta, ref_delta, atol=1e-4, err_msg=k)
+    assert float(metrics["pairs"]) == pytest.approx(float(ref_metrics["pairs"]))
+
+
+def test_pair_kernel_sp_trainer_end_to_end():
+    """The matrix hole closed end-to-end: kernel=pair trains under sp=2
+    through the full ShardedTrainer loop (previously a ValueError)."""
+    cfg = Word2VecConfig(
+        model="sg", train_method="hs", negative=0, word_dim=8, window=2,
+        min_count=1, subsample_threshold=0, iters=1, batch_rows=4,
+        max_sentence_len=12, kernel="pair",
+    )
+    rng = np.random.default_rng(5)
+    sents = [[f"w{j}" for j in rng.integers(0, 20, size=10)] for _ in range(40)]
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    tr = ShardedTrainer(cfg, vocab, corpus, sp=2)
+    state, report = tr.train(log_every=0)
+    assert report.total_words == corpus.num_tokens * cfg.iters
+    for k, v in tr.export_params(state).items():
+        assert np.all(np.isfinite(v)), k
+
+
+def test_sp_divisibility_and_scatter_mean_validation():
     vocab = Vocab.from_counter({f"w{i}": 5 for i in range(10)}, min_count=1)
     corpus = PackedCorpus.pack([np.arange(10, dtype=np.int32)], 16)
-    # hs rides sp since round 4 (ops/hs_step.py halo exchange) — only the
-    # PAIR kernel still rejects it
-    cfg_pair = Word2VecConfig(train_method="hs", negative=0, word_dim=8,
-                              min_count=1, max_sentence_len=16, kernel="pair")
-    with pytest.raises(ValueError, match="pair"):
-        ShardedTrainer(cfg_pair, vocab, corpus, sp=2)
     cfg_odd = Word2VecConfig(negative=2, word_dim=8, min_count=1,
                              max_sentence_len=15)
     with pytest.raises(ValueError, match="divisible"):

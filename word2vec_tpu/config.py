@@ -126,8 +126,15 @@ class Word2VecConfig:
     # slice add — no gather/scatter — leaving only each word's short path
     # TAIL (~13 padded slots vs ~25) for the positional gather/scatter
     # path. 0 = off (single-tier positional kernel). Perf lever for the
-    # hs on-chip sweep; update semantics are one-tier-exact (same per-pair
-    # math, different aggregation order) — pinned by tests/test_hs_dense.py.
+    # hs on-chip sweep; update semantics are one-tier-exact WHEN the trust
+    # region is not engaged (same per-pair math, different aggregation
+    # order) — pinned by tests/test_hs_dense.py. With clip_row_update > 0
+    # the bounds differ in granularity: the dense tier bounds the summed
+    # update per PAIR ENTRY while the one-tier kernel bounds per SLOT
+    # (across-offset sums taken before the norm), so the two kernels can
+    # diverge whenever the clip actively reshapes a row (the per-pair
+    # bound is >= the per-slot bound, so the dense tier engages no later;
+    # see ops/hs_step.py dense_tier clip notes).
     hs_dense_top: int = 0
     # Tail-scatter compaction bound: -1 = auto (E[touched slots] + 6 sigma
     # from the vocab's tail-length stats — statistically never overflows;

@@ -5,6 +5,7 @@ word2vec toolkit, absent from the reference (SURVEY §3.5).
     python -m word2vec_tpu.eval analogy   vec.txt king man woman
     python -m word2vec_tpu.eval ws353     vec.txt wordsim353.csv
     python -m word2vec_tpu.eval analogies vec.txt questions-words.txt
+    python -m word2vec_tpu.eval convert   SimLex-999.txt out.csv --cols 0,1,3
 
 Vector files: the trainer's text or binary formats (io/embeddings —
 text is auto-detected; pass --binary/--binary-layout otherwise).
@@ -63,7 +64,49 @@ def main(argv=None) -> int:
     p.add_argument("vectors")
     p.add_argument("questions_file")
 
+    p = sub.add_parser(
+        "convert",
+        help="normalize a similarity dataset (WordSim-353 / SimLex-999 / "
+        "MEN / any delimited word-pair file) into the canonical "
+        "word1,word2,score CSV the --eval-ws353 gate reads",
+    )
+    p.add_argument("src")
+    p.add_argument("dst")
+    p.add_argument("--cols", default="0,1,2",
+                   help="0-based columns of word1,word2,score (SimLex-999: "
+                   "0,1,3)")
+    p.add_argument("--delimiter", default=None,
+                   help="explicit field delimiter (default: sniff , tab "
+                   "then whitespace)")
+    p.add_argument("--keep-case", action="store_true",
+                   help="do not lowercase words")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "convert":
+        from .similarity import convert_pairs_file
+
+        try:
+            cols = tuple(int(c) for c in args.cols.split(","))
+        except ValueError:
+            print(f"error: --cols must be three integers, got {args.cols!r}",
+                  file=sys.stderr)
+            return 1
+        if len(cols) != 3 or any(c < 0 for c in cols):
+            print("error: --cols needs exactly three non-negative indices",
+                  file=sys.stderr)
+            return 1
+        try:
+            n = convert_pairs_file(
+                args.src, args.dst, cols=cols, delimiter=args.delimiter,
+                lower=not args.keep_case,
+            )
+        except (ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps({"pairs_written": n, "dst": args.dst}))
+        return 0
+
     vocab, W = _load(args)
 
     if args.cmd == "neighbors":

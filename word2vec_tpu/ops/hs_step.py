@@ -103,6 +103,16 @@ def resolve_tail_slots(
     tail-length stats — at most L positions contribute tail_len slots each,
     so mean L*mu and (independence approximation) variance L*var. The +Ct
     headroom covers tiny-L cases where the normal approximation is poor.
+
+    The variance term assumes tail lengths are INDEPENDENT across a row's
+    positions. Real corpora are bursty/topically correlated (a rare-word
+    run inflates many positions' tails together), so overflow can occur
+    more often than "statistically never" — and when it does, drops are
+    deterministic in slot order, biasing against late positions. Two
+    mitigations: the per-chunk hs_tail_dropped metric banks in every bench
+    record and training log, and the training driver warns when it is
+    persistently nonzero (Trainer._note_tail_dropped) — the fix then is a
+    larger explicit hs_tail_slots or hs_tail_slots=0 (compaction off).
     """
     if config.hs_tail_slots == 0 or slots == 0:
         return 0
@@ -463,7 +473,6 @@ def make_hs_train_step(
                     h, A, N, syn1, alpha
                 )
                 clip_count += c_cnt
-                ctx_hit = banded.band_row_sum(band_f, L) > 0
                 if Ct:
                     (paths, d_rows, touched, out_touch, d_h_tail, t_loss,
                      t_pairs, ctx_hit) = sg_sweep(
@@ -484,6 +493,9 @@ def make_hs_train_step(
                         T, k_sr, clip_count,
                     )
                 else:
+                    # no tail tier: sg_sweep didn't run, so derive the
+                    # center-activity mask from the band directly
+                    ctx_hit = banded.band_row_sum(band_f, L) > 0
                     new_out = syn1
                 new_out = dense_slice_add(new_out, d_top, k_sr)
             else:

@@ -317,14 +317,9 @@ def _make_base_step(
         return make_band_train_step(
             config, tables, tp_axis, dp_axis, sp_axis, fused
         )
-    if sp_axis is not None:
-        raise ValueError(
-            "sequence parallelism requires a band-route kernel (ns band or "
-            "positional hs), not the pair kernel"
-        )
     if fused:
         raise ValueError("fused_tables applies to the ns band kernel only")
-    return make_pair_train_step(config, tables, tp_axis, dp_axis)
+    return make_pair_train_step(config, tables, tp_axis, dp_axis, sp_axis)
 
 
 def make_pair_train_step(
@@ -332,13 +327,14 @@ def make_pair_train_step(
     tables: DeviceTables,
     tp_axis: str | None = None,
     dp_axis: str | None = None,
+    sp_axis: str | None = None,
 ) -> Callable[[Params, jnp.ndarray, jax.Array, jnp.ndarray], Tuple[Params, Metrics]]:
     """Build the jittable step(params, tokens[B,L], key, alpha) -> (params, metrics).
 
     All config values are closed over as static; `tables` arrays become
     captured device constants.
 
-    Mesh axes (both None for single chip; set by parallel/ inside shard_map):
+    Mesh axes (all None for single chip; set by parallel/ inside shard_map):
       tp_axis: embedding dim is sharded over this axis; logits are psum'd
                (see _score_and_update). All index/mask computation is
                replicated across tp shards (same key => same draws).
@@ -347,6 +343,14 @@ def make_pair_train_step(
                draws decorrelate. Replicas are periodically averaged by
                parallel.sync_params (the TPU-native analog of Hogwild's shared
                memory, SURVEY §5 "distributed communication backend").
+      sp_axis: each shard holds a [B, Lloc] column slice of the sequence and
+               exchanges a W-token halo with its neighbors over ICI
+               (band_step._halo_exchange — the same contract as the band/hs
+               kernels, closing the last hole in the kernel x parallelism
+               matrix, VERDICT r4 item 7). Halo positions are context-only:
+               their center direction is owned by the neighboring shard, so
+               every (center, context) pair is enumerated exactly once
+               globally and the per-shard table deltas sum correctly.
     """
     W = config.window
     K = config.negative
@@ -365,9 +369,20 @@ def make_pair_train_step(
     def step(
         params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
     ) -> Tuple[Params, Metrics]:
-        B, L = tokens.shape
         if dp_axis is not None:
             key = jax.random.fold_in(key, jax.lax.axis_index(dp_axis))
+        center_zone = None
+        if sp_axis is not None:
+            from .band_step import _halo_exchange
+
+            key = jax.random.fold_in(key, jax.lax.axis_index(sp_axis))
+            Lloc = tokens.shape[1]
+            tokens = _halo_exchange(tokens, W, sp_axis)
+            pos = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            # halo positions are context-only: their center direction is
+            # owned (and trained) by the neighboring shard
+            center_zone = (pos >= W) & (pos < W + Lloc)
+        B, L = tokens.shape
         k_sub, k_win, k_neg = jax.random.split(key, 3)
         k_sr = _sr_streams(key, sr)
 
@@ -378,6 +393,8 @@ def make_pair_train_step(
         keep = valid & (
             jax.random.uniform(k_sub, (B, L)) < tables.keep_probs[tok]
         )
+        if center_zone is not None:
+            keep = keep & center_zone[None, :]
         # Per-position window shrink: reduced ~ U{0..W-1}, effective half-width
         # w_eff = W - reduced in {1..W} (Word2Vec.cpp:285-287,335-337).
         w_eff = W - jax.random.randint(k_win, (B, L), 0, W, dtype=jnp.int32)

@@ -385,11 +385,6 @@ class ShardedTrainer(Trainer):
                 f"shorter than window {config.window}; lower sp or raise "
                 f"max_sentence_len"
             )
-        if self.sp > 1 and config.resolved_kernel != "band":
-            raise ValueError(
-                "sequence parallelism (sp > 1) requires a band-route kernel "
-                "(ns band or positional hs), not the pair kernel"
-            )
         if self.sp > 1 and config.scatter_mean:
             raise ValueError(
                 "scatter_mean duplicate counts are shard-local and would "

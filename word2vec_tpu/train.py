@@ -201,6 +201,7 @@ class Trainer:
         loss_hist: List[float] = []
         last_metrics = None
         self._warned_nonfinite = False
+        self._tail_drop_streak = 0
         chunk_len = self._resolve_chunk_len(batcher)
         if chunk_len > 1:
             return self._train_chunked(
@@ -252,6 +253,13 @@ class Trainer:
                             "hot rows) — shrink the batch, or set "
                             "config.scatter_mean=True (see config.py notes).",
                             stacklevel=2,
+                        )
+                    if "hs_tail_dropped" in m:
+                        # warn on persistent drops whether or not a log
+                        # sink is attached (drive-verified: the first cut
+                        # only checked under log_fn and never fired)
+                        self._note_tail_dropped(
+                            float(m["hs_tail_dropped"]), state.step
                         )
                     if self.log_fn:
                         dt = time.perf_counter() - t0
@@ -547,6 +555,32 @@ class Trainer:
         jax.device_put / asarray calls are)."""
         return jnp.asarray(np_chunk)
 
+    def _note_tail_dropped(self, dropped: float, at_step: int) -> None:
+        """Escalate persistent two-tier hs tail overflow from a metric to a
+        warning. The auto compaction bound assumes tail lengths are
+        independent across positions (ops/hs_step.resolve_tail_slots);
+        bursty real corpora can violate that, and a user watching only the
+        progress line would never see the hs_tail_dropped counter. One
+        nonzero observation is a statistical spike; two CONSECUTIVE logged
+        observations means the bound is genuinely too tight for this
+        corpus, so say so once, with the fix."""
+        if dropped > 0:
+            self._tail_drop_streak += 1
+        else:
+            self._tail_drop_streak = 0
+        if self._tail_drop_streak == 2:
+            import warnings
+
+            warnings.warn(
+                f"hs tail compaction dropped updates in consecutive logged "
+                f"chunks (latest: {dropped:.0f} slots at step {at_step}). "
+                "The auto bound (mean + 6 sigma, independence "
+                "approximation) is too tight for this corpus — raise "
+                "config.hs_tail_slots or set hs_tail_slots=0 to disable "
+                "compaction.",
+                stacklevel=2,
+            )
+
     def _note_metrics(
         self,
         m: Dict,
@@ -575,6 +609,13 @@ class Trainer:
                 "batched-sum updates have diverged (see config.scatter_mean "
                 "notes).",
                 stacklevel=2,
+            )
+        if "hs_tail_dropped" in m:
+            # warn on persistent drops whether or not a log sink is
+            # attached or this chunk hits the log cadence — every fetched
+            # chunk is an observation
+            self._note_tail_dropped(
+                float(np.sum(m["hs_tail_dropped"])), at_step
             )
         if not do_log:
             return

@@ -40,9 +40,15 @@ LEVERS = {
     "bf16sr": {"dtype": "bfloat16", "stochastic_rounding": True},
 }
 
+_CORPUS_CACHE: dict = {}
+
 
 def compile_combo(names: tuple, vocab_size: int, tokens: int) -> dict:
     import jax
+
+    # the axon sitecustomize overrides the JAX_PLATFORMS env var; a
+    # config.update after import wins over both (same trick as bench.py)
+    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
@@ -67,9 +73,16 @@ def compile_combo(names: tuple, vocab_size: int, tokens: int) -> dict:
         window=5, subsample_threshold=1e-4, batch_rows=256,
         max_sentence_len=192, **overrides,
     )
-    vocab = zipf_vocab(vocab_size, 17_000_000)
-    ids = zipf_corpus_ids(vocab, tokens, seed=0)
-    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    key = (vocab_size, tokens)
+    if _CORPUS_CACHE.get("key") != key:
+        vocab = zipf_vocab(vocab_size, 17_000_000)
+        ids = zipf_corpus_ids(vocab, tokens, seed=0)
+        _CORPUS_CACHE.update(
+            key=key, vocab=vocab,
+            corpus=PackedCorpus.pack(ids, cfg.max_sentence_len),
+        )
+    vocab = _CORPUS_CACHE["vocab"]
+    corpus = _CORPUS_CACHE["corpus"]
     tables = DeviceTables.build(vocab, cfg)
     params = init_params(cfg, len(vocab), jax.random.key(0))
     batcher = BatchIterator(corpus, cfg.batch_rows, cfg.max_sentence_len, seed=1)

@@ -1,0 +1,28 @@
+#!/bin/bash
+# VERDICT r4 item 5: the hs dense-top=512 parity row showed delta_margin
+# +0.0405 — 2x the calibrated ±0.02 noise band — on ONE corpus draw, and
+# the promotion rule accepts positive deltas asymmetrically. Before that
+# asymmetry can stand, the delta must replicate across corpora with
+# DIFFERENT structures (topic counts, sharing rates, zipf exponents,
+# seeds), and the one-tier kernel must be measured on the SAME corpora to
+# separate "the two-tier update changes dynamics" from "ours-vs-reference
+# hs offset on this corpus family".
+#
+# 4 corpus structures x {dense-top=512, one-tier} = 8 rows.
+# Usage: bash benchmarks/hs_dense_parity_r5.sh > benchmarks/PARITY_HS_DENSE_r5.jsonl
+cd "$(dirname "$0")/.." || exit 1
+P="python benchmarks/parity.py --tokens 200000 --dim 64 --iters 5 --model sg --train-method hs"
+
+CORPORA=(
+  ""                                                                      # r4's structure, seed 0 (continuity row)
+  "--seed 1"                                                              # same structure, fresh draw
+  "--corpus-topics 16 --corpus-words-per-topic 25 --corpus-p-shared 0.4 --corpus-zipf 0.8 --seed 2"
+  "--corpus-topics 4 --corpus-words-per-topic 80 --corpus-p-shared 0.15 --corpus-zipf 1.3 --corpus-span 30 --seed 3"
+)
+
+for c in "${CORPORA[@]}"; do
+  for tier in "--hs-dense-top 512" ""; do
+    echo "## hs parity $c $tier" >&2
+    timeout 1800 $P $c $tier 2>/dev/null | tail -1
+  done
+done

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Matched-baseline replication for the batch-scoped-negatives lever
+# (--neg-scope batch --kp 256), mirroring hs_dense_parity_r5.sh: its
+# parity delta_margin was quality-POSITIVE beyond the ±0.02 band
+# (+0.031 r3, +0.024 r4) and the retired asymmetric rule accepted that
+# without isolation. The matched comparison — ours(negbatch) vs
+# ours(row-scope) on the SAME corpus — separates "the lever changes
+# training dynamics" (expected here: one KP=256 pool per batch has lower
+# per-center gradient variance than per-row KP=64 pools) from
+# corpus-draw noise, and the replication across structures shows whether
+# the direction is stable enough to justify a documented positive-effect
+# promotion.
+# Usage: bash benchmarks/negbatch_parity_r5.sh > benchmarks/PARITY_NEGBATCH_r5.jsonl
+cd "$(dirname "$0")/.." || exit 1
+P="python benchmarks/parity.py --tokens 200000 --dim 64 --iters 5 --model sg --train-method ns"
+
+CORPORA=(
+  ""
+  "--corpus-topics 16 --corpus-words-per-topic 25 --corpus-p-shared 0.4 --corpus-zipf 0.8 --seed 2"
+  "--corpus-topics 4 --corpus-words-per-topic 80 --corpus-p-shared 0.15 --corpus-zipf 1.3 --corpus-span 30 --seed 3"
+)
+
+for c in "${CORPORA[@]}"; do
+  for lever in "--negative-scope batch --shared-negatives 256" ""; do
+    echo "## negbatch parity $c $lever" >&2
+    timeout 1800 $P $c $lever 2>/dev/null | tail -1
+  done
+done

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-5 full-budget accuracy-parity matrix vs the compiled C++ reference.
+# Same rows as r4 plus the NEW graded-similarity rows: Spearman vs
+# unique-rank golds (no tie ceiling — VERDICT r4 weak item 5), which now
+# discriminates where the old two-level golds pinned every artifact at
+# 0.866. The hs dense-top multi-corpus replication lives in its own
+# artifact (hs_dense_parity_r5.sh -> PARITY_HS_DENSE_r5.jsonl).
+# Usage: bash benchmarks/parity_matrix5.sh > benchmarks/PARITY_MATRIX_r5.txt
+cd "$(dirname "$0")/.." || exit 1
+P="python benchmarks/parity.py --tokens 200000 --dim 64 --iters 5"
+echo "# Parity matrix r5 ($(date -u +%F)): ours vs compiled reference,"
+echo "# same stream, same eval. delta_* = ours - reference."
+for args in \
+  "--model sg   --train-method ns" \
+  "--model cbow --train-method ns" \
+  "--model sg   --train-method hs" \
+  "--model sg   --train-method hs --hs-dense-top 512" \
+  "--model cbow --train-method hs" \
+  "--model sg   --train-method ns --kernel pair" \
+  "--model sg   --train-method ns --prng rbg" \
+  "--model sg   --train-method ns --table-dtype bfloat16 --sr 1" \
+  "--model sg   --train-method ns --negative-scope batch --shared-negatives 256" \
+  ; do
+  echo "## parity $args"
+  timeout 1800 $P $args 2>/dev/null | tail -1
+done
+echo "## graded-similarity parity (unique-rank golds; tokens=240k)"
+for args in \
+  "--model sg   --train-method ns" \
+  "--model cbow --train-method ns" \
+  "--model sg   --train-method hs" \
+  "--model sg   --train-method hs --hs-dense-top 512" \
+  "--model sg   --train-method ns --negative-scope batch --shared-negatives 256" \
+  ; do
+  echo "## graded $args"
+  timeout 1800 python benchmarks/parity.py --graded --tokens 240000 --dim 64 \
+    --iters 5 --min-count 1 $args 2>/dev/null | tail -1
+done
+echo "## analogy parity (grid corpus, 3CosAdd)"
+timeout 1800 python benchmarks/parity.py --analogy --tokens 300000 2>/dev/null | tail -1
+echo "## analogy parity, cbow"
+timeout 1800 python benchmarks/parity.py --analogy --tokens 300000 --model cbow 2>/dev/null | tail -1

@@ -29,7 +29,9 @@ REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 sys.path.insert(0, HERE)
 
-from parity import eval_analogy_vectors, eval_vectors  # noqa: E402
+from parity import (  # noqa: E402
+    eval_analogy_vectors, eval_graded_vectors, eval_vectors,
+)
 
 
 def main() -> None:
@@ -61,6 +63,10 @@ def main() -> None:
                     "corpus (utils/synthetic.analogy_corpus) and score "
                     "3CosAdd accuracy at full dim — the at-scale form of "
                     "the parity harness's analogy gate")
+    ap.add_argument("--graded", action="store_true",
+                    help="graded mode: train on the graded-overlap pair "
+                    "corpus and score Spearman vs UNIQUE-rank golds — the "
+                    "tie-ceiling-free quality axis (r5)")
     ap.add_argument("--run-timeout", type=float, default=1800.0,
                     help="watchdog for the training child (a tunnel hang "
                     "post-probe would otherwise wedge with no output, the "
@@ -68,10 +74,18 @@ def main() -> None:
     args = ap.parse_args()
 
     from word2vec_tpu.utils.synthetic import (
-        analogy_corpus, topic_corpus, topic_similarity_pairs,
+        analogy_corpus, graded_pair_corpus, topic_corpus,
+        topic_similarity_pairs,
     )
 
-    if args.analogy:
+    if args.graded:
+        # more pairs than the parity budget: full-dim training resolves a
+        # finer rank ordering, so give the instrument more rungs
+        tokens, gpairs = graded_pair_corpus(
+            n_pairs=48, n_tokens=args.tokens, seed=args.seed,
+        )
+        corpus_desc = f"graded-overlap-{args.tokens} tokens (48 pairs)"
+    elif args.analogy:
         # larger grid than the parity budget: more cells and pool words so
         # full-dim training has a non-trivial instrument
         tokens, questions = analogy_corpus(
@@ -144,7 +158,11 @@ def main() -> None:
                 "stderr_tail": run.stderr.strip().splitlines()[-6:],
             }))
             return
-        if args.analogy:
+        if args.graded:
+            scores = eval_graded_vectors(
+                os.path.join(tmp, "vec.txt"), gpairs
+            )
+        elif args.analogy:
             scores = eval_analogy_vectors(
                 os.path.join(tmp, "vec.txt"), questions
             )

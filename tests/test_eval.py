@@ -161,3 +161,71 @@ def test_analogy_rank_averages_ties(tmp_path):
     r = evaluate_analogies(W, vocab, str(f))
     assert r.total == 1
     assert r.mean_gold_rank == pytest.approx(1.5)
+
+
+def test_graded_pair_corpus_unique_golds_and_coverage():
+    """The graded-overlap generator (r5, VERDICT r4 weak item 5): golds
+    must be UNIQUE (the whole point — no spearman tie ceiling) and every
+    pair word must actually occur in the stream."""
+    from word2vec_tpu.utils.synthetic import graded_pair_corpus
+
+    tokens, pairs = graded_pair_corpus(n_pairs=16, n_tokens=40_000, seed=5)
+    golds = [s for _, _, s in pairs]
+    assert len(set(golds)) == 16
+    assert golds == sorted(golds)  # the unique grid, in order
+    present = set(tokens)
+    for a, b, _ in pairs:
+        assert a in present and b in present
+
+
+def test_graded_eval_discriminates_rank_quality(tmp_path):
+    """eval_graded_vectors' spearman must move continuously with how well
+    cosines track the planted alpha order: a perfect monotone embedding
+    scores 1.0, a partially shuffled one strictly less, with NO tie
+    ceiling between them (the two-level golds clipped both at 0.866)."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+    ))
+    from parity import eval_graded_vectors
+
+    from word2vec_tpu.io.embeddings import save_embeddings_text
+    from word2vec_tpu.utils.synthetic import graded_pair_corpus
+
+    _, pairs = graded_pair_corpus(n_pairs=16, n_tokens=16_000, seed=5)
+    rng = np.random.default_rng(0)
+
+    def vecs(alpha_order):
+        # pair k: a = unit x_k; b = cos-target mix of x_k and noise
+        words, rows = [], []
+        d = 24
+        for k, (a, b, alpha) in enumerate(pairs):
+            x = np.zeros(d)
+            x[k % d] = 1.0
+            n = rng.normal(size=d)
+            n -= n.dot(x) * x
+            n /= np.linalg.norm(n)
+            t = alpha_order[k]
+            y = t * x + np.sqrt(max(1e-9, 1 - t * t)) * n
+            words += [a, b]
+            rows += [x, y]
+        return words, np.asarray(rows, np.float32)
+
+    alphas = np.asarray([s for _, _, s in pairs])
+    perfect = str(tmp_path / "perfect.txt")
+    words, W = vecs(alphas)
+    save_embeddings_text(perfect, words, W)
+    r1 = eval_graded_vectors(perfect, pairs)
+    assert r1["spearman_graded"] == pytest.approx(1.0)
+
+    # corrupt a third of the ordering: spearman must drop strictly below
+    shuffled = alphas.copy()
+    shuffled[:6] = shuffled[:6][::-1]
+    corrupt = str(tmp_path / "corrupt.txt")
+    words, W = vecs(shuffled)
+    save_embeddings_text(corrupt, words, W)
+    r2 = eval_graded_vectors(corrupt, pairs)
+    assert r2["spearman_graded"] < r1["spearman_graded"] - 0.05

@@ -254,8 +254,9 @@ def make_train_step(
     "band" selects the objective's fast path — banded-matmul ns
     (ops/band_step.py) or positional hs (ops/hs_step.py); "pair" is the
     reference-faithful enumeration below. sp_axis (sequence/context
-    parallelism via halo exchange) is implemented by the band-route kernels
-    (ns band and positional hs), not the pair kernel.
+    parallelism via halo exchange) is implemented by every kernel route:
+    band, positional hs (both tiers), and — since r5 — the pair kernel
+    (same halo + center-ownership contract).
 
     With config.micro_steps = k > 1 the step is wrapped in a sequential
     lax.fori_loop over k row sub-blocks of the dispatched batch: updates

@@ -101,19 +101,25 @@ BASE_ITEMS = ("default", "hs_dim200", "cbow_dim100", "sg_w10")
 
 
 def load_parity_rows() -> list:
+    """Rows from the full-budget parity matrices, NEWEST FIRST (r5
+    supersedes r4: same config strings, refreshed reference training, plus
+    the graded rows). parity_delta takes the first matching row, so a
+    config present in both resolves to r5, while configs the in-progress
+    r5 run hasn't reached yet still resolve to their r4 row."""
     rows = []
-    path = os.path.join(HERE, "PARITY_MATRIX_r4.txt")
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line.startswith("{"):
-                    try:
-                        rows.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        pass
-    except OSError:
-        pass
+    for name in ("PARITY_MATRIX_r5.txt", "PARITY_MATRIX_r4.txt"):
+        path = os.path.join(HERE, name)
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line.startswith("{"):
+                        try:
+                            rows.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            pass
+        except OSError:
+            continue
     return rows
 
 

@@ -57,6 +57,11 @@ run_item slab_sorted          900 "$TPU" $B --slab-scatter 1
 run_item bf16sr               900 "$TPU" $B --table-dtype bfloat16 --sr 1
 run_item negbatch_kp256       900 "$TPU" $B --neg-scope batch --kp 256
 run_item hs_dim200_dense1024  900 "$TPU" $B --train-method hs --dim 200 --hs-dense-top 1024
+# row length L has never been swept on chip (fixed 192 since r1): it sets
+# the band-edge waste, mask sizes, and rows-per-step; the corpus's
+# 1000-token pseudo-sentences split into ceil(1000/L) rows either way
+run_item l384                 900 "$TPU" $B --max-len 384
+run_item l512                 900 "$TPU" $B --max-len 512
 
 # --- tier 4: combos -----------------------------------------------------------
 run_item pallas_c96           900 "$TPU" $B --band-backend pallas --chunk-cap 96

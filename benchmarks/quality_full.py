@@ -58,15 +58,22 @@ def main() -> None:
                     help="stochastic rounding (bf16 tables; passthrough)")
     ap.add_argument("--hs-dense-top", type=int, default=0,
                     help="two-tier hs dense tier (config.hs_dense_top)")
-    ap.add_argument("--analogy", action="store_true",
-                    help="analogy mode: train on the compositional-grid "
-                    "corpus (utils/synthetic.analogy_corpus) and score "
-                    "3CosAdd accuracy at full dim — the at-scale form of "
-                    "the parity harness's analogy gate")
-    ap.add_argument("--graded", action="store_true",
-                    help="graded mode: train on the graded-overlap pair "
-                    "corpus and score Spearman vs UNIQUE-rank golds — the "
-                    "tie-ceiling-free quality axis (r5)")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--analogy", action="store_true",
+                      help="analogy mode: train on the compositional-grid "
+                      "corpus (utils/synthetic.analogy_corpus) and score "
+                      "3CosAdd accuracy at full dim — the at-scale form of "
+                      "the parity harness's analogy gate")
+    mode.add_argument("--graded", action="store_true",
+                      help="graded mode: train on the graded-overlap pair "
+                      "corpus and score Spearman vs UNIQUE-rank golds — "
+                      "the tie-ceiling-free quality axis (r5)")
+    mode.add_argument("--mixed", action="store_true",
+                      help="mixed mode: topic corpus with graded spans "
+                      "interleaved (utils/synthetic.mixed_eval_corpus) — "
+                      "BOTH instruments scored from one production-shaped "
+                      "training run (r5; the pure graded corpus is "
+                      "unrepresentatively small-vocab at this budget)")
     ap.add_argument("--run-timeout", type=float, default=1800.0,
                     help="watchdog for the training child (a tunnel hang "
                     "post-probe would otherwise wedge with no output, the "
@@ -74,11 +81,22 @@ def main() -> None:
     args = ap.parse_args()
 
     from word2vec_tpu.utils.synthetic import (
-        analogy_corpus, graded_pair_corpus, topic_corpus,
+        analogy_corpus, graded_pair_corpus, mixed_eval_corpus, topic_corpus,
         topic_similarity_pairs,
     )
 
-    if args.graded:
+    if args.mixed:
+        tokens, topic_of, gpairs = mixed_eval_corpus(
+            n_tokens=args.tokens, seed=args.seed,
+            n_topics=args.n_topics, words_per_topic=args.words_per_topic,
+            shared_words=args.n_topics * 5,
+        )
+        pairs = topic_similarity_pairs(topic_of, seed=args.seed + 3)
+        corpus_desc = (
+            f"mixed topic+graded {args.tokens} tokens "
+            f"({args.n_topics} topics, {len(gpairs)} graded pairs)"
+        )
+    elif args.graded:
         # more pairs than the parity budget: full-dim training resolves a
         # finer rank ordering, so give the instrument more rungs
         tokens, gpairs = graded_pair_corpus(
@@ -158,7 +176,20 @@ def main() -> None:
                 "stderr_tail": run.stderr.strip().splitlines()[-6:],
             }))
             return
-        if args.graded:
+        if args.mixed:
+            scores = eval_vectors(
+                os.path.join(tmp, "vec.txt"), pairs, topic_of
+            )
+            g = eval_graded_vectors(os.path.join(tmp, "vec.txt"), gpairs)
+            # keep both instruments' keys distinguishable — including a
+            # graded-side failure, which must not masquerade as (or
+            # clobber) a topic-side "error"
+            scores.update({
+                (k if k.startswith("spearman") or k.startswith("pearson")
+                 else f"graded_{k}"): v
+                for k, v in g.items()
+            })
+        elif args.graded:
             scores = eval_graded_vectors(
                 os.path.join(tmp, "vec.txt"), gpairs
             )

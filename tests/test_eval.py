@@ -229,3 +229,26 @@ def test_graded_eval_discriminates_rank_quality(tmp_path):
     save_embeddings_text(corrupt, words, W)
     r2 = eval_graded_vectors(corrupt, pairs)
     assert r2["spearman_graded"] < r1["spearman_graded"] - 0.05
+
+
+def test_mixed_eval_corpus_carries_both_instruments():
+    """mixed_eval_corpus (r5): one stream, two gold sets — graded pair
+    words diluted into the topic corpus at realistic frequencies."""
+    from word2vec_tpu.utils.synthetic import mixed_eval_corpus
+
+    tokens, topic_of, gpairs = mixed_eval_corpus(
+        n_tokens=60_000, n_pairs=8, seed=4, n_topics=4,
+        words_per_topic=10, shared_words=5,
+    )
+    present = set(tokens)
+    # both instruments' words are in the stream
+    assert sum(w in present for w in topic_of) > len(topic_of) * 0.9
+    for a, b, _ in gpairs:
+        assert a in present and b in present
+    # graded golds stay unique
+    golds = [s for _, _, s in gpairs]
+    assert len(set(golds)) == len(golds)
+    # dilution: graded-pair center words are a small minority of tokens
+    centers = {w for a, b, _ in gpairs for w in (a, b)}
+    frac = sum(t in centers for t in tokens) / len(tokens)
+    assert 0.0 < frac < 0.15

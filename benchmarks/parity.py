@@ -87,6 +87,14 @@ def _load_pair_cosines(path: str, pairs, min_pairs: int = 1):
     if len(ii) < min_pairs:
         return {"error": f"eval pairs OOV at this budget ({len(ii)} usable)"}
     cos = cosine_rows(W, np.asarray(ii), np.asarray(jj))
+    if not np.isfinite(cos).all():
+        # a diverged model (NaN/inf rows) must fail the eval loudly —
+        # rank statistics over NaNs produce arbitrary values (the r5 clip
+        # sweep's tau=0 run scored a spurious spearman_graded of 1.0 on a
+        # NaN-margin model before this guard)
+        bad = int((~np.isfinite(cos)).sum())
+        return {"error": f"non-finite cosines for {bad}/{len(cos)} pairs "
+                "(diverged model)"}
     return words, W, cos, np.asarray(gold, np.float64)
 
 

@@ -58,6 +58,10 @@ def main() -> None:
                     help="stochastic rounding (bf16 tables; passthrough)")
     ap.add_argument("--hs-dense-top", type=int, default=0,
                     help="two-tier hs dense tier (config.hs_dense_top)")
+    ap.add_argument("--clip-row-update", type=float, default=None,
+                    help="trust-region tau override (CLI passthrough; "
+                    "None = the shipped default 1.0) — for the r5 clip "
+                    "quality-sensitivity study on the graded axis")
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument("--analogy", action="store_true",
                       help="analogy mode: train on the compositional-grid "
@@ -154,6 +158,8 @@ def main() -> None:
                     "--stochastic-rounding", str(args.sr)]
         if args.hs_dense_top:
             cmd += ["--hs-dense-top", str(args.hs_dense_top)]
+        if args.clip_row_update is not None:
+            cmd += ["--clip-row-update", str(args.clip_row_update)]
         env = {
             **os.environ,
             "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -238,6 +244,8 @@ def main() -> None:
         kernel += f", {args.table_dtype} tables" + (" +sr" if args.sr else "")
     if args.hs_dense_top:
         kernel += f", dense-top={args.hs_dense_top}"
+    if args.clip_row_update is not None:
+        kernel += f", clip={args.clip_row_update}"
     print(json.dumps({
         "platform": platform,
         "device_kind": device_kind,

@@ -252,3 +252,30 @@ def test_mixed_eval_corpus_carries_both_instruments():
     centers = {w for a, b, _ in gpairs for w in (a, b)}
     frac = sum(t in centers for t in tokens) / len(tokens)
     assert 0.0 < frac < 0.15
+
+
+def test_graded_eval_rejects_diverged_model(tmp_path):
+    """A NaN model must FAIL the pair evals loudly — the r5 clip sweep's
+    tau=0 (trust region off) run diverged to NaN margin yet scored a
+    spurious spearman_graded of 1.0 before the finite-cosine guard."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+    ))
+    from parity import eval_graded_vectors, eval_vectors
+
+    from word2vec_tpu.io.embeddings import save_embeddings_text
+
+    words = ["a", "b", "c", "d", "e", "f"]
+    W = np.ones((6, 8), np.float32)
+    W[1] = np.nan
+    path = str(tmp_path / "nan.txt")
+    save_embeddings_text(path, words, W)
+    pairs = [("a", "b", 1.0), ("c", "d", 2.0), ("e", "f", 3.0)]
+    r = eval_graded_vectors(path, pairs)
+    assert "error" in r and "non-finite" in r["error"]
+    r2 = eval_vectors(path, pairs, {})
+    assert "error" in r2 and "non-finite" in r2["error"]

@@ -63,6 +63,11 @@ def main(argv=None) -> int:
                        help="google questions-words accuracy (compute-accuracy)")
     p.add_argument("vectors")
     p.add_argument("questions_file")
+    p.add_argument("--method", choices=["3cosadd", "3cosmul"],
+                   default="3cosadd",
+                   help="scoring objective: compute-accuracy's additive "
+                   "3CosAdd (default) or the multiplicative 3CosMul "
+                   "(Levy & Goldberg 2014; gensim most_similar_cosmul)")
 
     p = sub.add_parser(
         "convert",
@@ -130,8 +135,11 @@ def main(argv=None) -> int:
             "pairs_used": res.pairs_used, "pairs_total": res.pairs_total,
         }))
     elif args.cmd == "analogies":
-        res = evaluate_analogies(W, vocab, args.questions_file)
+        res = evaluate_analogies(
+            W, vocab, args.questions_file, method=args.method
+        )
         print(json.dumps({
+            "method": args.method,
             "accuracy": res.accuracy,
             "correct": res.correct,
             "total": res.total,

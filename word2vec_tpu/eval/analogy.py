@@ -67,11 +67,12 @@ def evaluate_analogies(
     path: str,
     batch_size: int = 512,
     restrict_vocab: int = 30000,
+    method: str = "3cosadd",
 ) -> AnalogyResult:
-    """3CosAdd over a questions-words.txt file; see evaluate_analogy_sections
-    for the protocol."""
+    """3CosAdd (default) or 3CosMul over a questions-words.txt file; see
+    evaluate_analogy_sections for the protocol."""
     return evaluate_analogy_sections(
-        W, vocab, load_questions(path), batch_size, restrict_vocab
+        W, vocab, load_questions(path), batch_size, restrict_vocab, method
     )
 
 
@@ -81,8 +82,17 @@ def evaluate_analogy_sections(
     sections: List[Tuple[str, List[Tuple[str, str, str, str]]]],
     batch_size: int = 512,
     restrict_vocab: int = 30000,
+    method: str = "3cosadd",
 ) -> AnalogyResult:
-    """3CosAdd with the compute-accuracy conventions.
+    """3CosAdd (compute-accuracy) or 3CosMul (Levy & Goldberg 2014) with
+    the compute-accuracy conventions.
+
+    3CosMul scores each candidate d' as
+    cos01(d',b) * cos01(d',c) / (cos01(d',a) + 1e-3) with cosines shifted
+    to [0,1] — the multiplicative objective amplifies small differences in
+    the larger terms and is the other standard protocol (gensim
+    most_similar_cosmul); published numbers differ between the two, so
+    the method is explicit in the result and CLI output.
 
     Takes in-memory (section, questions) lists so harnesses with generated
     questions (benchmarks/parity.py planted-relation corpus) share the exact
@@ -92,6 +102,8 @@ def evaluate_analogy_sections(
     (the original tool's `threshold`, default 30000), which also decides OOV
     skips — matching how published text8 numbers are produced.
     """
+    if method not in ("3cosadd", "3cosmul"):
+        raise ValueError(f"method must be 3cosadd or 3cosmul, got {method!r}")
     V = min(len(vocab), restrict_vocab) if restrict_vocab else len(vocab)
     Wn = W[:V] / np.maximum(np.linalg.norm(W[:V], axis=1, keepdims=True), 1e-12)
 
@@ -114,9 +126,18 @@ def evaluate_analogy_sections(
             if len(chunk) == 0:
                 continue
             a, b, c, d = chunk.T
-            query = Wn[b] - Wn[a] + Wn[c]
-            query /= np.maximum(np.linalg.norm(query, axis=1, keepdims=True), 1e-12)
-            sims = query @ Wn.T  # [chunk, V]
+            if method == "3cosmul":
+                # all three candidate-cosine planes, shifted to [0, 1]
+                ca = (Wn[a] @ Wn.T + 1.0) / 2.0
+                cb = (Wn[b] @ Wn.T + 1.0) / 2.0
+                cc = (Wn[c] @ Wn.T + 1.0) / 2.0
+                sims = cb * cc / (ca + 1e-3)  # [chunk, V]
+            else:
+                query = Wn[b] - Wn[a] + Wn[c]
+                query /= np.maximum(
+                    np.linalg.norm(query, axis=1, keepdims=True), 1e-12
+                )
+                sims = query @ Wn.T  # [chunk, V]
             rows = np.arange(len(chunk))
             sims[rows, a] = -np.inf  # exclude question words
             sims[rows, b] = -np.inf

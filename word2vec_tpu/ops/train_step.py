@@ -25,7 +25,6 @@ reference is impossible (it seeds from random_device); parity is statistical.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, Dict, Tuple
 
 import jax

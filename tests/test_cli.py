@@ -278,3 +278,63 @@ def test_resume_reports_typed_micro_steps(tmp_path, corpus_file, capsys):
     assert rc == 0
     err = capsys.readouterr().err
     assert "ignoring differing flags" in err and "micro_steps" in err
+
+
+def test_export_side_override(tmp_path, corpus_file, capsys):
+    """--export-side (r5): auto mirrors the reference's matrix choice;
+    input/output override it — motivated by the reference's own cbow+ns
+    save choice anticorrelating with fine-grained similarity
+    (benchmarks/CBOW_GRADED_CALIB_r5.jsonl)."""
+    import numpy as np
+
+    from word2vec_tpu.io.embeddings import load_embeddings_text
+
+    common = [
+        "-train", corpus_file, "-size", "8", "-negative", "2",
+        "-min-count", "1", "-iter", "1", "--backend", "cpu",
+        "--batch-rows", "4", "--max-sentence-len", "32", "--quiet",
+        "-model", "cbow",
+    ]
+    out_auto = tmp_path / "auto.txt"
+    out_in = tmp_path / "input.txt"
+    rc = run(common + ["-output", str(out_auto)])
+    assert rc == 0
+    rc = run(common + ["-output", str(out_in), "--export-side", "input"])
+    assert rc == 0
+    _, W_auto = load_embeddings_text(str(out_auto))
+    _, W_in = load_embeddings_text(str(out_in))
+    # cbow+ns auto saves the OUTPUT matrix (main.cpp:201); the input
+    # override must produce a genuinely different table
+    assert not np.allclose(W_auto, W_in)
+
+    # hs + output side is rejected BEFORE training (internal-node rows)
+    rc = run([
+        "-train", corpus_file, "-size", "8", "-negative", "0",
+        "-train_method", "hs", "-min-count", "1", "-iter", "1",
+        "--backend", "cpu", "--quiet", "-output", str(tmp_path / "x.txt"),
+        "--export-side", "output",
+    ])
+    assert rc == 1
+    assert "internal nodes" in capsys.readouterr().err
+
+
+def test_export_side_guard_uses_effective_config(tmp_path, corpus_file, capsys):
+    """Resuming an hs checkpoint with --export-side output (without
+    retyping -train_method) must be rejected up front on the EFFECTIVE
+    config — the checkpoint overrides the flag, and the guard must not
+    let a long training run crash at the export step."""
+    ck = str(tmp_path / "ck")
+    rc = run([
+        "-train", corpus_file, "-train_method", "hs", "-negative", "0",
+        "-size", "8", "-min-count", "1", "-iter", "1", "--backend", "cpu",
+        "--batch-rows", "4", "--max-sentence-len", "32", "--quiet",
+        "-output", "", "--checkpoint-dir", ck,
+    ])
+    assert rc == 0
+    rc = run([
+        "-train", corpus_file, "-size", "8", "-min-count", "1",
+        "--backend", "cpu", "--quiet", "-output", str(tmp_path / "v.txt"),
+        "--resume", ck, "--export-side", "output",
+    ])
+    assert rc == 1
+    assert "internal nodes" in capsys.readouterr().err

@@ -165,6 +165,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "lines: one sentence per line (Word2Vec.cpp:19-30)")
     p.add_argument("--binary-layout", choices=["reference", "google"],
                    default="reference")
+    p.add_argument("--export-side", choices=["auto", "input", "output"],
+                   default="auto",
+                   help="which table -output saves: auto = the reference's "
+                   "choice (main.cpp:196-202); input = the gather-side "
+                   "table (gensim wv); output = the ns prediction table "
+                   "(gensim syn1neg). The reference's auto choice for "
+                   "cbow+ns saves the output matrix, which its own "
+                   "training leaves anticorrelated with fine-grained "
+                   "similarity (benchmarks/CBOW_GRADED_CALIB_r5.jsonl)")
     p.add_argument("--checkpoint-dir", metavar="DIR")
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="STEPS")
     p.add_argument("--resume", metavar="DIR", help="resume from checkpoint dir")
@@ -296,6 +305,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         cfg = ck_cfg if ck_cfg is not None else Word2VecConfig(**flag_kwargs)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    if args.export_side == "output" and cfg.use_hs:
+        # fail BEFORE training, not at the export step after a long run —
+        # and on the EFFECTIVE config (a resumed checkpoint overrides the
+        # -train_method flag): the hs output table rows are Huffman
+        # internal nodes, not words
+        print("error: --export-side output requires negative sampling "
+              "(the hs output table holds internal nodes, not word rows)",
+              file=sys.stderr)
         return 1
 
     if ck_cfg is not None and args.prng != ck_cfg.prng_impl:
@@ -546,7 +565,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         params = trainer.export_params(state)
     else:
         params = {k: v for k, v in state.params.items()}
-    matrix = export_matrix(params, cfg)
+    matrix = export_matrix(params, cfg, side=args.export_side)
     if args.output and is_primary:
         save_word2vec(
             args.output, vocab, matrix,

@@ -62,8 +62,36 @@ def init_params(config: Word2VecConfig, vocab_size: int, key: jax.Array) -> Para
     return params
 
 
-def export_matrix(params: Params, config: Word2VecConfig) -> jnp.ndarray:
-    """The matrix the reference CLI would save (main.cpp:196-202)."""
+def export_matrix(
+    params: Params, config: Word2VecConfig, side: str = "auto"
+) -> jnp.ndarray:
+    """The matrix to save.
+
+    side="auto" mirrors the reference CLI exactly (main.cpp:196-202):
+    hs+cbow saves C (the context/input matrix), everything else saves W.
+    For cbow+ns that means the OUTPUT matrix — a choice the r5 graded
+    instrument showed to be systematically bad in the reference itself
+    (its saved cbow+ns matrix ANTICORRELATES with fine-grained
+    similarity, CBOW_GRADED_CALIB_r5.jsonl; ours recovers it, but users
+    may still want the other side). side="input"/"output" overrides:
+    "input" = the gather-side table (centers for sg, contexts for cbow —
+    emb_in; gensim's `wv`), "output" = the ns prediction-side table
+    (emb_out_ns; gensim's `syn1neg`). "output" requires ns: the hs
+    output table holds V-1 Huffman INTERNAL NODES, not word rows, so
+    exporting it as word vectors would be meaningless."""
+    if side == "input":
+        return params["emb_in"]
+    if side == "output":
+        if not config.use_ns:
+            raise ValueError(
+                "export side='output' requires negative sampling: the hs "
+                "output table rows are Huffman internal nodes, not words"
+            )
+        return params["emb_out_ns"]
+    if side != "auto":
+        raise ValueError(
+            f"export side must be auto, input or output, got {side!r}"
+        )
     if config.model == "cbow" and config.use_hs:
         return params["emb_in"]  # C, main.cpp:198-199
     if config.model == "cbow" and config.use_ns:

@@ -164,3 +164,33 @@ def test_clip_engagement_metric(train_method, kernel):
         tokens, jax.random.key(1), jnp.float32(cfg.init_alpha),
     )
     assert float(m3["clip_engaged"]) == 0.0
+
+
+def test_degenerate_corpus_warning():
+    """r5 fence (benchmarks/BAND_DEGENERACY_r5.md): a band+ns run on a
+    tiny closed vocabulary at 1000+ occurrences per word must warn and
+    point at kernel='pair'; the pair kernel itself must not warn."""
+    import warnings
+
+    import numpy as np
+
+    from word2vec_tpu import PackedCorpus, Trainer, Vocab, Word2VecConfig
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(40)]
+    sents = [list(rng.choice(words, size=20)) for _ in range(3000)]
+    vocab = Vocab.build(sents, min_count=1)  # 40 words x 60k tokens = 1500 occ/word
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), 32)
+
+    def warns_for(kernel):
+        cfg = Word2VecConfig(
+            model="sg", train_method="ns", negative=3, word_dim=8,
+            min_count=1, batch_rows=8, max_sentence_len=32, kernel=kernel,
+        )
+        with warnings.catch_warnings(record=True) as wlist:
+            warnings.simplefilter("always")
+            Trainer(cfg, vocab, corpus)
+        return [w for w in wlist if "shared negative pool" in str(w.message)]
+
+    assert len(warns_for("band")) == 1
+    assert len(warns_for("pair")) == 0

@@ -90,14 +90,19 @@ class Trainer:
         # resident-corpus runner + HBM corpus, built once per instance
         self._resident_cache = None
         self._resident_ready = False
-        self._warn_batch_geometry()
+        self._warn_config_hazards()
         self._build_step()
 
-    def _warn_batch_geometry(self) -> None:
-        """Batched-sum updates need enough steps/epoch to converge (measured
-        threshold ~70, benchmarks/parity.py; see config.scatter_mean notes).
-        The CLI auto-sizes batch_rows; library users constructing Trainer
-        directly get this guard instead."""
+    def _warn_config_hazards(self) -> None:
+        """Pre-training configuration hazards, warned once at construction:
+        (a) optimizer blocks too token-heavy per vocabulary word (summed
+        updates overshoot, measured NaN at ~15x), (b) too few optimizer
+        steps per epoch to converge (measured threshold ~70,
+        benchmarks/parity.py; see config.scatter_mean notes), and (c) the
+        degenerate-corpus domain where the band kernel's shared negative
+        pool collapses planted structure (BAND_DEGENERACY_r5.md). The CLI
+        auto-sizes batch_rows; library users constructing Trainer directly
+        get these guards instead."""
         import warnings
 
         cfg = self.config
@@ -112,6 +117,31 @@ class Trainer:
                 "config.MAX_BLOCK_TOKENS_PER_VOCAB). Raise micro_steps or "
                 "shrink batch_rows; Word2VecConfig.auto_geometry(..., "
                 "vocab_size=len(vocab)) sizes this automatically.",
+                stacklevel=3,
+            )
+        # Degenerate-corpus fence (r5, benchmarks/BAND_DEGENERACY_r5.md):
+        # with a tiny closed vocabulary trained for thousands of
+        # occurrences per word, the band kernel's SHARED negative pool
+        # correlates the negative-side gradient across a row's positives
+        # and measurably collapses planted structure (analogy grid:
+        # band 0.0 vs pair 0.74 vs reference 0.86 at 4,600 occ/word,
+        # dim 300 — any KP, any scope, clip exonerated at tau=16).
+        # Onset ~1,000+ occ/word at vocab < ~5k; realistic corpora
+        # (text8: 71k vocab, ~240 occ/word) are far outside the domain.
+        if (
+            cfg.use_ns
+            and cfg.resolved_kernel == "band"
+            and 0 < len(self.vocab) < 5000
+            and self.total_words * cfg.iters > 1000 * len(self.vocab)
+        ):
+            occ = self.total_words * cfg.iters // len(self.vocab)
+            warnings.warn(
+                f"~{occ} training occurrences per vocabulary word on a "
+                f"{len(self.vocab)}-word vocabulary: the band kernel's "
+                "shared negative pool measurably degrades planted "
+                "structure in this over-trained tiny-vocab regime "
+                "(benchmarks/BAND_DEGENERACY_r5.md). Use kernel='pair' "
+                "(per-pair negative draws) for corpora this degenerate.",
                 stacklevel=3,
             )
         steps_per_epoch = max(

@@ -62,6 +62,10 @@ def main() -> None:
                     help="trust-region tau override (CLI passthrough; "
                     "None = the shipped default 1.0) — for the r5 clip "
                     "quality-sensitivity study on the graded axis")
+    ap.add_argument("--kernel", choices=["auto", "band", "pair"],
+                    default="auto",
+                    help="device kernel (CLI passthrough) — for the r5 "
+                    "band-degeneracy isolation runs")
     mode = ap.add_mutually_exclusive_group()
     mode.add_argument("--analogy", action="store_true",
                       help="analogy mode: train on the compositional-grid "
@@ -160,6 +164,8 @@ def main() -> None:
             cmd += ["--hs-dense-top", str(args.hs_dense_top)]
         if args.clip_row_update is not None:
             cmd += ["--clip-row-update", str(args.clip_row_update)]
+        if args.kernel != "auto":
+            cmd += ["--kernel", args.kernel]
         env = {
             **os.environ,
             "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -235,7 +241,9 @@ def main() -> None:
             device_kind = parts[1] if len(parts) > 1 else platform
 
     # what the CLI's auto-selection actually routes this config through
-    kernel = "band" if args.train_method == "ns" else "hs-positional"
+    kernel = args.kernel if args.kernel != "auto" else (
+        "band" if args.train_method == "ns" else "hs-positional"
+    )
     if args.negative_scope != "row":
         kernel += f", neg-scope={args.negative_scope}"
         if args.shared_negatives:

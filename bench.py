@@ -167,6 +167,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         subsample_threshold=1e-4,
         batch_rows=args.batch_rows,
         max_sentence_len=args.max_len,
+        chunk_cap=args.chunk_cap,
         slab_scatter=bool(args.slab_scatter),
         fused_tables=bool(args.fused) and args.train_method == "ns",
         shared_negatives=args.kp,
@@ -210,6 +211,26 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
         corpus_name = f"zipf-synthetic-{args.tokens // 1_000_000}M"
 
+    # Autotuned execution planner (tune/): resolve the step-shape plan
+    # against THIS corpus + device before anything shape-dependent is built.
+    # "cached" starts from the persisted (device, kernel, vocab, dim) plan
+    # with zero probe cost; "probe" searches (cost-model-pruned grid, short
+    # compile-separated probes) and persists the winner for next time.
+    plan_res = None
+    if args.autotune != "off":
+        from word2vec_tpu.tune import resolve_plan
+
+        plan_res = resolve_plan(
+            cfg, vocab, corpus=corpus, mode=args.autotune,
+            cache_path=args.plan_cache or None,
+        )
+        cfg = cfg.apply_plan(plan_res.plan)
+        print(
+            f"autotune: {'cache hit' if plan_res.source == 'cache' else 'probed'}"
+            f" key={plan_res.key} plan={plan_res.plan.to_json()}",
+            file=sys.stderr,
+        )
+
     tables = DeviceTables.build(vocab, cfg)
     params = init_params(cfg, len(vocab), jax.random.key(0, impl=cfg.jax_prng_impl))
     batcher = BatchIterator(corpus, cfg.batch_rows, cfg.max_sentence_len, seed=1)
@@ -219,7 +240,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     # per device program, so per-dispatch overhead — which through the remote
     # tunnel costs ~4-5x the 8 ms device step — amortizes to noise. The
     # trajectory is identical to per-step dispatch (tests/test_chunk_runner.py).
-    S, _ = cfg.chunk_geometry(batcher.steps_per_epoch(), cap=args.chunk_cap)
+    S, _ = cfg.chunk_geometry(batcher.steps_per_epoch(), cap=cfg.chunk_cap)
     alphas = jnp.full((S,), cfg.init_alpha, jnp.float32)
 
     from word2vec_tpu.ops import resident as res
@@ -258,7 +279,8 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         def dispatches():
             # chunk transfers overlap compute (batcher.placed_prefetch)
             for dev_chunk, wlist in placed_prefetch(
-                chunk_batches(batcher.epoch(), S), jax.device_put
+                chunk_batches(batcher.epoch(), S), jax.device_put,
+                depth=cfg.prefetch_depth,
             ):
                 yield sum(wlist), (
                     lambda p, s, t=dev_chunk: chunk_fn(p, t, base_key, s, alphas)
@@ -330,6 +352,19 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         (v for k, v in PEAK_FLOPS_BF16.items() if dev.device_kind.startswith(k)),
         None,
     )
+    # Predicted-vs-measured cost (tune/cost_model.py; the cost model and
+    # this record share the utils/profiling counters). measured_cost is the
+    # whole-pipeline truth the model is judged against — banked side by
+    # side so the model's error stays observable round over round.
+    from word2vec_tpu.tune import cost_model as _cm
+
+    predicted = _cm.predict(
+        cfg, len(vocab), dev.device_kind, dev.platform
+    ).to_json()
+    measured = {
+        "step_ms": round(1e3 * dt / max(1, steps), 4),
+        "words_per_sec": round(wps, 1),
+    }
     record = {
         "metric": f"{key} words/sec ({corpus_name}, {dev.platform})",
         "value": round(wps, 1),
@@ -342,7 +377,15 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "model_tflops_per_sec": round(model_fps / 1e12, 4),
         "mfu": round(model_fps / peak, 5) if peak else None,
         "resident_corpus": use_resident,
+        "plan": cfg.current_plan().to_json(),
+        "plan_source": plan_res.source if plan_res else "flags",
+        "predicted_cost": predicted,
+        "measured_cost": measured,
     }
+    if plan_res is not None:
+        record["plan_cache_hit"] = plan_res.source == "cache"
+        if plan_res.probes:
+            record["plan_probes"] = plan_res.probes
     if load_start is not None:
         record["host_load_1m"] = [
             round(load_start, 2), round(os.getloadavg()[0], 2),
@@ -413,6 +456,20 @@ def build_parser() -> argparse.ArgumentParser:
                     help="device-resident corpus (ops/resident.py); falls "
                     "back to host streaming when the corpus exceeds HBM "
                     "budget")
+    ap.add_argument("--autotune", choices=["off", "probe", "cached"],
+                    default="off",
+                    help="autotuned execution planner (word2vec_tpu/tune): "
+                    "probe = cost-model-pruned grid + timed probes, winner "
+                    "persisted; cached = start from the persisted plan with "
+                    "zero probe cost (miss falls back to probe)")
+    ap.add_argument("--plan-cache", default="",
+                    help="plan-cache JSON path (default: $W2V_PLAN_CACHE or "
+                    "~/.cache/word2vec_tpu/plan_cache.json)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI smoke preset: shrink the synthetic corpus to "
+                    "~60s of CPU wall time (still the real pipeline at the "
+                    "flagship dim/vocab — catches throughput regressions "
+                    "and crashes, not absolute-number drift)")
     ap.add_argument("--measure-steps", type=int, default=0,
                     help="0 = one full epoch (rounded up to whole chunks)")
     ap.add_argument("--text8", default="text8")
@@ -430,6 +487,18 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--inner", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--fallback-reason", default=None, help=argparse.SUPPRESS)
     return ap
+
+
+def apply_smoke(args: argparse.Namespace) -> None:
+    """--smoke preset, applied identically in the outer shell and the inner
+    child (both parse argv): a ~300k-token synthetic epoch at the flagship
+    shape. Explicit --tokens/--probe flags still win where smaller."""
+    if not args.smoke:
+        return
+    args.tokens = min(args.tokens, 300_000)
+    args.probe_timeout = min(args.probe_timeout, 20.0)
+    args.probe_retries = 1
+    args.run_timeout = min(args.run_timeout, 600.0)
 
 
 def error_record(args: argparse.Namespace, err: str, note: str | None) -> dict:
@@ -512,6 +581,7 @@ def acquire_chip_lock(timeout_s: float = 900.0):
 
 def main() -> None:
     args = build_parser().parse_args()
+    apply_smoke(args)
     if args.inner:
         inner_main(args)
         return
@@ -556,6 +626,7 @@ def main() -> None:
         ("--resident", args.resident), ("--fused", args.fused),
         ("--prng", args.prng), ("--table-dtype", args.table_dtype),
         ("--sr", args.sr),
+        ("--autotune", args.autotune), ("--plan-cache", args.plan_cache),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:
         child_cmd += [flag, str(val)]

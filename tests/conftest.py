@@ -25,18 +25,18 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent XLA compilation cache: the suite is compile-bound on a 1-core
-# host (~216 jit programs), and the cache cuts a warm re-run ~4x (measured
-# 8.7s -> 2.1s on one trajectory test). Repo-local so repeat suite runs —
-# CI, the judge's re-run, a dev loop — hit it; gitignored (binary blobs).
-# Set via jax.config, not env: the tunnel's sitecustomize imports jax at
-# interpreter start, long before this file, so import-time env reads have
-# already happened.
-_cache = os.path.join(os.path.dirname(os.path.dirname(__file__)),
-                      ".pytest_jax_cache")
-if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-    jax.config.update("jax_compilation_cache_dir", _cache)
-# the thresholds apply to an externally-redirected cache too: JAX's default
-# 1s min-compile-time would exclude most of the suite's small jit programs
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+# Persistent XLA compilation cache: DISABLED — it was the source of the
+# intermittent SIGSEGV/SIGABRT that aborted whole tier-1 runs (the
+# tests/test_resume.py and sharded-trainer crashes). Root-caused 2026-08-04
+# by bisection: with a WARM cache a run deserializes previously compiled
+# executables and the next MLIR lowering intermittently dies inside
+# jax/_src/interpreters/mlir.py make_ir_context (reproduced 100% on
+# test_fused sharded tests: fresh cache dir passes 3/3, reusing the same
+# dir crashes; independent of donation, the native layer, and execution
+# concurrency — a block_until_ready barrier before the lowering still
+# crashes). That is a jaxlib-internal bug on this CPU backend; a test
+# harness must not trade determinism for warm-run speed, so the suite
+# fresh-compiles every run. If an environment-provided
+# JAX_COMPILATION_CACHE_DIR is set, trust the operator and leave it alone
+# (the crash class is re-detectable: any "Fatal Python error" under
+# make_ir_context with a warm cache is this).

@@ -1,0 +1,53 @@
+"""compat.py: the jax version shim every moved-API call site routes through.
+
+These tests pin the CONTRACT (callable shard_map, an export module with
+export(), a static axis_size under shard_map) rather than any particular
+jax version's spelling — the suite must stay green across the 0.4.x ->
+0.6+ API moves that broke 36 seed tier-1 tests.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu import compat
+
+
+def test_shard_map_resolves_and_runs():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    out = compat.shard_map(
+        lambda t: t * 2, mesh=mesh, in_specs=(P("x"),), out_specs=P("x")
+    )(jnp.arange(4.0))
+    np.testing.assert_array_equal(np.asarray(out), [0.0, 2.0, 4.0, 6.0])
+
+
+def test_export_module_has_export():
+    assert callable(compat.export.export)
+
+
+def test_axis_size_is_static_under_shard_map():
+    """ops/band_step._halo_exchange builds Python-level ppermute pairs from
+    the axis size, so the shim must return a value usable in range()."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    seen = {}
+
+    def f(t):
+        n = compat.axis_size("x")
+        seen["n"] = int(n)
+        list(range(n - 1))  # must not be a tracer
+        return t
+
+    compat.shard_map(f, mesh=mesh, in_specs=(P("x"),), out_specs=P("x"))(
+        jnp.arange(4.0)
+    )
+    assert seen["n"] == 2

@@ -4,6 +4,8 @@ The native path (host_data.cpp via ctypes) must be byte-identical to the
 Python fallbacks for counting, encoding (both corpus formats) and batch fill.
 """
 
+import shutil
+
 import numpy as np
 import pytest
 
@@ -27,8 +29,14 @@ def corpus_file(tmp_path):
     return str(p)
 
 
+@pytest.mark.skipif(
+    not any(shutil.which(cc) for cc in ("g++", "c++", "clang++")),
+    reason="no C++ toolchain on this host: the native layer legitimately "
+    "falls back to the byte-identical Python path (an environment gap, "
+    "not a code failure)",
+)
 def test_native_builds():
-    assert native.available(), "g++ toolchain present; native build must work"
+    assert native.available(), "C++ toolchain present; native build must work"
 
 
 def test_count_matches_python(corpus_file):

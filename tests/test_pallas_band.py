@@ -16,6 +16,7 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp
 
+from word2vec_tpu import compat
 from word2vec_tpu.config import Word2VecConfig
 from word2vec_tpu.data.negative import build_alias_table
 from word2vec_tpu.models.params import init_params
@@ -23,6 +24,29 @@ from word2vec_tpu.ops.band_step import make_band_train_step
 from word2vec_tpu.ops.tables import DeviceTables
 
 V, D = 60, 16
+
+
+def _export_for_tpu(fn, *args):
+    """Cross-platform AOT export for platforms=["tpu"], or SKIP when this
+    host's jaxlib has no TPU lowering path at all (no Mosaic pass
+    registered / no TPU plugin). A host that CAN lower must still fail
+    loudly on a real kernel/compiler incompatibility — only the
+    environmental "this jaxlib cannot target TPU" class skips."""
+    try:
+        return compat.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    except Exception as e:  # noqa: BLE001 — classified below
+        msg = str(e).lower()
+        environmental = (
+            "unknown backend" in msg
+            or "no tpu" in msg
+            or "tpu backend" in msg
+            or "unsupported platform" in msg
+            or "cannot lower" in msg and "tpu" in msg
+            or isinstance(e, NotImplementedError)
+        )
+        if environmental:
+            pytest.skip(f"no TPU lowering path on this host: {e}")
+        raise
 
 
 def _tables(cfg):
@@ -233,7 +257,7 @@ def test_kernel_lowers_to_mosaic(model, scope, window, tdt):
         band_core, W=window, K=5, cdt=jnp.bfloat16,
         is_cbow=model == "cbow", interpret=False,
     )
-    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    exp = _export_for_tpu(fn, *args)
     assert len(exp.mlir_module_serialized) > 0
 
 
@@ -270,8 +294,8 @@ def test_full_resident_runner_lowers_to_mosaic_with_pallas():
     }
     order = jnp.arange(corpus.num_rows, dtype=jnp.int32)
     alphas = jnp.full((8,), 0.025, jnp.float32)
-    exp = jax.export.export(jax.jit(fn), platforms=["tpu"])(
-        params, corpus_dev, order, jax.random.key(7), 0, 9999, alphas
+    exp = _export_for_tpu(
+        fn, params, corpus_dev, order, jax.random.key(7), 0, 9999, alphas
     )
     assert len(exp.mlir_module_serialized) > 0
 

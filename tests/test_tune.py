@@ -1,0 +1,319 @@
+"""Autotuned execution planner (word2vec_tpu/tune): cost model, plan cache,
+candidate grid, and the probe -> cache -> apply pipeline.
+
+Cost-model assertions pin ORDERINGS and calibration anchors, not absolute
+bytes — the model's job is pruning (tune/cost_model.py docstring), and the
+one measured anchor it must reproduce is the r2 trace's 2.14 ms layout-copy
+term at the flagship shape (PERF.md).
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from word2vec_tpu.config import TunePlan, Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.tune import cache as plan_cache
+from word2vec_tpu.tune import cost_model
+from word2vec_tpu.tune.planner import (
+    candidate_grid, config_fingerprint, kernel_route, resolve_plan,
+)
+from word2vec_tpu.utils.profiling import step_flops, step_hbm_bytes
+from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+V5E = ("TPU v5 lite", "tpu")
+FLAGSHIP = dict(
+    model="sg", train_method="ns", negative=5, word_dim=300, window=5,
+    batch_rows=256, max_sentence_len=192, min_count=1,
+)
+
+
+def _cfg(**kw):
+    base = dict(FLAGSHIP)
+    base.update(kw)
+    return Word2VecConfig(**base)
+
+
+# ------------------------------------------------------------- cost model
+def test_flops_monotone_in_batch_rows_dim_and_len():
+    for field, values in [
+        ("batch_rows", [64, 128, 256, 512]),
+        ("word_dim", [100, 200, 300, 600]),
+        ("max_sentence_len", [96, 192, 384]),
+    ]:
+        flops = [step_flops(_cfg(**{field: v}), 71000) for v in values]
+        assert all(a < b for a, b in zip(flops, flops[1:])), (field, flops)
+
+
+def test_bytes_monotone_in_shared_negatives():
+    vals = [
+        step_hbm_bytes(_cfg(shared_negatives=kp), 71000)["total"]
+        for kp in (16, 32, 64, 128)
+    ]
+    assert all(a < b for a, b in zip(vals, vals[1:])), vals
+
+
+def test_band_beats_pair_at_flagship_shape():
+    """The pair kernel enumerates [P, K+1, d] row gathers/scatters the band
+    kernel never materializes — at bench shapes the model must rank band
+    far cheaper (that ordering is why 'band' is the default fast path)."""
+    band = cost_model.predict(_cfg(), 71000, *V5E)
+    pair = cost_model.predict(_cfg(kernel="pair"), 71000, *V5E)
+    assert band.total_ms < pair.total_ms / 3
+    assert band.hbm_bytes < pair.hbm_bytes
+
+
+def test_pair_beats_band_when_shared_pool_dominates():
+    """Crossover exists: with a tiny window/row and a huge shared pool the
+    band kernel's KP-wide negative block outweighs per-pair enumeration —
+    the model must not hardcode band-always-wins."""
+    small = dict(
+        window=1, max_sentence_len=8, batch_rows=4, negative=1, word_dim=32,
+    )
+    band = cost_model.predict(
+        _cfg(shared_negatives=512, **small), 1000, *V5E
+    )
+    pair = cost_model.predict(
+        _cfg(kernel="pair", shared_negatives=512, **small), 1000, *V5E
+    )
+    assert pair.total_ms < band.total_ms
+
+
+def test_layout_copy_term_matches_measured_r2_anchor():
+    """The XLA band chain's layout-copy cost at the traced flagship shape
+    (B=256, L=192, d=300, W=5 on TPU v5 lite) must reproduce the measured
+    2.14 ms (PERF.md r2 trace) — the model's one empirical calibration."""
+    traffic = step_hbm_bytes(_cfg(), 71000)
+    _, bw, _ = cost_model.device_spec(*V5E)
+    ms = cost_model.layout_copy_ms(traffic["layout_copies"], bw)
+    assert abs(ms - 2.14) / 2.14 < 0.05, ms
+
+
+def test_pallas_moves_fewer_bytes_than_xla_band():
+    """The planner's pallas-vs-xla preference rests on the traffic contrast
+    documented in ops/pallas_band.py: VMEM-resident plane, single row-tensor
+    pass, no overlap-add copies."""
+    xla = step_hbm_bytes(_cfg(), 71000)
+    pal = step_hbm_bytes(_cfg(band_backend="pallas"), 71000)
+    assert pal["total"] < xla["total"]
+    assert pal["layout_copies"] == 0.0
+    assert pal["intermediates"] < xla["intermediates"]
+
+
+def test_dispatch_overhead_amortizes_with_chunk_cap():
+    a = cost_model.predict(_cfg(chunk_cap=1), 71000, *V5E)
+    b = cost_model.predict(_cfg(chunk_cap=96), 71000, *V5E)
+    assert a.dispatch_ms > b.dispatch_ms * 50
+    assert a.step_ms == b.step_ms  # cap changes dispatch economics only
+
+
+# -------------------------------------------------------------- plan cache
+def test_plan_cache_round_trip(tmp_path):
+    path = str(tmp_path / "plans.json")
+    cfg = _cfg()
+    key = plan_cache.plan_key("cpu", "cpu", kernel_route(cfg), 71000, 300)
+    fp = config_fingerprint(cfg)
+    entry = {
+        "plan": TunePlan(batch_rows=128, chunk_cap=96).to_json(),
+        "fingerprint": fp,
+        "predicted": {"total_ms": 1.0},
+    }
+    plan_cache.store(key, entry, path)
+    got = plan_cache.lookup(key, fp, path)
+    assert got is not None
+    assert TunePlan.from_json(got["plan"]) == TunePlan(
+        batch_rows=128, chunk_cap=96
+    )
+
+
+def test_plan_cache_invalidates_on_key_and_fingerprint_change(tmp_path):
+    path = str(tmp_path / "plans.json")
+    cfg = _cfg()
+    key = plan_cache.plan_key("cpu", "cpu", kernel_route(cfg), 71000, 300)
+    fp = config_fingerprint(cfg)
+    plan_cache.store(
+        key, {"plan": TunePlan().to_json(), "fingerprint": fp}, path
+    )
+    # a different (vocab, dim) key misses
+    other = plan_cache.plan_key("cpu", "cpu", kernel_route(cfg), 71000, 200)
+    assert plan_cache.lookup(other, fp, path) is None
+    # same key, changed problem (window) -> fingerprint miss
+    fp2 = config_fingerprint(_cfg(window=10))
+    assert plan_cache.lookup(key, fp2, path) is None
+    assert plan_cache.lookup(key, fp, path) is not None
+
+
+def test_plan_cache_corrupt_file_reads_as_empty(tmp_path):
+    path = str(tmp_path / "plans.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert plan_cache.lookup("k", {}, path) is None
+    plan_cache.store("k", {"plan": TunePlan().to_json()}, path)  # no raise
+    with open(path) as f:
+        assert json.load(f)["plans"]["k"]
+
+
+def test_vocab_size_bucketing_makes_near_vocabs_share_plans():
+    k1 = plan_cache.plan_key("TPU v5 lite", "tpu", "band-ns", 71290, 300)
+    k2 = plan_cache.plan_key("TPU v5 lite", "tpu", "band-ns", 71000, 300)
+    assert k1 == k2
+    assert plan_cache.plan_key(
+        "TPU v5 lite", "tpu", "band-ns", 50000, 300
+    ) != k1
+
+
+def test_seed_plans_cover_the_banked_tpu_default():
+    """The packaged seeds must serve the flagship bench config on the chip
+    it was banked on (TPU_R4/default.json) with a fingerprint that matches
+    what the planner computes — else 'cached' mode on the TPU would
+    silently probe instead of starting at 30.39x."""
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=5, word_dim=300, window=5,
+        subsample_threshold=1e-4, batch_rows=256, max_sentence_len=192,
+    )
+    key = plan_cache.plan_key(
+        "TPU v5 lite", "tpu", kernel_route(cfg), 71000, 300
+    )
+    entry = plan_cache.lookup(
+        key, config_fingerprint(cfg), path=os.devnull
+    )
+    assert entry is not None, "seed_plans.json lost the banked default"
+    assert TunePlan.from_json(entry["plan"]).batch_rows == 256
+
+
+# ----------------------------------------------------------- candidate grid
+def _tiny(**kw):
+    base = dict(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        batch_rows=8, max_sentence_len=32, min_count=1, chunk_steps=0,
+    )
+    base.update(kw)
+    return Word2VecConfig(**base)
+
+
+def test_candidate_grid_contains_base_and_only_valid_plans():
+    cfg = _tiny()
+    grid = candidate_grid(cfg, 60, {"platform": "cpu"})
+    assert cfg.current_plan() in grid
+    for plan in grid:
+        cfg.apply_plan(plan)  # must not raise
+        assert plan.band_backend == "xla"  # no pallas candidates off-TPU
+
+
+def test_candidate_grid_respects_hot_row_block_guard():
+    """Tuning must never walk a run INTO the hot-row divergence domain: on
+    a tiny vocabulary the grid may not grow the optimizer block past
+    max(8x vocab tokens, the configured block)."""
+    cfg = _tiny(batch_rows=4, max_sentence_len=16)
+    vocab_size = 8
+    max_block = max(8 * vocab_size, 4 * 16)
+    for plan in candidate_grid(cfg, vocab_size, {"platform": "cpu"}):
+        applied = cfg.apply_plan(plan)
+        block = applied.batch_rows // applied.micro_steps * 16
+        assert block <= max_block, plan
+
+
+def test_apply_plan_keeps_micro_steps_valid():
+    cfg = _tiny(batch_rows=8, micro_steps=4)  # block = 2 rows
+    out = cfg.apply_plan(TunePlan(batch_rows=16))
+    # micro still divides -> carried over (batch_rows is a real lever, the
+    # queue's b128/b512 semantics); divisibility always holds
+    assert (out.batch_rows, out.micro_steps) == (16, 4)
+    assert out.autotune == "off"
+    # non-dividing rows: micro rescales toward the old optimizer block
+    out2 = cfg.apply_plan(TunePlan(batch_rows=6))
+    assert out2.batch_rows % out2.micro_steps == 0
+    assert out2.micro_steps == 3  # block of 2 rows preserved exactly
+
+
+# ------------------------------------------------- probe -> cache -> apply
+@pytest.fixture(scope="module")
+def tiny_problem():
+    cfg = _tiny()
+    vocab = zipf_vocab(60, 6000)
+    corpus = PackedCorpus.pack(zipf_corpus_ids(vocab, 16000, seed=3), 32)
+    return cfg, vocab, corpus
+
+
+def test_probe_then_cached_reproduces_winner_bit_for_bit(
+    tmp_path, tiny_problem
+):
+    """ISSUE 1 acceptance: a probe run persists its winner, and a cached
+    run returns the exact same plan (bit-for-bit over the JSON round trip)
+    with zero probes."""
+    cfg, vocab, corpus = tiny_problem
+    cache = str(tmp_path / "plans.json")
+    probed = resolve_plan(
+        cfg, vocab, corpus=corpus, mode="probe", cache_path=cache,
+        max_probes=2, probe_steps=1, probe_dispatches=1,
+    )
+    assert probed.source == "probe"
+    assert probed.probes  # it really timed candidates
+    assert all("error" not in p for p in probed.probes)
+
+    cached = resolve_plan(
+        cfg, vocab, corpus=corpus, mode="cached", cache_path=cache,
+    )
+    assert cached.source == "cache"
+    assert cached.probes == []
+    assert cached.plan == probed.plan
+    assert cached.plan.to_json() == probed.plan.to_json()
+
+
+def test_cached_miss_falls_back_to_probe_and_persists(tmp_path, tiny_problem):
+    cfg, vocab, corpus = tiny_problem
+    cache = str(tmp_path / "fresh.json")
+    res = resolve_plan(
+        cfg, vocab, corpus=corpus, mode="cached", cache_path=cache,
+        max_probes=1, probe_steps=1, probe_dispatches=1,
+    )
+    assert res.source == "probe"  # miss -> searched
+    res2 = resolve_plan(
+        cfg, vocab, corpus=corpus, mode="cached", cache_path=cache,
+    )
+    assert res2.source == "cache" and res2.plan == res.plan
+
+
+def test_trainer_consumes_cached_plan(tmp_path, tiny_problem):
+    """config.autotune='cached' end-to-end: the Trainer applies the cached
+    plan before building anything and trains with the tuned shapes."""
+    from word2vec_tpu.train import Trainer
+
+    cfg, vocab, corpus = tiny_problem
+    cache = str(tmp_path / "plans.json")
+    probed = resolve_plan(
+        cfg, vocab, corpus=corpus, mode="probe", cache_path=cache,
+        max_probes=2, probe_steps=1, probe_dispatches=1,
+    )
+    cfg_at = dataclasses.replace(cfg, autotune="cached", plan_cache=cache)
+    tr = Trainer(cfg_at, vocab, corpus)
+    assert tr.plan_resolution is not None
+    assert tr.plan_resolution.source == "cache"
+    assert tr.config.current_plan() == probed.plan
+    assert tr.config.autotune == "off"  # resolved, cannot re-trigger
+    state, report = tr.train(log_every=0)
+    assert report.total_words > 0
+    assert np.isfinite(report.final_loss)
+
+
+def test_plan_shapes_exposed_by_both_trainers(tiny_problem):
+    from word2vec_tpu.train import Trainer
+
+    cfg, vocab, corpus = tiny_problem
+    shapes = Trainer(cfg, vocab, corpus).plan_shapes()
+    assert shapes["rows_per_dispatch"] == cfg.batch_rows
+    assert shapes["chunk_len"] >= 1
+
+    if len(jax.devices()) >= 2:
+        from word2vec_tpu.parallel import ShardedTrainer
+
+        tr = ShardedTrainer(cfg, vocab, corpus, dp=2)
+        sh = tr.plan_shapes()
+        assert sh["dp"] == 2
+        assert sh["rows_per_dispatch"] == cfg.batch_rows * 2
+        assert tr.plan_constraints()["allow_pallas"] is False

@@ -16,7 +16,7 @@ Quick start:
     state, report = Trainer(cfg, vocab, corpus).train()
 """
 
-from .config import Word2VecConfig
+from .config import TunePlan, Word2VecConfig
 from .data.batcher import BatchIterator, PackedCorpus
 from .data.huffman import HuffmanCoding, build_huffman
 from .data.negative import AliasTable, build_alias_table
@@ -35,6 +35,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Word2VecConfig",
+    "TunePlan",
     "Vocab",
     "PackedCorpus",
     "BatchIterator",

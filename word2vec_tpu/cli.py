@@ -155,6 +155,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="two-tier hs tail-scatter compaction bound per batch "
                         "row: -1 auto (+6 sigma), 0 off, >0 explicit "
                         "(config.hs_tail_slots)")
+    p.add_argument("--autotune", choices=["off", "probe", "cached"],
+                   default="off",
+                   help="autotuned execution planner (tune/): probe = search "
+                        "the step-shape space (cost-model-pruned grid, short "
+                        "timed probes) and persist the winner; cached = "
+                        "start from the persisted plan for this (device, "
+                        "kernel, vocab, dim) with zero probe cost (falls "
+                        "back to probe on a miss)")
+    p.add_argument("--plan-cache", dest="plan_cache", metavar="FILE",
+                   default="",
+                   help="plan-cache JSON path (default: $W2V_PLAN_CACHE or "
+                        "~/.cache/word2vec_tpu/plan_cache.json; the packaged "
+                        "seed plans back every lookup)")
     p.add_argument("--resident", choices=["auto", "on", "off"], default="auto",
                    help="device-resident corpus: keep the packed corpus in "
                         "HBM and assemble batches on device (single-chip "
@@ -296,6 +309,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         hs_dense_top=args.hs_dense_top,
         hs_tail_slots=args.hs_tail_slots,
         resident=args.resident,
+        autotune=args.autotune,
+        plan_cache=args.plan_cache,
         clip_row_update=args.clip_row_update,
         prng_impl=args.prng,
         dtype=args.table_dtype,
@@ -510,6 +525,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     else:
         trainer = Trainer(cfg, vocab, corpus, log_fn=log_fn)
+
+    if trainer.plan_resolution is not None:
+        cfg = trainer.config  # the plan-applied config (checkpoints pin it)
+        if not args.quiet:
+            pr = trainer.plan_resolution
+            hit = "cache hit" if pr.source == "cache" else "probed"
+            print(f"autotune ({hit}, key {pr.key}): {pr.plan.to_json()}")
 
     if state is not None and hasattr(trainer, "import_params"):
         # checkpoints always hold unreplicated [V, d] tables; re-shard them

@@ -1,0 +1,43 @@
+"""JAX version-compatibility shim.
+
+Single source of truth for APIs that moved between the jax versions this
+framework meets in the wild:
+
+  * ``shard_map`` — top-level ``jax.shard_map`` from jax 0.6; at 0.4.x it
+    lives at ``jax.experimental.shard_map.shard_map``. Every shard_map call
+    site (parallel/trainer.py) imports it from here.
+  * ``export`` — the AOT export module. Present as ``jax.export`` since
+    0.4.30, but on 0.4.x it is a *lazily importable submodule*, not an
+    eagerly-populated attribute: ``jax.export.export(...)`` raises
+    ``AttributeError`` unless something imported it first. Importing it here
+    makes ``compat.export`` work on every supported version (the Mosaic
+    cross-lowering tests use it).
+
+Keep this module dependency-light: it is imported by both the library and
+the test suite, before any backend initialization.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6
+    shard_map = jax.shard_map
+except AttributeError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+try:
+    import jax.export as export  # noqa: F401  (module import, version-stable)
+except ImportError:  # very old jax: the serialization-free experimental home
+    from jax.experimental import export  # noqa: F401
+
+try:  # jax >= 0.6
+    axis_size = jax.lax.axis_size
+except AttributeError:  # jax 0.4.x: psum of 1 over the axis is STATIC (a
+    # Python int) under shard_map tracing, so `range(axis_size(a) - 1)`
+    # works identically (ops/band_step._halo_exchange needs that)
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+
+__all__ = ["shard_map", "export", "axis_size"]

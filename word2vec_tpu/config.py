@@ -10,7 +10,36 @@ single-process OpenMP program.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class TunePlan:
+    """One point in the execution-shape space the autotuned planner searches
+    (tune/planner.py): the throughput levers that leave the training
+    OBJECTIVE fixed or quality-gated — batch geometry, band chunking, scan
+    megastep length, host prefetch depth, the negative-pool scope/width
+    (quality holds to KP=8 per PERF.md; 'batch' scope is the promoted
+    quality-positive lever), and the band compute backend. Everything else
+    (window, dim, objective, clip, dtypes) is the PROBLEM, not the plan,
+    and lives in the cache key/fingerprint instead.
+    """
+
+    batch_rows: int = 256
+    band_chunk: int = 0        # 0 = auto (ops/banded.resolve_chunk)
+    chunk_cap: int = 32        # max optimizer steps fused per dispatch
+    prefetch_depth: int = 1    # placed_prefetch depth on the streaming path
+    shared_negatives: int = 64
+    negative_scope: str = "row"
+    band_backend: str = "xla"
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Dict) -> "TunePlan":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
 
 @dataclasses.dataclass
@@ -159,6 +188,28 @@ class Word2VecConfig:
     # auto-sizes batch_rows this way). Set True only for degenerate
     # hot-row workloads.
     scatter_mean: bool = False
+
+    # --- autotuned execution planner (tune/) ---
+    # "off"    — run the configured shapes as-is.
+    # "probe"  — search the step-shape space: prune a candidate grid with
+    #            the analytic cost model (tune/cost_model.py), time the
+    #            survivors with short compile-separated probes, apply the
+    #            winner, and persist it in the plan cache.
+    # "cached" — start from the persisted plan for this
+    #            (device_kind, backend, kernel, vocab, dim) key with ZERO
+    #            probe cost; fall back to a probe (then cache) on a miss.
+    autotune: str = "off"
+    # plan-cache JSON path; "" = $W2V_PLAN_CACHE or
+    # ~/.cache/word2vec_tpu/plan_cache.json (tune/cache.py; the packaged
+    # seed plans in tune/seed_plans.json back every lookup)
+    plan_cache: str = ""
+    # Max optimizer steps fused into one dispatched scan megastep — the cap
+    # chunk_geometry sizes chunks against (previously a bench.py-only knob;
+    # a TunePlan dimension, so it must live on the config to be appliable).
+    chunk_cap: int = 32
+    # placed_prefetch depth for the streaming chunked path (host->device
+    # copy overlap; each unit pins one in-flight chunk buffer).
+    prefetch_depth: int = 1
 
     # Sequential optimizer sub-steps per dispatched batch (ops/train_step.py
     # micro wrapper): the [B, L] batch is split into micro_steps row blocks
@@ -352,12 +403,64 @@ class Word2VecConfig:
                 f"batch_rows {self.batch_rows} must be divisible by "
                 f"micro_steps {self.micro_steps}"
             )
+        if self.autotune not in ("off", "probe", "cached"):
+            raise ValueError(
+                f"autotune must be off|probe|cached, got {self.autotune!r}"
+            )
+        if self.chunk_cap < 1:
+            raise ValueError("chunk_cap must be >= 1")
+        if self.prefetch_depth < 1:
+            raise ValueError("prefetch_depth must be >= 1")
 
     @property
     def jax_prng_impl(self) -> str:
         """The jax.random.key(impl=...) spelling of prng_impl (the public
         flag keeps word2vec.c-era brevity; jax names the full algorithm)."""
         return {"threefry": "threefry2x32", "rbg": "rbg"}[self.prng_impl]
+
+    def apply_plan(self, plan: TunePlan) -> "Word2VecConfig":
+        """This config with the plan's step shapes applied (a NEW config —
+        the source config is untouched; autotune is marked resolved so the
+        result can never re-trigger a search).
+
+        batch_rows is a real lever here — the hand-tuned sweeps this planner
+        replaces (benchmarks/tpu_queue5.sh b128/b512 items) scale the
+        optimizer block with the dispatch, inside the hot-row guard the
+        candidate grid enforces. micro_steps therefore carries over
+        unchanged when it still divides the plan's rows, and is rescaled
+        toward preserving the old optimizer block only when it does not.
+        """
+        micro = self.micro_steps
+        if plan.batch_rows % micro != 0:
+            block = max(1, self.batch_rows // self.micro_steps)
+            micro = max(1, plan.batch_rows // block)
+            while plan.batch_rows % micro:
+                micro -= 1
+        return dataclasses.replace(
+            self,
+            batch_rows=plan.batch_rows,
+            band_chunk=plan.band_chunk,
+            chunk_cap=plan.chunk_cap,
+            prefetch_depth=plan.prefetch_depth,
+            shared_negatives=plan.shared_negatives,
+            negative_scope=plan.negative_scope,
+            band_backend=plan.band_backend,
+            micro_steps=micro,
+            autotune="off",
+        )
+
+    def current_plan(self) -> TunePlan:
+        """The plan this config already encodes (the search grid's 'default'
+        candidate, and the shape bench.py records when autotune is off)."""
+        return TunePlan(
+            batch_rows=self.batch_rows,
+            band_chunk=self.band_chunk,
+            chunk_cap=self.chunk_cap,
+            prefetch_depth=self.prefetch_depth,
+            shared_negatives=self.shared_negatives,
+            negative_scope=self.negative_scope,
+            band_backend=self.band_backend,
+        )
 
     @property
     def resolved_kernel(self) -> str:

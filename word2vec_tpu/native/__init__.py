@@ -61,8 +61,19 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(path)
         except OSError:
-            _build_failed = True
-            return None
+            # A checked-in .so built on another machine can be unloadable
+            # here (e.g. a newer glibc symbol version) while the toolchain
+            # compiles the source just fine — rebuild once from source
+            # before declaring the native layer unavailable.
+            path = _build()
+            if path is None:
+                _build_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                _build_failed = True
+                return None
         lib.w2v_count_file.restype = ctypes.c_void_p
         lib.w2v_count_file.argtypes = [ctypes.c_char_p]
         lib.w2v_counter_size.restype = ctypes.c_longlong

@@ -81,6 +81,7 @@ from ..config import Word2VecConfig
 from ..models.params import Params
 from . import banded
 from .tables import DeviceTables
+from .. import compat
 from .train_step import (
     _cast_update, _draw_negatives, _dup_mean_scale, _row_clip_scale,
     _sr_streams,
@@ -99,7 +100,7 @@ def _halo_exchange(tok: jnp.ndarray, w: int, axis: str) -> jnp.ndarray:
         raise ValueError(
             f"per-shard slice length {tok.shape[1]} < window {w}"
         )
-    n = jax.lax.axis_size(axis)
+    n = compat.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     # left halo = right edge of the left neighbor (shift right: i -> i+1)
     left = jax.lax.ppermute(

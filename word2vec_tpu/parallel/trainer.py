@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..compat import shard_map
+
 from ..config import Word2VecConfig
 from ..data.batcher import BatchIterator, PackedCorpus
 from ..data.vocab import Vocab
@@ -139,7 +141,7 @@ def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
 
     def stepfn(params, tokens, key, alpha):
         specs = {k: PARAM_SPEC for k in params}
-        return jax.shard_map(
+        return shard_map(
             local_step,
             mesh=mesh,
             in_specs=(specs, TOKEN_SPEC, P(), P()),
@@ -205,7 +207,7 @@ def make_sharded_chunk(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh)
 
     def chunkfn(params, tokens, base_key, step0, alphas):
         specs = {k: PARAM_SPEC for k in params}
-        return jax.shard_map(
+        return shard_map(
             local_chunk,
             mesh=mesh,
             in_specs=(specs, P(None, DATA_AXIS, SEQ_AXIS), P(), P(), P()),
@@ -282,7 +284,7 @@ def make_sharded_resident_chunk(
     def chunkfn(params, corpus, order, base_key, step0, epoch_t0, alphas):
         specs = {k: PARAM_SPEC for k in params}
         corpus_specs = {k: P() for k in corpus}
-        return jax.shard_map(
+        return shard_map(
             local_chunk,
             mesh=mesh,
             in_specs=(specs, corpus_specs, P(), P(), P(), P(), P()),
@@ -302,7 +304,7 @@ def make_sync(mesh: Mesh):
         def local(p):
             return {k: jax.lax.pmean(v, REPLICA_AXES) for k, v in p.items()}
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh, in_specs=(specs,), out_specs=specs
         )(params)
 
@@ -335,7 +337,7 @@ def make_delta_sync(mesh: Mesh):
                 out[k] = b[k] + mean_delta.astype(v.dtype)
             return out
 
-        return jax.shard_map(
+        return shard_map(
             local, mesh=mesh, in_specs=(specs, specs), out_specs=specs
         )(params, base)
 
@@ -516,7 +518,7 @@ class ShardedTrainer(Trainer):
         local_dp = self.dp // self.procs
         steps = self._agreed_steps_per_epoch(batcher, local_dp)
         if cfg.chunk_steps == 0:
-            s, _ = cfg.chunk_geometry(steps)
+            s, _ = cfg.chunk_geometry(steps, cap=cfg.chunk_cap)
         else:
             s = min(cfg.chunk_steps, steps)
         if self.dp * self.sp > 1 and cfg.dp_sync_every:
@@ -638,6 +640,36 @@ class ShardedTrainer(Trainer):
         if self.dp * self.sp > 1 and self._last_sync_step != state.step:
             state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
+
+    # ------------------------------------------------------------- planning
+    def plan_constraints(self):
+        """Mesh-aware constraints for the autotuned planner: the pallas
+        backend cannot live under shard_map (_reject_pallas), and candidate
+        shapes must respect the mesh divisibility rules the constructor
+        enforces (the planner never changes word_dim or max_sentence_len,
+        so dp is the only live divider — exposed for block-token math)."""
+        return {
+            "dp": self.dp,
+            "sp": self.sp,
+            "tp": self.tp,
+            "allow_pallas": False,
+        }
+
+    def plan_shapes(self):
+        """Realized per-chunk shapes over the mesh: the global dispatch is
+        dp row blocks wide, each shard sees an L/sp column slice and a d/tp
+        dim slice, and the chunk length is the sync-cadence-capped global
+        value (_resolve_chunk_len)."""
+        shapes = super().plan_shapes()
+        shapes.update(
+            dp=self.dp,
+            sp=self.sp,
+            tp=self.tp,
+            rows_per_dispatch=self.config.batch_rows * self.dp,
+            cols_per_shard=self.config.max_sentence_len // self.sp,
+            dim_per_shard=self.config.word_dim // self.tp,
+        )
+        return shapes
 
     # ----------------------------------------------------------------- api
     def export_params(self, state: TrainState) -> Params:

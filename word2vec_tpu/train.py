@@ -73,6 +73,9 @@ class Trainer:
     #: how the resident gate resolved (set by _build_resident; surfaced on
     #: TrainReport.resident and as an "event" log record)
     resident_resolution: Optional[Dict] = None
+    #: how the autotuned planner resolved (config.autotune != "off"):
+    #: a tune.PlanResolution, for bench/CLI observability
+    plan_resolution = None
 
     def __init__(
         self,
@@ -84,14 +87,61 @@ class Trainer:
         self.config = config
         self.vocab = vocab
         self.corpus = corpus
-        self.tables = DeviceTables.build(vocab, config)
         self.log_fn = log_fn
+        if config.autotune != "off":
+            # Resolve the execution plan BEFORE anything shape-dependent is
+            # built: cached plans apply with zero probe cost, probe mode
+            # times candidates on this very corpus (tune/planner.py). The
+            # resolved config has autotune="off", so nothing downstream can
+            # re-trigger a search.
+            from .tune import resolve_plan
+
+            self.plan_resolution = resolve_plan(
+                config,
+                vocab,
+                corpus=corpus,
+                mode=config.autotune,
+                cache_path=config.plan_cache or None,
+                constraints=self.plan_constraints(),
+                log_fn=log_fn,
+            )
+            self.config = config = config.apply_plan(self.plan_resolution.plan)
+        self.tables = DeviceTables.build(vocab, config)
         self.total_words = corpus.num_tokens
         # resident-corpus runner + HBM corpus, built once per instance
         self._resident_cache = None
         self._resident_ready = False
         self._warn_config_hazards()
         self._build_step()
+
+    # ------------------------------------------------------------- planning
+    def plan_constraints(self) -> Dict:
+        """What the planner's candidate grid must respect for this trainer
+        (the sharded trainer narrows these from its mesh)."""
+        return {"dp": 1, "sp": 1, "tp": 1, "allow_pallas": True}
+
+    def plan_shapes(self) -> Dict:
+        """The realized per-dispatch step shapes (for the planner's records
+        and bench artifacts): dispatch geometry, resolved band chunk, and
+        the scan megastep length this corpus resolves to."""
+        from .data.batcher import BatchIterator
+        from .utils.profiling import step_geometry
+
+        cfg = self.config
+        g = step_geometry(cfg, len(self.vocab))
+        batcher = BatchIterator(
+            self.corpus, cfg.batch_rows, cfg.max_sentence_len, seed=cfg.seed
+        )
+        return {
+            "rows_per_dispatch": cfg.batch_rows,
+            "max_sentence_len": cfg.max_sentence_len,
+            "micro_steps": cfg.micro_steps,
+            "band_chunk_S": g["S"],
+            "chunk_len": self._resolve_chunk_len(batcher),
+            "dp": 1,
+            "sp": 1,
+            "tp": 1,
+        }
 
     def _warn_config_hazards(self) -> None:
         """Pre-training configuration hazards, warned once at construction:
@@ -219,6 +269,23 @@ class Trainer:
         checkpoint_every: int = 0,
     ) -> Tuple[TrainState, TrainReport]:
         cfg = self.config
+        if state is not None:
+            # Donation hygiene for externally-supplied state (checkpoint
+            # resume, train(state=...) callers): the first step DONATES its
+            # params buffers, so without this copy every reference the
+            # CALLER still holds to those arrays dies the moment training
+            # starts ("Array has been deleted" on any later read — e.g.
+            # saving the pre-resume snapshot, or a test comparing against
+            # the handed-in state). Training consumes device-owned COPIES
+            # instead; one extra table copy per train() call is noise.
+            # (The tier-1 segfault that used to abort tests/test_resume.py
+            # was a separate issue — warm persistent-compile-cache
+            # deserialization crashing later MLIR lowerings — fixed at the
+            # source in tests/conftest.py.)
+            state.params = {
+                k: jnp.asarray(v).copy() for k, v in state.params.items()
+            }
+            jax.block_until_ready(state.params)
         state = state or self.init_state()
         batcher = BatchIterator(
             self.corpus, cfg.batch_rows, cfg.max_sentence_len, seed=cfg.seed
@@ -339,7 +406,7 @@ class Trainer:
             return 1
         steps = batcher.steps_per_epoch()
         if cfg.chunk_steps == 0:
-            s, _ = cfg.chunk_geometry(steps)
+            s, _ = cfg.chunk_geometry(steps, cap=cfg.chunk_cap)
             return s
         return min(cfg.chunk_steps, steps)
 
@@ -561,6 +628,7 @@ class Trainer:
         for tokens, words_list in placed_prefetch(
             self._chunk_stream(batcher, epoch, skip, chunk_len),
             self._place_tokens,
+            depth=self.config.prefetch_depth,
         ):
 
             def dispatch(al, tokens=tokens):

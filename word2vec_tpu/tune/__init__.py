@@ -1,0 +1,42 @@
+"""Autotuned execution planner (config.autotune; ISSUE 1 tentpole).
+
+The step-shape space this framework exposes — batch rows, band chunk, scan
+megastep length, prefetch depth, negative scope/width, kernel backend — has
+until now been searched by humans queuing shell lines at a TPU tunnel
+(benchmarks/tpu_queue*.sh). This package turns that search into code:
+
+    cost_model  — analytic HBM-bytes + FLOPs per step (shared counters in
+                  utils/profiling.py) -> roofline milliseconds, used to
+                  prune the candidate grid without running anything
+    planner     — grid -> prune -> short compile-separated timed probes ->
+                  winner (resolve_plan, the single entry point)
+    cache       — persistent JSON plan cache keyed by (device_kind,
+                  backend, kernel, vocab, dim), seeded with the hand-tuned
+                  shapes already banked on chip (seed_plans.json)
+
+Consumers: train.Trainer (config.autotune != "off"), cli.py (--autotune),
+bench.py (--autotune; banks plan + predicted-vs-measured cost in its JSON).
+"""
+
+from .cache import default_cache_path, lookup, plan_key, store
+from .cost_model import CostEstimate, predict, predicted_words_per_sec
+from .planner import (
+    PlanResolution, candidate_grid, config_fingerprint, kernel_route,
+    probe_plan, resolve_plan,
+)
+
+__all__ = [
+    "CostEstimate",
+    "PlanResolution",
+    "candidate_grid",
+    "config_fingerprint",
+    "default_cache_path",
+    "kernel_route",
+    "lookup",
+    "plan_key",
+    "predict",
+    "predicted_words_per_sec",
+    "probe_plan",
+    "resolve_plan",
+    "store",
+]

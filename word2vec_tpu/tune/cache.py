@@ -1,0 +1,120 @@
+"""Persistent JSON plan cache for the autotuned execution planner.
+
+One file, one JSON object: {schema, plans: {key: entry}}. Keys are the
+planner's identity tuple
+
+    (device_kind, backend, kernel_route, vocab_size, word_dim)
+
+rendered as a string (plan_key) — the dimensions along which a tuned step
+shape transfers: the chip generation, where the program runs (cpu/tpu), which
+kernel family realizes the objective, and the two sizes that set every
+matmul/scatter shape. Anything else that could invalidate a plan (window,
+sentence length, dtypes, micro-step block, model/objective) goes into the
+entry's FINGERPRINT: a lookup whose fingerprint disagrees is a miss, so a
+stale plan can never be silently applied to a different problem.
+
+Entries carry provenance (probe throughput, predicted cost, creation time)
+so a banked bench artifact can say where its shapes came from.
+
+Writes are atomic (tmp + os.replace) and lock-free: last writer wins, which
+is fine for a cache whose entries are independently recomputable. A corrupt
+or unreadable file reads as empty — the planner then re-probes, it never
+crashes the run.
+
+The packaged seed file (tune/seed_plans.json) backs every lookup: shapes
+hand-tuned in benchmarks/tpu_queue5.sh-era sweeps (e.g. the banked
+TPU v5 lite default, TPU_R4/default.json) are available with zero probe cost
+on a fresh machine. User-cache entries shadow seeds on key collision.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Dict, Optional
+
+SCHEMA = 1
+
+_SEED_PATH = os.path.join(os.path.dirname(__file__), "seed_plans.json")
+
+
+def default_cache_path() -> str:
+    env = os.environ.get("W2V_PLAN_CACHE")
+    if env:
+        return env
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "word2vec_tpu", "plan_cache.json"
+    )
+
+
+def plan_key(
+    device_kind: str, backend: str, kernel_route: str, vocab_size: int,
+    dim: int,
+) -> str:
+    """The cache key: (device_kind, backend, kernel, vocab_size, dim).
+
+    vocab_size is bucketed to 2 significant figures — step shapes do not
+    change between a 71,290- and a 71,000-word vocabulary, and an exact
+    count would make every corpus re-probe.
+    """
+    v = int(vocab_size)
+    if v >= 100:
+        mag = 10 ** (len(str(v)) - 2)
+        v = (v // mag) * mag
+    return f"{device_kind or 'unknown'}|{backend}|{kernel_route}|V{v}|d{dim}"
+
+
+def _read(path: str) -> Dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+            return {"schema": SCHEMA, "plans": {}}
+        if not isinstance(doc.get("plans"), dict):
+            return {"schema": SCHEMA, "plans": {}}
+        return doc
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {"schema": SCHEMA, "plans": {}}
+
+
+def lookup(
+    key: str, fingerprint: Dict, path: Optional[str] = None
+) -> Optional[Dict]:
+    """The cached entry for `key`, or None. Fingerprint mismatch is a miss
+    (invalidation: the key matched but the problem changed underneath it).
+    User cache first, packaged seeds second."""
+    for p in (path or default_cache_path(), _SEED_PATH):
+        entry = _read(p)["plans"].get(key)
+        if entry is None:
+            continue
+        if entry.get("fingerprint") != fingerprint:
+            continue
+        return entry
+    return None
+
+
+def store(key: str, entry: Dict, path: Optional[str] = None) -> str:
+    """Atomically merge {key: entry} into the cache file; returns the path."""
+    path = path or default_cache_path()
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    doc = _read(path)
+    doc["plans"][key] = dict(
+        entry,
+        created_utc=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        schema=SCHEMA,
+    )
+    fd, tmp = tempfile.mkstemp(dir=parent, prefix=".plan_cache_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path

@@ -1,0 +1,407 @@
+"""The autotuned execution planner: cost-model pruning + timed probes.
+
+Today every platform runs a hand-picked step shape (the benchmarks/ queue
+scripts sweep them one shell line at a time, per round, per tunnel window).
+This module makes that search code:
+
+  1. GRID     — candidate TunePlans around the configured shape: batch rows,
+                band chunk, scan megastep cap, negative-pool width/scope,
+                band backend. Candidates that would change training quality
+                are excluded up front (hot-row block-token guard; levers
+                stay inside their measured quality envelopes — PERF.md).
+  2. PRUNE    — rank the grid with the analytic cost model
+                (tune/cost_model.py: HBM bytes + FLOPs -> roofline ms) and
+                keep the top few plus the configured default.
+  3. PROBE    — time the survivors with short, compile-separated probes:
+                one warmup dispatch (compile + first-touch, excluded, the
+                bench.py protocol), then a few timed dispatches of a short
+                scan. The measured step time is combined with the model's
+                per-dispatch overhead term so a cheap-to-probe short scan
+                still ranks megastep caps correctly.
+  4. PERSIST  — the winner goes into the JSON plan cache keyed by
+                (device_kind, backend, kernel, vocab, dim); the next run
+                starts tuned with zero probe cost (mode="cached").
+
+Probes run the REAL kernels at the REAL shapes on whatever backend is live,
+so the whole planner is exercisable on CPU while aiming at the on-chip
+>=50x item (ROADMAP). The probe trains on a throwaway copy of the params —
+a probed run's training state is never touched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import TunePlan, Word2VecConfig
+from . import cache as plan_cache
+from . import cost_model
+
+# fingerprint: everything that invalidates a cached plan but is neither a
+# cache-key dimension nor a plan dimension (see cache.py). schema bumps
+# force re-probes when the planner's own semantics change.
+FINGERPRINT_FIELDS = (
+    "model", "train_method", "negative", "window", "max_sentence_len",
+    "dtype", "compute_dtype", "stochastic_rounding", "slab_scatter",
+    "fused_tables", "hs_dense_top", "hs_tail_slots", "clip_row_update",
+    "scatter_mean", "cbow_mean",
+)
+
+
+def config_fingerprint(config: Word2VecConfig) -> Dict:
+    fp = {f: getattr(config, f) for f in FINGERPRINT_FIELDS}
+    fp["schema"] = plan_cache.SCHEMA
+    return fp
+
+
+def kernel_route(config: Word2VecConfig) -> str:
+    if config.resolved_kernel == "pair":
+        return "pair"
+    return "band-hs" if config.use_hs else "band-ns"
+
+
+@dataclasses.dataclass
+class PlanResolution:
+    plan: TunePlan
+    source: str                 # "cache" | "probe"
+    key: str
+    predicted: Dict             # CostEstimate.to_json() of the chosen plan
+    probes: List[Dict]          # per-candidate records ([] on a cache hit)
+    cache_path: Optional[str]
+
+    def to_json(self) -> Dict:
+        return {
+            "plan": self.plan.to_json(),
+            "source": self.source,
+            "key": self.key,
+            "predicted": self.predicted,
+            "probes": self.probes,
+        }
+
+
+def candidate_grid(
+    config: Word2VecConfig,
+    vocab_size: int,
+    constraints: Optional[Dict] = None,
+) -> List[TunePlan]:
+    """Valid TunePlans around the configured shape.
+
+    Quality fences: the optimizer block may not carry more tokens per vocab
+    word than max(8x vocab, the configured block) — tuning must never walk
+    a run INTO the hot-row divergence domain the Trainer warns about; KP
+    stays >= 32 (accuracy measured holding to KP=8, PERF.md — 32 keeps
+    margin); 'batch' scope is the replicated quality-positive lever. A
+    candidate the config rules reject (pallas+hs, batch-scope+pair, ...)
+    is dropped by construction via apply_plan's validation.
+    """
+    c = constraints or {}
+    base = config.current_plan()
+    L = config.max_sentence_len
+    block = max(1, config.batch_rows // config.micro_steps) * L
+    max_block = max(8 * max(1, vocab_size), block)
+
+    rows = sorted({
+        base.batch_rows,
+        max(config.micro_steps, base.batch_rows // 2),
+        base.batch_rows * 2,
+    })
+    caps = sorted({base.chunk_cap, 32, 96})
+    # Band chunk S: the auto rule (ops/banded.resolve_chunk) fills a 128-lane
+    # slab — an MXU tiling choice, not a plane-size optimum. Smaller explicit
+    # chunks shrink the [B, C, S, S+2W] logit plane (S = L/2 cuts it ~33% at
+    # the flagship shape) at the cost of more, narrower matmuls — which side
+    # wins is exactly what probes are for.
+    W2 = 2 * config.window
+    chunks = sorted({
+        base.band_chunk,
+        max(W2, config.max_sentence_len // 2),
+        max(W2, config.max_sentence_len // 3),
+    })
+    is_band_ns = kernel_route(config) == "band-ns"
+    kps = sorted({base.shared_negatives, 32, 64}) if is_band_ns else [
+        base.shared_negatives
+    ]
+    scopes = ["row", "batch"] if is_band_ns else [base.negative_scope]
+    backends = [base.band_backend]
+    if (
+        is_band_ns
+        and c.get("allow_pallas", True)
+        and c.get("platform") == "tpu"
+        and not config.fused_tables
+        and "pallas" not in backends
+    ):
+        backends.append("pallas")
+
+    combos = [
+        (b, cap, kp, scope, S, be)
+        for b in rows
+        for cap in caps
+        for kp in kps
+        for scope in scopes
+        for S in chunks
+        for be in backends
+    ]
+    out: List[TunePlan] = []
+    seen = set()
+    for b, cap, kp, scope, S, be in combos:
+        # batch scope correlates the whole batch on one pool; keep it at
+        # the promoted kp=256 width
+        eff_kp = max(kp, 256) if scope == "batch" else kp
+        plan = TunePlan(
+            batch_rows=b,
+            band_chunk=S,
+            chunk_cap=cap,
+            prefetch_depth=base.prefetch_depth,
+            shared_negatives=eff_kp,
+            negative_scope=scope,
+            band_backend=be,
+        )
+        if plan in seen:
+            continue
+        seen.add(plan)
+        try:
+            applied = config.apply_plan(plan)
+        except ValueError:
+            continue
+        cand_block = (applied.batch_rows // applied.micro_steps) * L
+        if cand_block > max_block:
+            continue
+        out.append(plan)
+    return out
+
+
+def _synthetic_probe_corpus(vocab, n_tokens: int, max_len: int):
+    from ..data.batcher import PackedCorpus
+    from ..utils.synthetic import zipf_corpus_ids
+
+    ids = zipf_corpus_ids(vocab, n_tokens, seed=11)
+    return PackedCorpus.pack(ids, max_len)
+
+
+def _probe_chunks(corpus, cfg: Word2VecConfig, s_probe: int, n: int):
+    """n [s_probe, B, L] token chunks from the corpus front (no shuffle —
+    probes time compute, they don't train)."""
+    from ..data.batcher import BatchIterator, chunk_batches
+
+    batcher = BatchIterator(
+        corpus, cfg.batch_rows, cfg.max_sentence_len, seed=1, shuffle=False
+    )
+    chunks: List[Tuple[np.ndarray, List[int]]] = []
+    while len(chunks) < n:
+        for tok, words in chunk_batches(batcher.epoch(0), s_probe):
+            chunks.append((tok, words))
+            if len(chunks) == n:
+                break
+        if not chunks:  # empty corpus cannot happen (PackedCorpus raises)
+            break
+    return chunks
+
+
+def probe_plan(
+    config: Word2VecConfig,
+    plan: TunePlan,
+    vocab,
+    corpus,
+    probe_steps: int = 2,
+    probe_dispatches: int = 2,
+) -> Dict:
+    """Time one candidate: words/sec and ms per optimizer step, compile
+    excluded (one warmup dispatch à la bench.py, then timed dispatches of a
+    short scan). Raises nothing — a candidate that fails to build/compile
+    returns a record with an "error" field and infinite cost."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.params import init_params
+    from ..ops.tables import DeviceTables
+    from ..ops.train_step import jit_chunk_runner
+
+    rec: Dict = {"plan": plan.to_json()}
+    try:
+        cfg = config.apply_plan(plan)
+        s = max(1, min(probe_steps, cfg.chunk_cap))
+        chunks = _probe_chunks(corpus, cfg, s, probe_dispatches + 1)
+        tables = DeviceTables.build(vocab, cfg)
+        params = init_params(
+            cfg, len(vocab), jax.random.key(0, impl=cfg.jax_prng_impl)
+        )
+        chunk_fn = jit_chunk_runner(cfg, tables)
+        base_key = jax.random.key(13, impl=cfg.jax_prng_impl)
+        alphas = jnp.full((s,), cfg.init_alpha, jnp.float32)
+
+        warm = jnp.asarray(chunks[0][0])
+        params, _ = chunk_fn(params, warm, base_key, 0, alphas)
+        jax.block_until_ready(params)
+
+        words = 0
+        t0 = time.perf_counter()
+        for i in range(probe_dispatches):
+            tok, wl = chunks[(i + 1) % len(chunks)]
+            params, _ = chunk_fn(
+                params, jnp.asarray(tok), base_key, (i + 1) * s, alphas
+            )
+            words += sum(wl)
+        jax.block_until_ready(params)
+        dt = time.perf_counter() - t0
+        del params, tables, chunk_fn, chunks, warm
+        step_ms = 1e3 * dt / (probe_dispatches * s)
+        rec["measured_step_ms"] = round(step_ms, 4)
+        rec["probe_words_per_sec"] = round(words / max(dt, 1e-9), 1)
+        # short scans under-represent dispatch amortization; add the model's
+        # per-dispatch overhead share at the candidate's REAL megastep cap
+        dev = jax.devices()[0]
+        _, _, overhead = cost_model.device_spec(
+            dev.device_kind, dev.platform
+        )
+        total_ms = step_ms + overhead / max(1, plan.chunk_cap)
+        wps = 1e3 * words / max(probe_dispatches * s, 1) / max(
+            total_ms, 1e-9
+        )
+        rec["score_words_per_sec"] = round(wps, 1)
+    except Exception as e:  # noqa: BLE001 — a candidate must not kill the run
+        rec["error"] = f"{type(e).__name__}: {e}"
+        rec["score_words_per_sec"] = 0.0
+    return rec
+
+
+def resolve_plan(
+    config: Word2VecConfig,
+    vocab,
+    corpus=None,
+    mode: Optional[str] = None,
+    cache_path: Optional[str] = None,
+    constraints: Optional[Dict] = None,
+    max_probes: int = 4,
+    probe_steps: int = 2,
+    probe_dispatches: int = 2,
+    log_fn: Optional[Callable[[Dict], None]] = None,
+) -> PlanResolution:
+    """The planner entry point (Trainer, cli.py and bench.py all call this).
+
+    mode "cached": cache hit -> zero probe cost; miss -> probe, then cache.
+    mode "probe":  always search (and refresh the cache with the winner).
+    """
+    import jax
+
+    mode = mode or config.autotune
+    if mode == "off":
+        raise ValueError("resolve_plan called with autotune='off'")
+    dev = jax.devices()[0]
+    platform = dev.platform
+    constraints = dict(constraints or {})
+    constraints.setdefault("platform", platform)
+    key = plan_cache.plan_key(
+        dev.device_kind, platform, kernel_route(config), len(vocab),
+        config.word_dim,
+    )
+    fp = config_fingerprint(config)
+
+    if mode == "cached":
+        entry = plan_cache.lookup(key, fp, cache_path)
+        if entry is not None:
+            res = PlanResolution(
+                plan=TunePlan.from_json(entry["plan"]),
+                source="cache",
+                key=key,
+                predicted=entry.get("predicted", {}),
+                probes=[],
+                cache_path=cache_path or plan_cache.default_cache_path(),
+            )
+            if log_fn:
+                log_fn({"event": "autotune", **res.to_json()})
+            return res
+        # miss: fall through to a probe (then persist, so the NEXT cached
+        # run is free)
+
+    grid = candidate_grid(config, len(vocab), constraints)
+    base = config.current_plan()
+    if base not in grid:
+        grid.append(base)
+
+    def predicted_wps(plan: TunePlan) -> float:
+        cfg = config.apply_plan(plan)
+        return cost_model.predicted_words_per_sec(
+            cfg, len(vocab), dev.device_kind, platform
+        )
+
+    ranked = sorted(grid, key=predicted_wps, reverse=True)
+    survivors = ranked[: max(1, max_probes)]
+    if base not in survivors:
+        survivors[-1] = base  # the incumbent always gets probed
+
+    if corpus is None:
+        need = max(p.batch_rows for p in survivors) * probe_steps * (
+            probe_dispatches + 1
+        )
+        corpus = _synthetic_probe_corpus(
+            vocab, need * config.max_sentence_len, config.max_sentence_len
+        )
+
+    probes = []
+    for plan in survivors:
+        rec = probe_plan(
+            config, plan, vocab, corpus,
+            probe_steps=probe_steps, probe_dispatches=probe_dispatches,
+        )
+        rec["predicted_total_ms"] = cost_model.predict(
+            config.apply_plan(plan), len(vocab), dev.device_kind, platform
+        ).to_json()["total_ms"]
+        probes.append(rec)
+        if log_fn:
+            log_fn({"event": "autotune_probe", **rec})
+
+    # Leave no probe residue in the process that is about to train: each
+    # candidate compiled its own executables and allocated its own tables,
+    # and that residue measurably slows the subsequent run (~10% on the CPU
+    # bench's measured epoch). Dropping jit caches + cycles returns the
+    # process to a fresh-start allocator state; the caller's own programs
+    # have not been built yet (the plan decides their shapes).
+    import gc
+
+    gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:  # noqa: BLE001 — older jax: cache clearing is best-effort
+        pass
+
+    best = max(probes, key=lambda r: r.get("score_words_per_sec", 0.0))
+    if "error" in best:
+        # every survivor failed: keep the configured shape, report why
+        best_plan = base
+    else:
+        best_plan = TunePlan.from_json(best["plan"])
+    predicted = cost_model.predict(
+        config.apply_plan(best_plan), len(vocab), dev.device_kind, platform
+    ).to_json()
+
+    stored_path = None
+    try:
+        stored_path = plan_cache.store(
+            key,
+            {
+                "plan": best_plan.to_json(),
+                "fingerprint": fp,
+                "predicted": predicted,
+                "measured_words_per_sec": best.get("probe_words_per_sec"),
+                "device_kind": dev.device_kind,
+                "platform": platform,
+            },
+            cache_path,
+        )
+    except OSError:
+        pass  # read-only filesystem: the plan still applies, it just won't persist
+
+    res = PlanResolution(
+        plan=best_plan,
+        source="probe",
+        key=key,
+        predicted=predicted,
+        probes=probes,
+        cache_path=stored_path,
+    )
+    if log_fn:
+        log_fn({"event": "autotune", **res.to_json()})
+    return res

@@ -14,6 +14,13 @@ The reference has none (SURVEY §5: the only perf artifact is the `-Ofast
     state step timing with percentile stats, used by benchmarks/ablate.py
     and bench.py-style meters. Timing without blocking measures dispatch,
     not compute — this forces the sync.
+  * `step_flops` / `step_hbm_bytes` — analytic per-optimizer-step work
+    accounting (algorithmic FLOPs and HBM traffic) for every kernel route
+    (pair / band-XLA / band-Pallas / positional hs). These are the shared
+    counters behind the autotuned execution planner's cost model
+    (tune/cost_model.py) and bench.py's predicted-cost record: one
+    definition, so the number the planner ranks candidates by is the same
+    number the bench artifact reports.
 
 Words/sec metering itself lives in the Trainer's log records
 (utils/logging.py); this module is for *why is the step slow*, not *how fast
@@ -23,8 +30,9 @@ is it going*.
 from __future__ import annotations
 
 import contextlib
+import math
 import time
-from typing import Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 import jax
 
@@ -83,3 +91,159 @@ class StepTimer:
             "min_ms": 1e3 * laps[0],
             "max_ms": 1e3 * laps[-1],
         }
+
+
+# --------------------------------------------------------------------------
+# Analytic per-step work accounting (the planner's and bench.py's counters)
+# --------------------------------------------------------------------------
+
+def _dtype_bytes(name: str) -> int:
+    return 2 if name == "bfloat16" else 4
+
+
+def step_geometry(config, vocab_size: int) -> Dict:
+    """Resolved step-shape geometry for one dispatched optimizer step.
+
+    Pure shape math (no jax): B rows x L positions, the band chunking
+    (ops/banded.resolve_chunk) and the negative-pool shape, as the kernels
+    will actually realize them. The planner, the cost model, and bench.py
+    all read step shapes from here so they can never disagree.
+    """
+    from ..ops.banded import resolve_chunk
+
+    B, L, W = config.batch_rows, config.max_sentence_len, config.window
+    S = resolve_chunk(L, W, config.band_chunk)
+    if S == 0:
+        C, slab, plane = 1, L, B * L * L
+    else:
+        C = -(-L // S)
+        slab = S + 2 * W
+        plane = B * C * S * slab
+    NB = 1 if config.negative_scope == "batch" else B
+    return {
+        "B": B,
+        "L": L,
+        "W": W,
+        "d": config.word_dim,
+        "S": S,
+        "C": C,
+        "slab": slab,
+        "plane": plane,
+        "KP": config.shared_negatives,
+        "NB": NB,
+        "K": config.negative,
+        "avg_path": max(1, math.ceil(math.log2(max(2, vocab_size)))),
+        "kernel": config.resolved_kernel,
+        "route": (
+            "pair"
+            if config.resolved_kernel == "pair"
+            else ("band-hs" if config.use_hs else "band-ns")
+        ),
+        "backend": config.band_backend,
+        "table_bytes": _dtype_bytes(config.dtype),
+        "compute_bytes": _dtype_bytes(config.compute_dtype),
+    }
+
+
+def step_flops(config, vocab_size: int) -> float:
+    """Algorithmic FLOPs one optimizer step executes (not model-useful
+    FLOPs — masked band slots count, exactly as the hardware pays them).
+
+    Band ns: three band contractions over the [B, C, S, S+2W] logit plane
+    (qk logits, sv center-grad, vs context-grad) at 2*plane*d each, plus the
+    shared-negative side's three [B*L, KP] contractions. Pair: the unrolled
+    P = B*L*2W enumeration against K+1 targets, 3 * 2d per target
+    (bench.model_flops_per_target's accounting). Positional hs: like pair
+    with the padded Huffman path length in place of K+1.
+    """
+    g = step_geometry(config, vocab_size)
+    B, L, d, W = g["B"], g["L"], g["d"], g["W"]
+    if g["route"] == "pair":
+        targets = (g["K"] + 1) if config.use_ns else g["avg_path"]
+        return 6.0 * B * L * 2 * W * targets * d
+    if g["route"] == "band-hs":
+        # positional kernel: every (center, path-slot) pair scores/updates a
+        # d-row; the padded path length bounds it
+        return 6.0 * B * L * g["avg_path"] * d + 12.0 * B * L * g["avg_path"]
+    # band-ns: positive band plane + shared-negative block + elementwise
+    return (
+        6.0 * g["plane"] * d
+        + 6.0 * B * L * g["KP"] * d
+        + 12.0 * g["plane"]
+        + 8.0 * B * L * g["KP"]
+    )
+
+
+def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
+    """Analytic HBM traffic of one optimizer step, split by origin:
+
+      table_io       — embedding-row gathers + read-modify-write scatters
+      intermediates  — materialized row tensors / logit planes re-read by
+                       later ops (XLA band chain; ~0 for the fused Pallas
+                       kernel, which keeps them in VMEM — the traffic
+                       contrast prose-documented in ops/pallas_band.py)
+      layout_copies  — the {0,2,1}<->{2,1,0} copies XLA inserts around the
+                       overlap-add chain (measured 2.14 ms = 27% of the r2
+                       step; absent on the pallas and slab-scatter paths)
+      total          — sum of the above
+
+    Absolute bytes are a model, not a measurement — the value is in the
+    ORDERING (pallas < xla band << pair at bench shapes) and the terms'
+    scaling, which the planner's pruning relies on and
+    tests/test_tune.py pins.
+    """
+    g = step_geometry(config, vocab_size)
+    B, L, d = g["B"], g["L"], g["d"]
+    tb, f32 = g["table_bytes"], 4
+    if g["route"] == "pair":
+        P = B * L * 2 * g["W"]
+        targets = (g["K"] + 1) if config.use_ns else g["avg_path"]
+        gathers = (P + P * targets) * d * tb
+        scatters = 3.0 * (P + P * targets) * d * tb  # RMW + index machinery
+        inter = 2.0 * P * targets * f32  # logits/grads planes
+        return {
+            "table_io": gathers + scatters,
+            "intermediates": inter,
+            "layout_copies": 0.0,
+            "total": gathers + scatters + inter,
+        }
+    if g["route"] == "band-hs":
+        rows = B * L * g["avg_path"]
+        table_io = 4.0 * rows * d * tb
+        inter = 4.0 * B * L * d * f32
+        return {
+            "table_io": table_io,
+            "intermediates": inter,
+            "layout_copies": 0.0,
+            "total": table_io + inter,
+        }
+    # --- band ns ---
+    ein_rows = B * L * d
+    slab_rows = B * g["C"] * g["slab"] * d
+    neg_rows = g["NB"] * g["KP"] * d
+    # gathers once + scatter read-modify-write (~2x) for each touched row set
+    table_io = 3.0 * (ein_rows + slab_rows + neg_rows) * tb
+    if g["backend"] == "pallas":
+        # each row tensor crosses HBM exactly once in and once out
+        # (kernel outputs d_h/d_ctx/d_neg in f32)
+        inter = (ein_rows + slab_rows + neg_rows) * tb + (
+            B * g["C"] * g["S"] * d + slab_rows + neg_rows
+        ) * f32
+        copies = 0.0
+    else:
+        # XLA chain: row tensors re-read by the four band contractions, and
+        # the [B, C, S, S+2W] logit/grad planes round-trip between them
+        inter = 4.0 * (ein_rows + slab_rows) * g["compute_bytes"] + 4.0 * g[
+            "plane"
+        ] * f32
+        copies = (
+            0.0
+            if (config.slab_scatter or g["S"] == 0)
+            else 3.0 * slab_rows * f32
+        )
+    return {
+        "table_io": table_io,
+        "intermediates": inter,
+        "layout_copies": copies,
+        "total": table_io + inter + copies,
+    }

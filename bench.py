@@ -435,10 +435,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--hs-tail-slots", type=int, default=-1,
                     help="two-tier hs tail compaction bound "
                          "(config.hs_tail_slots)")
-    ap.add_argument("--band-backend", choices=["xla", "pallas"],
+    ap.add_argument("--band-backend", choices=["xla", "pallas", "pallas_oa"],
                     default="xla",
-                    help="band step compute: XLA chain or the fused Pallas "
-                    "kernel (ops/pallas_band.py; sg+ns fp32 unfused only)")
+                    help="band step compute: XLA chain, the fused Pallas "
+                    "kernel (ops/pallas_band.py), or the XLA chain with "
+                    "the Pallas overlap-add kernel replacing the "
+                    "layout-copy chain (pallas_oa, ops/pallas_overlap.py; "
+                    "composes with --fused/--table-dtype/--sr/--neg-scope)")
     ap.add_argument("--table-dtype", choices=["float32", "bfloat16"],
                     default="float32",
                     help="storage dtype of the [V, d] tables (A/B lever: "

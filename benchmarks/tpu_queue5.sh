@@ -30,10 +30,24 @@ OUT=benchmarks/TPU_R5
 
 B='python bench.py --probe-retries 1'
 TPU='"platform": "tpu"'
+# Forwarding-audit markers (r4 lesson: the first "pallas" artifact was
+# INVALID because bench.py's outer->inner re-exec dropped --band-backend and
+# silently measured the XLA path). These markers only bank a record whose
+# realized plan carries the requested backend — a forwarding regression
+# banks nothing and the item retries, instead of banking a mislabeled
+# number. JSON key order is stable (platform precedes plan in bench.py's
+# record), so one basic-regex grep covers both.
+OA='"platform": "tpu".*"band_backend": "pallas_oa"'
+PAL='"platform": "tpu".*"band_backend": "pallas"'
 
-# --- tier 1: the decisive six -------------------------------------------------
+# --- tier 1: the decisive six (+ the ISSUE-2 overlap-add kernel) -------------
 run_item default              900 "$TPU" $B
-run_item pallas               900 "$TPU" $B --band-backend pallas
+run_item pallas               900 "$PAL" $B --band-backend pallas
+# Pallas overlap-add (ops/pallas_overlap.py): deletes the 2.14 ms / 26.9%
+# layout-copy chain of the r2 step while keeping the sorted table scatter;
+# cost model predicts ~-27% step time vs the xla default at this shape
+# (PERF.md "Pallas slab-space overlap-add"). The A/B that decides the lever.
+run_item pallas_oa            900 "$OA" $B --band-backend pallas_oa
 run_item hs_dim200            900 "$TPU" $B --train-method hs --dim 200
 run_item hs_dim200_dense512   900 "$TPU" $B --train-method hs --dim 200 --hs-dense-top 512
 run_item cbow_dim100          900 "$TPU" $B --model cbow --dim 100
@@ -64,11 +78,19 @@ run_item l384                 900 "$TPU" $B --max-len 384
 run_item l512                 900 "$TPU" $B --max-len 512
 
 # --- tier 4: combos -----------------------------------------------------------
-run_item pallas_c96           900 "$TPU" $B --band-backend pallas --chunk-cap 96
-run_item pallas_b512          900 "$TPU" $B --band-backend pallas --batch-rows 512
-run_item pallas_bf16sr        900 "$TPU" $B --band-backend pallas --table-dtype bfloat16 --sr 1
-run_item pallas_negbatch      900 "$TPU" $B --band-backend pallas --neg-scope batch --kp 256
-run_item cbow_dim100_pallas   900 "$TPU" $B --model cbow --dim 100 --band-backend pallas
+# pallas_oa stacks (audited like the single): fused is the stack only this
+# backend can take (token-order context grads share the center side's
+# sorted index set; the fully-fused kernel and slab scatter cannot fuse
+# tables), the rest mirror the pallas combos for a like-for-like read.
+run_item pallas_oa_fused      900 "$OA" $B --band-backend pallas_oa --fused 1
+run_item pallas_oa_c96        900 "$OA" $B --band-backend pallas_oa --chunk-cap 96
+run_item pallas_oa_bf16sr     900 "$OA" $B --band-backend pallas_oa --table-dtype bfloat16 --sr 1
+run_item pallas_oa_negbatch   900 "$OA" $B --band-backend pallas_oa --neg-scope batch --kp 256
+run_item pallas_c96           900 "$PAL" $B --band-backend pallas --chunk-cap 96
+run_item pallas_b512          900 "$PAL" $B --band-backend pallas --batch-rows 512
+run_item pallas_bf16sr        900 "$PAL" $B --band-backend pallas --table-dtype bfloat16 --sr 1
+run_item pallas_negbatch      900 "$PAL" $B --band-backend pallas --neg-scope batch --kp 256
+run_item cbow_dim100_pallas   900 "$PAL" $B --model cbow --dim 100 --band-backend pallas
 run_item negbatch_b512        900 "$TPU" $B --neg-scope batch --kp 256 --batch-rows 512
 run_item bf16sr_negbatch      900 "$TPU" $B --table-dtype bfloat16 --sr 1 --neg-scope batch --kp 256
 run_item fused_kp32           900 "$TPU" $B --fused 1 --kp 32

@@ -99,6 +99,33 @@ def test_convert_men_style_roundtrips_through_ws353(vec_file, tmp_path):
     assert json.loads(r.stdout)["pairs_used"] == 3
 
 
+def test_convert_explicit_space_delimiter_collapses_runs(tmp_path):
+    """ADVICE r5 #3 regression: a MEN-style file padded with RUNS of spaces,
+    converted with an explicit `--delimiter ' '`, used to split into empty
+    fields and die with a misleading "non-numeric score". A whitespace
+    delimiter now collapses runs like the default sniff does."""
+    src = tmp_path / "men_padded.txt"
+    src.write_text("king   queen  45.0\nman woman   42.5\n")
+    dst = tmp_path / "out.csv"
+    r = _run(["convert", str(src), str(dst), "--delimiter", " "])
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["pairs_written"] == 2
+    assert dst.read_text() == "king,queen,45.0\nman,woman,42.5\n"
+
+
+def test_convert_explicit_nonspace_delimiter_keeps_empty_fields(tmp_path):
+    """The run-collapsing is whitespace-only: positional empty fields of a
+    non-whitespace delimiter must survive (a ,,-padded CSV would otherwise
+    silently shift its columns)."""
+    src = tmp_path / "padded.csv"
+    src.write_text("king,queen,,45.0\n")
+    dst = tmp_path / "out.csv"
+    r = _run(["convert", str(src), str(dst),
+              "--cols", "0,1,3", "--delimiter", ","])
+    assert r.returncode == 0, r.stderr
+    assert dst.read_text() == "king,queen,45.0\n"
+
+
 def test_convert_rejects_bad_rows(tmp_path):
     src = tmp_path / "bad.txt"
     src.write_text("w1,w2,3.0\nonly_two,cols\n")

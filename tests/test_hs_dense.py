@@ -386,3 +386,32 @@ def test_tables_build_wires_split():
     assert t.hs_tail_codes is not None
     assert 0.0 < t.hs_dense_coverage <= 1.0
     assert t.hs_tail_mean > 0.0
+
+
+def test_tail_overflow_warning_fires_without_logging():
+    """ADVICE r5 #2 regression: the per-step training loop observed
+    hs_tail_dropped only inside the log_every branch, so log_every=0 never
+    warned (despite the adjacent claim that it fires whether or not a log
+    sink is attached). The observation is now hoisted out of the log
+    cadence — an undersized compaction bound must warn with logging
+    disabled, on the per-step path, exactly like the chunked path."""
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.train import Trainer
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="hs", negative=0, word_dim=D, window=2,
+        min_count=1, subsample_threshold=0, iters=1, batch_rows=2,
+        max_sentence_len=16, hs_dense_top=4, hs_tail_slots=1,
+        chunk_steps=1,  # the per-step loop, where the regression lived
+    )
+    rng = np.random.default_rng(0)
+    sents = [
+        [f"w{j}" for j in rng.integers(0, V, size=12)] for _ in range(24)
+    ]
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(
+        vocab.encode_corpus(sents), cfg.max_sentence_len
+    )
+    tr = Trainer(cfg, vocab, corpus)
+    with pytest.warns(UserWarning, match="tail compaction dropped"):
+        tr.train(log_every=0)

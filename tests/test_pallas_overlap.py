@@ -1,0 +1,364 @@
+"""config.band_backend='pallas_oa' (ops/pallas_overlap.py): the Pallas
+overlap-add kernel must reproduce the XLA chain's context-gradient
+reduction and, composed into the band step, the whole step.
+
+Two layers of pinning:
+
+  * kernel-level — overlap_add_tokens vs banded._overlap_add on random
+    slab planes is BITWISE equal in f32 (both sum the same <= 2 slab slots
+    per token; two-operand float addition is order-free), across chunk
+    geometries incl. ragged tails and wide windows.
+  * step-level — the pallas_oa backend vs the XLA backend across the
+    support grid (sg/cbow x scatter_mean x neg-scope x clip x fused x
+    f32/bf16 +- SR). The backends share every op except the overlap-add
+    realization, so the tolerance class is test_pallas_band's or tighter.
+
+Runs through the Pallas interpreter on the CPU test backend; the Mosaic
+lowering tests run the real TPU pass via cross-platform AOT export
+(the test_pallas_band._export_for_tpu pattern).
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu import compat
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.negative import build_alias_table
+from word2vec_tpu.models.params import init_params
+from word2vec_tpu.ops import banded
+from word2vec_tpu.ops.band_step import fuse_tables, make_band_train_step
+from word2vec_tpu.ops.pallas_overlap import (
+    overlap_add_slabs, overlap_add_tokens,
+)
+from word2vec_tpu.ops.tables import DeviceTables
+
+V, D = 60, 16
+
+
+def _export_for_tpu(fn, *args):
+    """Cross-platform AOT export for platforms=["tpu"], or SKIP when this
+    host's jaxlib has no TPU lowering path at all (the
+    tests/test_pallas_band.py helper's classification, duplicated here
+    because test modules are not a package)."""
+    try:
+        return compat.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    except Exception as e:  # noqa: BLE001 — classified below
+        msg = str(e).lower()
+        environmental = (
+            "unknown backend" in msg
+            or "no tpu" in msg
+            or "tpu backend" in msg
+            or "unsupported platform" in msg
+            or "cannot lower" in msg and "tpu" in msg
+            or isinstance(e, NotImplementedError)
+        )
+        if environmental:
+            pytest.skip(f"no TPU lowering path on this host: {e}")
+        raise
+
+
+# ------------------------------------------------------------------ kernel
+@pytest.mark.parametrize("B,L,W,S,d", [
+    (3, 40, 3, 10, 16),    # ragged: C*S = 40 exactly
+    (2, 33, 5, 10, 4),     # ragged tail: C*S = 40 > L
+    (1, 25, 2, 4, 8),      # S = 2W, the tightest legal slab
+    (2, 192, 5, 118, 12),  # flagship chunk geometry
+    (1, 300, 10, 108, 8),  # wide window
+])
+def test_overlap_add_kernel_bitwise_matches_xla_chain(B, L, W, S, d):
+    C, _ = banded._geom(L, W, S)
+    rng = np.random.default_rng(B * 1000 + L)
+    y = jnp.asarray(rng.normal(size=(B, C, S + 2 * W, d)).astype(np.float32))
+    ref = banded._overlap_add(y, S, 2 * W)[:, W:W + L]
+    got = overlap_add_tokens(y, W=W, S=S, L=L, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_overlap_add_rejects_bad_slab_geometry():
+    y = jnp.zeros((1, 2, 16, 4), jnp.float32)
+    with pytest.raises(ValueError, match="slab width"):
+        overlap_add_slabs(y, W=3, S=12, interpret=True)  # 12+6 != 16
+    with pytest.raises(ValueError, match="slab decomposition"):
+        overlap_add_slabs(
+            jnp.zeros((1, 2, 11, 4), jnp.float32), W=4, S=3, interpret=True
+        )  # S < 2W: a slab would overlap beyond its immediate neighbor
+
+
+# ------------------------------------------------------------- band step
+def _tables():
+    counts = np.arange(2 * V, V, -1).astype(np.float64)
+    at = build_alias_table(counts**0.75 / np.sum(counts**0.75))
+    return DeviceTables(
+        jnp.ones(V, jnp.float32),
+        jnp.asarray(at.accept),
+        jnp.asarray(at.alias),
+        None,
+        None,
+        None,
+    )
+
+
+def _cfg(**kw):
+    base = dict(
+        model="sg", train_method="ns", negative=3, word_dim=D,
+        window=3, min_count=1, subsample_threshold=0,
+        compute_dtype="float32", shared_negatives=8,
+        max_sentence_len=40, band_chunk=10,
+    )
+    base.update(kw)
+    return Word2VecConfig(**base)
+
+
+def _tokens():
+    rng = np.random.default_rng(4)
+    tokens = jnp.asarray(rng.integers(0, V, size=(6, 40)).astype(np.int32))
+    # padding exercises the invalid-slot masking on both paths
+    return tokens.at[2, 30:].set(-1)
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+@pytest.mark.parametrize("scope", ["row", "batch"])
+@pytest.mark.parametrize("scatter_mean", [False, True])
+def test_pallas_oa_step_matches_xla(scatter_mean, scope, model):
+    """Both backends share every op except the overlap-add realization,
+    which sums the identical <= 2 slab terms per token — the trajectories
+    must match bitwise in f32 compute."""
+    tokens, key, alpha = _tokens(), jax.random.key(9), jnp.float32(0.03)
+    cfg = _cfg(model=model, negative_scope=scope, scatter_mean=scatter_mean)
+    params = init_params(cfg, V, jax.random.key(1))
+    pa, ma = jax.jit(make_band_train_step(cfg, _tables()))(
+        dict(params), tokens, key, alpha
+    )
+    cfg_b = dataclasses.replace(cfg, band_backend="pallas_oa")
+    pb, mb = jax.jit(make_band_train_step(cfg_b, _tables()))(
+        dict(params), tokens, key, alpha
+    )
+    for k in pa:
+        np.testing.assert_array_equal(
+            np.asarray(pa[k]), np.asarray(pb[k]), err_msg=k
+        )
+    assert float(ma["loss_sum"]) == float(mb["loss_sum"])
+    assert float(ma["pairs"]) == float(mb["pairs"])
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_pallas_oa_with_row_clip_matches_xla(model):
+    tokens, key, alpha = _tokens(), jax.random.key(9), jnp.float32(0.03)
+    cfg = _cfg(model=model, scatter_mean=True, clip_row_update=0.5)
+    params = init_params(cfg, V, jax.random.key(1))
+    pa, ma = jax.jit(make_band_train_step(cfg, _tables()))(
+        dict(params), tokens, key, alpha
+    )
+    cfg_b = dataclasses.replace(cfg, band_backend="pallas_oa")
+    pb, mb = jax.jit(make_band_train_step(cfg_b, _tables()))(
+        dict(params), tokens, key, alpha
+    )
+    for k in pa:
+        np.testing.assert_array_equal(
+            np.asarray(pa[k]), np.asarray(pb[k]), err_msg=k
+        )
+    assert float(ma["clip_engaged"]) == float(mb["clip_engaged"])
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_pallas_oa_matches_xla_bf16_compute(model):
+    """Default compute dtype (bf16 operands, f32 accumulation): the slab
+    contraction is shared; only the reduction realization differs, so the
+    match stays exact (same tolerance rationale as the f32 grid)."""
+    tokens, key, alpha = _tokens(), jax.random.key(9), jnp.float32(0.03)
+    cfg = _cfg(model=model, compute_dtype="bfloat16", scatter_mean=True)
+    params = init_params(cfg, V, jax.random.key(1))
+    pa, _ = jax.jit(make_band_train_step(cfg, _tables()))(
+        dict(params), tokens, key, alpha
+    )
+    cfg_b = dataclasses.replace(cfg, band_backend="pallas_oa")
+    pb, _ = jax.jit(make_band_train_step(cfg_b, _tables()))(
+        dict(params), tokens, key, alpha
+    )
+    for k in pa:
+        np.testing.assert_allclose(
+            np.asarray(pa[k]), np.asarray(pb[k]), rtol=2e-5, atol=2e-6,
+            err_msg=k,
+        )
+
+
+@pytest.mark.parametrize("sr", [False, True])
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_pallas_oa_bf16_tables_match_xla(model, sr):
+    """bf16 table storage +- destination-grid stochastic rounding: the
+    pallas_oa tail IS the XLA tail (same value orderings, same SR stream
+    indices), so given the same key the match is exact — unlike the fused
+    pallas backend, whose reassociated deltas can flip threshold SR draws
+    (test_pallas_band's one-ulp tolerance)."""
+    tokens, key, alpha = _tokens(), jax.random.key(9), jnp.float32(0.03)
+    cfg = _cfg(
+        model=model, scatter_mean=True, dtype="bfloat16",
+        stochastic_rounding=sr,
+    )
+    params = init_params(cfg, V, jax.random.key(1))
+    pa, _ = jax.jit(make_band_train_step(cfg, _tables()))(
+        dict(params), tokens, key, alpha
+    )
+    cfg_b = dataclasses.replace(cfg, band_backend="pallas_oa")
+    pb, _ = jax.jit(make_band_train_step(cfg_b, _tables()))(
+        dict(params), tokens, key, alpha
+    )
+    for k in pa:
+        np.testing.assert_array_equal(
+            np.asarray(pa[k]), np.asarray(pb[k]), err_msg=k
+        )
+
+
+def test_pallas_oa_composes_with_fused_tables():
+    """The composition the slab-scatter paths cannot take: token-order
+    context grads share the center side's sorted index set, so the fused
+    [V, 2, d] single-scatter tail works unchanged under pallas_oa."""
+    tokens, key, alpha = _tokens(), jax.random.key(9), jnp.float32(0.03)
+    cfg = _cfg(fused_tables=True, band_backend="pallas_oa")
+    params = fuse_tables(dict(init_params(cfg, V, jax.random.key(1))))
+    pa, _ = jax.jit(make_band_train_step(cfg, _tables(), fused=True))(
+        dict(params), tokens, key, alpha
+    )
+    cfg_x = dataclasses.replace(cfg, band_backend="xla")
+    pb, _ = jax.jit(make_band_train_step(cfg_x, _tables(), fused=True))(
+        dict(params), tokens, key, alpha
+    )
+    for k in pa:
+        np.testing.assert_array_equal(
+            np.asarray(pa[k]), np.asarray(pb[k]), err_msg=k
+        )
+
+
+# ------------------------------------------------------------ Mosaic pass
+@pytest.mark.parametrize("W,S,d", [(5, 118, 300), (10, 108, 300)])
+def test_oa_kernel_lowers_to_mosaic(W, S, d):
+    """Cross-platform AOT export runs the REAL Mosaic TPU pass on the CPU
+    host (the test_pallas_band pattern), at the flagship and wide-window
+    chunk geometries, so compiler incompatibilities surface in CI instead
+    of burning a tunnel window."""
+    fn = functools.partial(overlap_add_slabs, W=W, S=S, interpret=False)
+    exp = _export_for_tpu(
+        lambda y: fn(y), jnp.zeros((2, 2, S + 2 * W, d), jnp.float32)
+    )
+    assert len(exp.mlir_module_serialized) > 0
+
+
+def test_full_chunk_runner_lowers_to_mosaic_with_pallas_oa():
+    """The whole bench-path program with band_backend='pallas_oa' — resident
+    batch assembly, the step inside lax.scan, sorted scatters — must lower
+    for TPU, not just the kernel in isolation."""
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.ops import resident as res
+
+    Vv, d = 1000, 300
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=5, word_dim=d,
+        window=5, min_count=1, subsample_threshold=1e-4,
+        batch_rows=64, max_sentence_len=192,
+        band_backend="pallas_oa", chunk_steps=4,
+    )
+    t = _tables()
+    t = dataclasses.replace(t, keep_probs=jnp.ones(Vv, jnp.float32))
+    rng = np.random.default_rng(0)
+    corpus = PackedCorpus.from_flat(
+        rng.integers(0, Vv, size=60_000).astype(np.int32),
+        cfg.max_sentence_len,
+    )
+    params = init_params(cfg, Vv, jax.random.key(0))
+    fn = res.make_resident_chunk_runner(cfg, t)
+    corpus_dev = {
+        k: jnp.asarray(v) for k, v in res.corpus_arrays(corpus).items()
+    }
+    order = jnp.arange(corpus.num_rows, dtype=jnp.int32)
+    alphas = jnp.full((4,), 0.025, jnp.float32)
+    exp = _export_for_tpu(
+        fn, params, corpus_dev, order, jax.random.key(7), 0, 9999, alphas
+    )
+    assert len(exp.mlir_module_serialized) > 0
+
+
+# ------------------------------------------------------------- rejections
+def test_pallas_oa_requires_chunked_representation():
+    # L=12 with band_chunk=0 resolves dense — there is no overlap-add to
+    # replace, and a silently-dense run would bank a mislabeled A/B
+    cfg = _cfg(max_sentence_len=12, band_chunk=0, band_backend="pallas_oa")
+    step = make_band_train_step(cfg, _tables())
+    with pytest.raises(ValueError, match="chunked band"):
+        step(
+            dict(init_params(cfg, V, jax.random.key(1))),
+            jnp.zeros((2, 12), jnp.int32), jax.random.key(0),
+            jnp.float32(0.03),
+        )
+
+
+def test_pallas_oa_config_rejections():
+    with pytest.raises(ValueError, match="ns band"):
+        Word2VecConfig(
+            train_method="hs", negative=0, min_count=1,
+            band_backend="pallas_oa",
+        )
+    with pytest.raises(ValueError, match="ns band"):
+        Word2VecConfig(
+            negative=3, min_count=1, kernel="pair", band_backend="pallas_oa",
+        )
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Word2VecConfig(
+            negative=3, min_count=1, slab_scatter=True,
+            band_backend="pallas_oa",
+        )
+
+
+def test_pallas_oa_rejects_mesh_axes():
+    cfg = _cfg(band_backend="pallas_oa")
+    for axes in (
+        {"tp_axis": "model"}, {"sp_axis": "seq"}, {"dp_axis": "data"},
+    ):
+        with pytest.raises(ValueError, match="unsupported here"):
+            make_band_train_step(cfg, _tables(), **axes)
+
+
+def test_pallas_oa_rejected_by_sharded_factories():
+    """shard_map cannot host pallas_call (parallel/trainer._reject_pallas):
+    the sharded step factories must fail up front for pallas_oa exactly as
+    they do for the fused pallas backend."""
+    from word2vec_tpu.parallel.mesh import make_mesh
+    from word2vec_tpu.parallel.trainer import (
+        make_sharded_chunk, make_sharded_step,
+    )
+
+    cfg = _cfg(band_backend="pallas_oa")
+    t = _tables()
+    for factory in (make_sharded_step, make_sharded_chunk):
+        with pytest.raises(ValueError, match="single-chip"):
+            factory(cfg, t, make_mesh(1, 1))
+
+
+# ---------------------------------------------------------------- trainer
+def test_trainer_end_to_end_with_pallas_oa():
+    """--band-backend pallas_oa reachable end-to-end: a short training run
+    through the chunked Trainer path produces finite tables and a report."""
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.data.vocab import Vocab
+    from word2vec_tpu.train import Trainer
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=D, window=2,
+        min_count=1, subsample_threshold=0, iters=1, batch_rows=4,
+        max_sentence_len=24, band_chunk=8, chunk_steps=0,
+        band_backend="pallas_oa",
+    )
+    rng = np.random.default_rng(3)
+    sents = [[f"w{j}" for j in rng.integers(0, 30, size=20)] for _ in range(80)]
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    tr = Trainer(cfg, vocab, corpus)
+    state, report = tr.train(log_every=0)
+    assert report.total_words == corpus.num_tokens
+    for k, v in state.params.items():
+        assert np.all(np.isfinite(np.asarray(v))), k

@@ -209,6 +209,59 @@ def test_sequence_parallel_conserves_the_single_chip_update():
     )
 
 
+@pytest.mark.parametrize("mode", ["mean", "delta"])
+def test_sp_sync_applies_mean_of_shard_deltas(mode):
+    """Post-sync sp semantics, pinned (ADVICE r5 #1): the conservation test
+    above covers PRE-sync deltas (their sum equals single-chip); this one
+    covers what the trainer actually APPLIES. Both sync modes pmean over
+    the replica axes, so the reconciled update is 1/sp of the single-chip
+    sum — Hogwild-analog averaging, an effective learning-rate scale, NOT
+    single-chip equivalence (the ops/train_step.py sp_axis docstring
+    documents exactly this). If sync ever switches to summing sp deltas,
+    this test is the one to flip."""
+    from word2vec_tpu.parallel.trainer import make_delta_sync
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=2, word_dim=D, window=1,
+        min_count=1, subsample_threshold=0.0, compute_dtype="float32",
+        shared_negatives=4, max_sentence_len=24,
+    )
+    tables = _degenerate_tables()
+    rng = np.random.default_rng(8)
+    tokens = rng.integers(1, V, size=(4, 24)).astype(np.int32)
+    params = init_params(cfg, V, jax.random.key(7))
+    key = jax.random.key(42)
+    alpha = jnp.float32(ALPHA)
+
+    single = jax.jit(make_train_step(cfg, tables))
+    ref_new, _ = single(params, jnp.asarray(tokens), key, alpha)
+
+    sp = 2
+    mesh = make_mesh(dp=1, tp=1, sp=sp)
+    sharded = make_sharded_step(cfg, tables, mesh)
+    repl = replicate_params(params, mesh)
+    out, _ = sharded(repl, jnp.asarray(tokens), key, alpha)
+    if mode == "mean":
+        synced = make_sync(mesh)(out)
+    else:
+        base = replicate_params(params, mesh)
+        synced = make_delta_sync(mesh)(out, base)
+
+    for k in params:
+        ref_delta = np.asarray(ref_new[k]) - np.asarray(params[k])
+        applied = np.asarray(synced[k][0]) - np.asarray(params[k])
+        # replicas agree after sync...
+        np.testing.assert_allclose(
+            np.asarray(synced[k][0]), np.asarray(synced[k][1]), atol=1e-6
+        )
+        # ...and the applied update is exactly 1/sp of the single-chip sum
+        # (delta mode: to bf16-of-the-delta precision, the wire dtype)
+        tol = 1e-4 if mode == "mean" else 2e-2
+        np.testing.assert_allclose(
+            applied, ref_delta / sp, atol=tol, err_msg=k
+        )
+
+
 def test_seq_parallel_trainer_end_to_end_all_axes():
     """dp=2 x sp=2 x tp=2 — all 8 virtual devices, full trainer loop."""
     cfg = Word2VecConfig(

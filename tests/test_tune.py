@@ -105,6 +105,44 @@ def test_pallas_moves_fewer_bytes_than_xla_band():
     assert pal["intermediates"] < xla["intermediates"]
 
 
+def test_pallas_oa_drops_exactly_the_copy_term():
+    """band_backend='pallas_oa' keeps the XLA chain's traffic accounting but
+    replaces the overlap-add: the copy term must vanish while the rest of
+    the bytes stay within the kernel's own 2x-slab streaming delta."""
+    xla = step_hbm_bytes(_cfg(), 71000)
+    oa = step_hbm_bytes(_cfg(band_backend="pallas_oa"), 71000)
+    assert oa["layout_copies"] == 0.0
+    assert xla["layout_copies"] > 0.0
+    assert oa["table_io"] == xla["table_io"]
+    # the kernel streams the slab grad plane in + token plane out once;
+    # that costs ~2/3 of the copy BYTES it deletes (the win is the ~7x
+    # strided-copy inefficiency, not the raw bytes)
+    assert oa["intermediates"] - xla["intermediates"] == pytest.approx(
+        2.0 / 3.0 * xla["layout_copies"]
+    )
+
+
+def test_planner_ranks_pallas_oa_above_xla_iff_copy_term_dominates():
+    """The ordering the planner's pruning relies on (ISSUE 2): pallas_oa
+    beats xla exactly because the strided layout copies cost ~7x their raw
+    bytes. With the measured inefficiency, pallas_oa must rank higher at
+    the traced flagship shape; with the inefficiency counterfactually at
+    parity with streaming (copies no longer dominant), the ordering must
+    flip — the model may not hardcode a pallas_oa preference."""
+    xla_cfg, oa_cfg = _cfg(), _cfg(band_backend="pallas_oa")
+    xla_wps = cost_model.predicted_words_per_sec(xla_cfg, 71000, *V5E)
+    oa_wps = cost_model.predicted_words_per_sec(oa_cfg, 71000, *V5E)
+    assert oa_wps > xla_wps
+    orig = cost_model.LAYOUT_COPY_INEFFICIENCY
+    try:
+        cost_model.LAYOUT_COPY_INEFFICIENCY = 0.1  # copies ~free
+        xla_cheap = cost_model.predicted_words_per_sec(xla_cfg, 71000, *V5E)
+        oa_cheap = cost_model.predicted_words_per_sec(oa_cfg, 71000, *V5E)
+        assert xla_cheap >= oa_cheap
+    finally:
+        cost_model.LAYOUT_COPY_INEFFICIENCY = orig
+
+
 def test_dispatch_overhead_amortizes_with_chunk_cap():
     a = cost_model.predict(_cfg(chunk_cap=1), 71000, *V5E)
     b = cost_model.predict(_cfg(chunk_cap=96), 71000, *V5E)
@@ -158,6 +196,23 @@ def test_plan_cache_corrupt_file_reads_as_empty(tmp_path):
         assert json.load(f)["plans"]["k"]
 
 
+def test_plan_cache_round_trips_the_backend_field(tmp_path):
+    """A pallas_oa plan must survive the store -> lookup -> from_json round
+    trip with its backend intact — a cache that dropped the field would
+    silently re-run the XLA chain under a pallas_oa label."""
+    path = str(tmp_path / "plans.json")
+    cfg = _cfg(band_backend="pallas_oa")
+    key = plan_cache.plan_key("TPU v5 lite", "tpu", kernel_route(cfg), 71000, 300)
+    fp = config_fingerprint(cfg)
+    plan = TunePlan(band_backend="pallas_oa", band_chunk=96, chunk_cap=96)
+    plan_cache.store(key, {"plan": plan.to_json(), "fingerprint": fp}, path)
+    got = TunePlan.from_json(plan_cache.lookup(key, fp, path)["plan"])
+    assert got == plan
+    assert got.band_backend == "pallas_oa"
+    applied = cfg.apply_plan(got)
+    assert applied.band_backend == "pallas_oa"
+
+
 def test_vocab_size_bucketing_makes_near_vocabs_share_plans():
     k1 = plan_cache.plan_key("TPU v5 lite", "tpu", "band-ns", 71290, 300)
     k2 = plan_cache.plan_key("TPU v5 lite", "tpu", "band-ns", 71000, 300)
@@ -203,6 +258,36 @@ def test_candidate_grid_contains_base_and_only_valid_plans():
     for plan in grid:
         cfg.apply_plan(plan)  # must not raise
         assert plan.band_backend == "xla"  # no pallas candidates off-TPU
+
+
+def test_candidate_grid_offers_pallas_oa_on_tpu():
+    """The planner must be able to DISCOVER the overlap-add kernel
+    (ISSUE 2): on a TPU platform the band-ns grid carries pallas_oa
+    candidates (chunked shapes only — the kernel has no dense path), and
+    they survive for fused_tables configs where the fully-fused pallas
+    kernel is excluded."""
+    from word2vec_tpu.ops.banded import resolve_chunk
+
+    cfg = _cfg(chunk_steps=0)
+    grid = candidate_grid(cfg, 71000, {"platform": "tpu"})
+    backends = {p.band_backend for p in grid}
+    assert {"xla", "pallas", "pallas_oa"} <= backends
+    for plan in grid:
+        if plan.band_backend in ("pallas", "pallas_oa"):
+            applied = cfg.apply_plan(plan)
+            assert resolve_chunk(
+                applied.max_sentence_len, applied.window, applied.band_chunk
+            ) > 0, plan
+
+    fused = candidate_grid(_cfg(fused_tables=True), 71000, {"platform": "tpu"})
+    fb = {p.band_backend for p in fused}
+    assert "pallas" not in fb  # fused tables: no fused-kernel candidates
+    assert "pallas_oa" in fb   # ...but the OA kernel composes
+
+    sharded = candidate_grid(
+        cfg, 71000, {"platform": "tpu", "allow_pallas": False}
+    )
+    assert {p.band_backend for p in sharded} == {"xla"}
 
 
 def test_candidate_grid_respects_hot_row_block_guard():

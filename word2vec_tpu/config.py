@@ -137,11 +137,24 @@ class Word2VecConfig:
     # contractions cost L*(S+2W) instead of L^2. 0 = auto (dense for short
     # rows, 128-lane slabs for long); explicit S must be >= 2*window.
     band_chunk: int = 0
-    # Band-step compute backend: "xla" (ops/band_step.py chain of band
-    # matmuls; every route/axis/dtype) or "pallas" (ops/pallas_band.py —
-    # one fused VMEM-resident kernel per (row, chunk); sg/cbow + ns,
-    # f32/bf16 tables ± SR, unfused, single-chip only; A/B perf lever
-    # for the on-chip sweep).
+    # Band-step compute backend:
+    #   "xla"       — ops/band_step.py chain of band matmuls; every
+    #                 route/axis/dtype.
+    #   "pallas"    — ops/pallas_band.py: one fused VMEM-resident kernel per
+    #                 (row, chunk); sg/cbow + ns, f32/bf16 tables ± SR,
+    #                 unfused, single-chip only; context grads exit in slab
+    #                 space through the sorted slab scatter.
+    #   "pallas_oa" — the XLA compute chain with the context-gradient
+    #                 overlap-add done by a Pallas kernel
+    #                 (ops/pallas_overlap.py) instead of the pad/add/slice
+    #                 chain whose layout copies cost 26.9% of the r2 band
+    #                 step (PERF.md). Emits per-token deltas, so the table
+    #                 scatter keeps its shared sorted-indices fast path (no
+    #                 second argsort, unlike slab_scatter v2); composes with
+    #                 fused_tables / bf16 ± SR / both negative scopes;
+    #                 chunked representation + single-chip only.
+    # All three are A/B perf levers for the on-chip sweep and candidates in
+    # the autotuned planner's TPU grid (tune/planner.py).
     band_backend: str = "xla"
 
     # Two-tier hierarchical-softmax update (ops/hs_step.py, data/huffman.py
@@ -308,12 +321,12 @@ class Word2VecConfig:
             raise ValueError(f"kernel must be auto|band|pair, got {self.kernel!r}")
         if self.shared_negatives < 1:
             raise ValueError("shared_negatives must be >= 1")
-        if self.band_backend not in ("xla", "pallas"):
+        if self.band_backend not in ("xla", "pallas", "pallas_oa"):
             raise ValueError(
-                f"band_backend must be 'xla' or 'pallas', "
+                f"band_backend must be 'xla', 'pallas' or 'pallas_oa', "
                 f"got {self.band_backend!r}"
             )
-        if self.band_backend == "pallas" and (
+        if self.band_backend != "xla" and (
             self.train_method == "hs" or self.kernel == "pair"
         ):
             # reject here, not just in make_band_train_step: the kernel
@@ -321,8 +334,17 @@ class Word2VecConfig:
             # A/B must not bank a measurement labeled pallas that actually
             # ran another kernel
             raise ValueError(
-                "band_backend='pallas' applies to the ns band kernel only "
-                "(hs and kernel='pair' route elsewhere; ops/pallas_band.py)"
+                f"band_backend={self.band_backend!r} applies to the ns band "
+                "kernel only (hs and kernel='pair' route elsewhere; "
+                "ops/pallas_band.py, ops/pallas_overlap.py)"
+            )
+        if self.band_backend == "pallas_oa" and self.slab_scatter:
+            # both delete the same overlap-add by different mechanisms; a
+            # combined flag would silently measure only one of them
+            raise ValueError(
+                "band_backend='pallas_oa' and slab_scatter are mutually "
+                "exclusive (the Pallas kernel replaces the overlap-add the "
+                "slab scatter would have skipped; ops/pallas_overlap.py)"
             )
         if self.negative_scope not in ("row", "batch"):
             raise ValueError(

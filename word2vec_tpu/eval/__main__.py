@@ -82,7 +82,8 @@ def main(argv=None) -> int:
                    "0,1,3)")
     p.add_argument("--delimiter", default=None,
                    help="explicit field delimiter (default: sniff , tab "
-                   "then whitespace)")
+                   "then whitespace); a whitespace delimiter treats runs "
+                   "of it as one separator, like the sniff")
     p.add_argument("--keep-case", action="store_true",
                    help="do not lowercase words")
 

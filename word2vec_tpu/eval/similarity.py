@@ -58,8 +58,15 @@ def _split_pair_line(
 ) -> List[str]:
     """The one delimiter sniff shared by the reader and the converter:
     comma, then tab, then whitespace — first split yielding min_cols
-    fields wins. An explicit delimiter skips the sniff."""
+    fields wins. An explicit delimiter skips the sniff; when that
+    delimiter is whitespace, consecutive separators count as ONE (the
+    split(None) convention), so a MEN-style file padded with runs of
+    spaces keeps its columns aligned instead of dying on an empty-string
+    "non-numeric score" (ADVICE r5 #3 — `--delimiter ' '` previously
+    produced ['w1', '', 'w2', ...])."""
     if delimiter is not None:
+        if delimiter.isspace():
+            return [p for p in line.split(delimiter) if p != ""]
         return line.split(delimiter)
     for sep in (",", "\t", None):
         parts = line.split(sep)

@@ -67,7 +67,10 @@ context) pair trained exactly once across the mesh: the i->j direction on
 i's owner, j->i on j's owner. Updates land in the shard-local replica and
 are reconciled by the same periodic averaging as the data axis
 (parallel/trainer.py) — sequence parallelism here is data parallelism over
-position slices plus the halo exchange that plain slicing would miss.
+position slices plus the halo exchange that plain slicing would miss. The
+pmean over dp+sp therefore applies 1/sp of the summed sp-shard delta per
+sync (Hogwild-analog averaging, NOT single-chip equivalence — see the
+sp_axis note in ops/train_step.make_pair_train_step and ADVICE r5 #1).
 """
 
 from __future__ import annotations
@@ -169,12 +172,16 @@ def make_band_train_step(
             "(slab_scatter uses a different index set per table)"
         )
     pallas = config.band_backend == "pallas"
-    if pallas:
+    pallas_oa = config.band_backend == "pallas_oa"
+    if pallas or pallas_oa:
         # Hard errors, not silent fallbacks: a bench A/B that silently ran
         # the XLA chain would bank a mislabeled measurement.
         unsupported = [
             why for cond, why in [
-                (fused, "fused_tables"),
+                # fused_tables composes with pallas_oa (its context grads
+                # come back in token order, same index set as the center
+                # side) but not with the fully-fused kernel's slab scatter
+                (fused and pallas, "fused_tables"),
                 (tp_axis is not None, "tensor parallelism"),
                 (sp_axis is not None, "sequence parallelism"),
                 # defense in depth: sharded trainers already reject pallas
@@ -189,8 +196,9 @@ def make_band_train_step(
         ]
         if unsupported:
             raise ValueError(
-                "band_backend='pallas' covers the sg/cbow ns unfused "
-                "single-chip step (ops/pallas_band.py); unsupported here: "
+                f"band_backend={config.band_backend!r} covers the sg/cbow "
+                "ns single-chip step (ops/pallas_band.py, "
+                "ops/pallas_overlap.py); unsupported here: "
                 + ", ".join(unsupported)
             )
     W = config.window
@@ -204,6 +212,14 @@ def make_band_train_step(
     slab_scatter = config.slab_scatter
     sr = config.stochastic_rounding
     cdt = jnp.dtype(config.compute_dtype)
+
+    if pallas_oa:
+        from . import pallas_overlap
+
+        # interpret=True routes the kernel through the Pallas interpreter on
+        # non-TPU backends (CPU tests / smoke); the same code compiles to
+        # Mosaic on chip — the same gate as the fused kernel below
+        oa_interpret = jax.devices()[0].platform != "tpu"
 
     def psum(x):
         return jax.lax.psum(x, tp_axis) if tp_axis is not None else x
@@ -241,6 +257,29 @@ def make_band_train_step(
         # short rows, [B, C, S, S+2W] slabs for long — positive-side cost
         # scales with L*(S+2W), not L^2 (VERDICT r1 item 3).
         S = banded.resolve_chunk(L, W, config.band_chunk)
+        if pallas_oa and S == 0:
+            raise ValueError(
+                f"band_backend='pallas_oa' needs the chunked band "
+                f"representation (rows of length {L} resolved to the dense "
+                f"path, which has no overlap-add to replace). Set "
+                f"band_chunk to 2*window <= S < {L}, or use the XLA "
+                f"backend for short rows"
+            )
+
+        def ctx_fan(scores, u):
+            # band_vs — the context-side fan-out — with the overlap-add
+            # reduced by the Pallas kernel on the pallas_oa backend, so the
+            # XLA pad/add/slice chain and the layout copies around it
+            # (2.14 ms = 26.9% of the r2 step, PERF.md) never materialize;
+            # output is per-token order, so the sorted table scatter below
+            # reuses the shared argsort unchanged
+            if pallas_oa:
+                return pallas_overlap.overlap_add_tokens(
+                    banded.band_vs_slab(scores, u, W, S, cdt),
+                    W=W, S=S, L=L, interpret=oa_interpret,
+                )
+            return banded.band_vs(scores, u, W, S, cdt)
+
         band_f = banded.band_mask(keep, valid, w_eff, W, S).astype(jnp.float32)
         n_ctx = banded.band_row_sum(band_f, L)  # [B, L] contexts per center
         # context-side gradients can stay in slab space and let the scatter
@@ -329,7 +368,7 @@ def make_band_train_step(
                 ctx_w_slab = banded.band_col_sum_slab(band_f)
                 d_out_pos = out_weight = None
             else:
-                d_out_pos = banded.band_vs(gp, ein, W, S, cdt)
+                d_out_pos = ctx_fan(gp, ein)
                 out_weight = banded.band_col_sum(band_f, L, W, S)
             d_in_pos = d_h  # accumulated on the center row (W.row += grad, :351)
             pos_loss = -banded.band_loss_sum(band_f * jax.nn.log_sigmoid(plog))
@@ -361,7 +400,7 @@ def make_band_train_step(
                 ctx_w_slab = banded.band_col_sum_slab(band_f)
                 d_in_pos = in_weight = None
             else:
-                d_in_pos = banded.band_vs(band_f, d_h, W, S, cdt)
+                d_in_pos = ctx_fan(band_f, d_h)
                 in_weight = banded.band_col_sum(band_f, L, W, S)
             pos_loss = -jnp.sum(active * jax.nn.log_sigmoid(plog))
             pos_pairs = jnp.sum(active)

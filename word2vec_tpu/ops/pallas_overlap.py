@@ -1,0 +1,130 @@
+"""Pallas TPU overlap-add kernel: slab-space context gradients -> token order.
+
+The XLA band chain realizes the context-gradient overlap-add
+(ops/banded._overlap_add: the transpose of the slab extraction) as a
+pad/reshape/add/slice composition over [B, C, S+2W, d]. XLA's layout
+assignment inserts {0,2,1}<->{2,1,0} copies around that chain, and the r2
+on-chip trace measured them as the LARGEST single component of the band step
+— 2.14 ms of 7.97 ms, 26.9% (PERF.md "Step-time composition"), ~7x what the
+raw bytes would cost at streaming bandwidth. The one attack tried before
+this kernel, config.slab_scatter, deleted the copies by scattering from
+slab space and LOST on chip (2.26M vs 3.64M words/sec): it traded the
+copies for a scatter off the sorted-indices fast path, and v2's repair (a
+second argsort over 1.33x the token count) pays the sort instead.
+
+This kernel takes the third path PERF.md names ("accepting them or a Pallas
+overlap-add"): perform the windowed overlap-add reduction itself, in VMEM,
+one (batch row, band chunk) tile per grid step, and emit the context deltas
+directly in TOKEN order — the order the sorted table scatter already has an
+argsort for. The layout-copy chain never materializes in HBM, the scatter
+keeps its sorted-indices fast path, and no extra sort is paid.
+
+The reduction (chunk-coordinate invariant of ops/banded.py): slab slot k of
+chunk c holds padded position p = c*S + k, token i sits at p = i + W, so
+token block c (rows i in [c*S, c*S + S)) receives
+
+    out[b, c, s] =            y[b, c,   s + W]            (own chunk)
+                 + (s <  W) * y[b, c-1, s + W + S]        (left neighbor)
+                 + (s >= S-W) * y[b, c+1, s + W - S]      (right neighbor)
+
+Because the slab decomposition guarantees S >= 2W (ops/banded.resolve_chunk)
+the two neighbor terms are disjoint: every token row sums exactly the <= 2
+slab slots that alias its padded position — the same pairs _overlap_add
+sums, so the result is bitwise identical in f32 (two-operand float addition
+is commutative). Pinned against the XLA chain by tests/test_pallas_overlap.py.
+
+The neighbor blocks arrive as two extra views of the SAME input array with
+shifted (clamped) block index maps; boundary chunks zero their missing
+neighbor by a program_id gate. Per grid step the working set is three
+[S+2W, d] blocks plus one [S, d] output — a few hundred KB at the flagship
+shape, far inside VMEM.
+
+Scope: any consumer of slab-space [B, C, S+2W, d] f32 gradients. Wired as
+config.band_backend='pallas_oa' (ops/band_step.py): the XLA band compute
+path with this kernel replacing the _overlap_add chain — which keeps every
+tail feature of the XLA step (fused_tables, bf16 tables +- stochastic
+rounding, scatter_mean, clip, both negative scopes) available, unlike the
+fully-fused 'pallas' backend. Single-chip only, same as every Pallas path
+here (shard_map cannot host pallas_call — parallel/trainer._reject_pallas).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _oa_kernel(y_ref, yl_ref, yr_ref, out_ref, *, W: int, S: int, C: int):
+    """One (batch row, chunk) tile of the token-space overlap-add.
+
+    y_ref/yl_ref/yr_ref are three views of the same [B, C, S+2W, d] array:
+    this chunk, its left neighbor, its right neighbor (block indices clamped
+    at the edges; the gates below zero the out-of-range terms).
+    """
+    c = pl.program_id(1)
+    body = y_ref[0, 0, W:S + W, :]            # own slots [W, S+W) -> rows 0..S
+    lsl = yl_ref[0, 0, S + W:, :]             # left slots [S+W, S+2W) -> rows [0, W)
+    rsl = yr_ref[0, 0, :W, :]                 # right slots [0, W) -> rows [S-W, S)
+    d = body.shape[1]
+    zeros = jnp.zeros((S - W, d), body.dtype)
+    lpart = jnp.concatenate([lsl, zeros], axis=0)
+    rpart = jnp.concatenate([zeros, rsl], axis=0)
+    lgate = jnp.where(c > 0, 1.0, 0.0).astype(body.dtype)
+    rgate = jnp.where(c < C - 1, 1.0, 0.0).astype(body.dtype)
+    out_ref[0, 0] = body + lgate * lpart + rgate * rpart
+
+
+@functools.partial(jax.jit, static_argnames=("W", "S", "interpret"))
+def overlap_add_slabs(
+    y: jnp.ndarray, *, W: int, S: int, interpret: bool = False
+) -> jnp.ndarray:
+    """[B, C, S+2W, d] slab-space values -> [B, C*S, d] token-space sums.
+
+    Token row i = c*S + s of the output is the overlap-add of every slab
+    slot aliasing padded position i + W (module docstring); rows past the
+    caller's L (the C*S padding tail) carry the reduction of padding slots
+    and must be sliced off (overlap_add_tokens does).
+    """
+    B, C, SK, d = y.shape
+    if SK != S + 2 * W:
+        raise ValueError(f"slab width {SK} != S + 2W = {S + 2 * W}")
+    if S < 2 * W:
+        # a slab would overlap beyond its immediate neighbors and the
+        # two-term reduction above would drop contributions
+        raise ValueError(f"S={S} < 2W={2 * W}: not a valid slab decomposition")
+
+    def bc(i, j):
+        return (i, j, 0, 0)
+
+    def bl(i, j):
+        return (i, jnp.maximum(j - 1, 0), 0, 0)
+
+    def br(i, j):
+        return (i, jnp.minimum(j + 1, C - 1), 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_oa_kernel, W=W, S=S, C=C),
+        grid=(B, C),
+        in_specs=[
+            pl.BlockSpec((1, 1, SK, d), bc),
+            pl.BlockSpec((1, 1, SK, d), bl),
+            pl.BlockSpec((1, 1, SK, d), br),
+        ],
+        out_specs=pl.BlockSpec((1, 1, S, d), bc),
+        out_shape=jax.ShapeDtypeStruct((B, C, S, d), y.dtype),
+        interpret=interpret,
+    )(y, y, y)
+    return out.reshape(B, C * S, d)
+
+
+def overlap_add_tokens(
+    y: jnp.ndarray, *, W: int, S: int, L: int, interpret: bool = False
+) -> jnp.ndarray:
+    """Drop-in for ops/banded.band_vs's overlap-add tail: slab-space
+    [B, C, S+2W, d] -> per-token [B, L, d], via the Pallas kernel. The
+    [:, :L] slice is a contiguous (layout-preserving) slice XLA fuses into
+    the consumer — no transpose chain."""
+    return overlap_add_slabs(y, W=W, S=S, interpret=interpret)[:, :L]

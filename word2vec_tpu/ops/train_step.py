@@ -350,7 +350,15 @@ def make_pair_train_step(
                matrix, VERDICT r4 item 7). Halo positions are context-only:
                their center direction is owned by the neighboring shard, so
                every (center, context) pair is enumerated exactly once
-               globally and the per-shard table deltas sum correctly.
+               globally and the SUM of the per-shard table deltas equals the
+               single-chip step's delta (pinned by the conservation tests,
+               tests/test_parallel.py). NOTE the trainer's sync then pmeans
+               replicas over dp AND sp (parallel/trainer.make_sync), so the
+               cross-replica update it APPLIES is 1/sp of that single-chip
+               sum — Hogwild-analog averaging semantics, an effective
+               learning-rate scale vs single-chip, not an equivalence
+               (ADVICE r5 #1; post-sync behavior pinned by
+               test_sp_sync_applies_mean_of_shard_deltas).
     """
     W = config.window
     K = config.negative

@@ -98,16 +98,18 @@ def assemble_local_replica(v: jax.Array) -> np.ndarray:
 
 
 def _reject_pallas(config: Word2VecConfig) -> None:
-    """shard_map cannot host the pallas band kernel yet: the Pallas
+    """shard_map cannot host the pallas band kernels yet: the Pallas
     interpreter's internal dynamic_slices are not vma-aware (crashes even
     on a 1x1x1 mesh on the CPU test backend), and no multi-chip hardware
-    exists here to validate a real-TPU compile. Reject up front with the
-    real reason instead of an internal JAX error mid-step."""
-    if config.band_backend == "pallas":
+    exists here to validate a real-TPU compile. Covers both the fused band
+    kernel (band_backend='pallas') and the overlap-add kernel
+    ('pallas_oa', ops/pallas_overlap.py). Reject up front with the real
+    reason instead of an internal JAX error mid-step."""
+    if config.band_backend in ("pallas", "pallas_oa"):
         raise ValueError(
-            "band_backend='pallas' is single-chip only (plain Trainer); "
-            "sharded trainers run the XLA band chain — see the scope note "
-            "in ops/pallas_band.py"
+            f"band_backend={config.band_backend!r} is single-chip only "
+            "(plain Trainer); sharded trainers run the XLA band chain — "
+            "see the scope note in ops/pallas_band.py"
         )
 
 

@@ -325,6 +325,21 @@ class Trainer:
         # state.epoch = epoch in progress; a mid-epoch checkpoint re-enters it
         # at the first undone batch (_resume_skip)
         skip = self._resume_skip(state, batcher)
+        # hs tail-overflow observation is decoupled from the log cadence:
+        # like the chunked driver (_note_metrics), every step is an
+        # observation, so the warning fires with log_every=0 too. The fetch
+        # lags one dispatched step behind so the device pipeline is never
+        # stalled to read the scalar.
+        pending_tail: Optional[Tuple[jnp.ndarray, int]] = None
+
+        def drain_tail() -> None:
+            nonlocal pending_tail
+            if pending_tail is None:
+                return
+            val, at_step = pending_tail
+            pending_tail = None
+            self._note_tail_dropped(float(jax.device_get(val)), at_step)
+
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
             for tokens, words in prefetch(self._batches(batcher, epoch, skip)):
@@ -335,6 +350,9 @@ class Trainer:
                 state.step += 1
                 state.words_done += words
                 self._post_step(state)
+                drain_tail()
+                if "hs_tail_dropped" in metrics:
+                    pending_tail = (metrics["hs_tail_dropped"], state.step)
                 if log_every and state.step % log_every == 0:
                     m = jax.device_get(metrics)
                     loss = float(m["loss_sum"]) / max(1.0, float(m["pairs"]))
@@ -351,13 +369,7 @@ class Trainer:
                             "config.scatter_mean=True (see config.py notes).",
                             stacklevel=2,
                         )
-                    if "hs_tail_dropped" in m:
-                        # warn on persistent drops whether or not a log
-                        # sink is attached (drive-verified: the first cut
-                        # only checked under log_fn and never fired)
-                        self._note_tail_dropped(
-                            float(m["hs_tail_dropped"]), state.step
-                        )
+
                     if self.log_fn:
                         dt = time.perf_counter() - t0
                         rec = {
@@ -382,6 +394,7 @@ class Trainer:
         self._finalize(state)
         # ensure all device work is done before timing
         jax.block_until_ready(state.params)
+        drain_tail()  # the last step's overflow observation still counts
         wall = time.perf_counter() - t0
         final_loss = float("nan")
         if last_metrics is not None:
@@ -658,10 +671,13 @@ class Trainer:
         warning. The auto compaction bound assumes tail lengths are
         independent across positions (ops/hs_step.resolve_tail_slots);
         bursty real corpora can violate that, and a user watching only the
-        progress line would never see the hs_tail_dropped counter. One
-        nonzero observation is a statistical spike; two CONSECUTIVE logged
-        observations means the bound is genuinely too tight for this
-        corpus, so say so once, with the fix."""
+        progress line would never see the hs_tail_dropped counter. Every
+        fetched step (per-step loop, drain_tail) or chunk (_note_metrics)
+        is an observation, independent of the log cadence — the warning
+        fires with log_every=0 too (ADVICE r5 #2). One nonzero observation
+        is a statistical spike; two CONSECUTIVE observations means the
+        bound is genuinely too tight for this corpus, so say so once, with
+        the fix."""
         if dropped > 0:
             self._tail_drop_streak += 1
         else:
@@ -670,8 +686,9 @@ class Trainer:
             import warnings
 
             warnings.warn(
-                f"hs tail compaction dropped updates in consecutive logged "
-                f"chunks (latest: {dropped:.0f} slots at step {at_step}). "
+                f"hs tail compaction dropped updates in consecutive "
+                f"observations (latest: {dropped:.0f} slots at step "
+                f"{at_step}). "
                 "The auto bound (mean + 6 sigma, independence "
                 "approximation) is too tight for this corpus — raise "
                 "config.hs_tail_slots or set hs_tail_slots=0 to disable "

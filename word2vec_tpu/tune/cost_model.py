@@ -16,7 +16,13 @@ layout copies at 2.14 ms = 27% of the 7.97 ms step at the flagship shape
 layout transposes are strided, not streaming. LAYOUT_COPY_INEFFICIENCY is
 calibrated so the model reproduces that anchor exactly at the traced shape
 (pinned by tests/test_tune.py); every other shape scales analytically from
-it.
+it. The term is attributed PER BACKEND by utils/profiling.step_hbm_bytes:
+it prices only the XLA overlap-add chain — the 'pallas' backend keeps the
+whole plane in VMEM, and 'pallas_oa' replaces exactly that chain with the
+VMEM overlap-add kernel (ops/pallas_overlap.py), paying one sequential
+slab-plane read + token-plane write instead. That contrast is what lets
+the planner rank pallas_oa above xla precisely when the copy term
+dominates (tests/test_tune.py ordering tests).
 
 The model's job is ORDERING (which few candidates deserve a timed probe),
 not absolute truth — probes decide the winner. Both numbers are banked side

@@ -130,10 +130,14 @@ def candidate_grid(
         is_band_ns
         and c.get("allow_pallas", True)
         and c.get("platform") == "tpu"
-        and not config.fused_tables
-        and "pallas" not in backends
     ):
-        backends.append("pallas")
+        # the fully-fused kernel cannot gather fused [V, 2, d] tables; the
+        # overlap-add kernel composes with fused_tables (token-order output
+        # shares the center side's sorted index set — ops/pallas_overlap.py)
+        if not config.fused_tables and "pallas" not in backends:
+            backends.append("pallas")
+        if "pallas_oa" not in backends:
+            backends.append("pallas_oa")
 
     combos = [
         (b, cap, kp, scope, S, be)
@@ -169,6 +173,14 @@ def candidate_grid(
         cand_block = (applied.batch_rows // applied.micro_steps) * L
         if cand_block > max_block:
             continue
+        if be in ("pallas", "pallas_oa"):
+            # both kernels require the chunked band representation; a
+            # candidate whose rows resolve dense would only burn a probe
+            # on a guaranteed ValueError
+            from ..ops.banded import resolve_chunk
+
+            if resolve_chunk(L, applied.window, applied.band_chunk) == 0:
+                continue
         out.append(plan)
     return out
 

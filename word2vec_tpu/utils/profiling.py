@@ -184,7 +184,9 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
                        contrast prose-documented in ops/pallas_band.py)
       layout_copies  — the {0,2,1}<->{2,1,0} copies XLA inserts around the
                        overlap-add chain (measured 2.14 ms = 27% of the r2
-                       step; absent on the pallas and slab-scatter paths)
+                       step; absent on the pallas, pallas_oa and
+                       slab-scatter paths — pallas_oa replaces the chain
+                       with a VMEM overlap-add kernel, ops/pallas_overlap)
       total          — sum of the above
 
     Absolute bytes are a model, not a measurement — the value is in the
@@ -229,6 +231,18 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
         inter = (ein_rows + slab_rows + neg_rows) * tb + (
             B * g["C"] * g["S"] * d + slab_rows + neg_rows
         ) * f32
+        copies = 0.0
+    elif g["backend"] == "pallas_oa" and g["S"] > 0:
+        # the XLA chain's traffic, with the overlap-add done in VMEM by
+        # ops/pallas_overlap.py: the layout-copy term disappears and the
+        # kernel itself streams the slab-space grad plane in and the
+        # token-order plane out once (~2x slab_rows, sequential — no
+        # LAYOUT_COPY_INEFFICIENCY multiplier applies)
+        inter = (
+            4.0 * (ein_rows + slab_rows) * g["compute_bytes"]
+            + 4.0 * g["plane"] * f32
+            + 2.0 * slab_rows * f32
+        )
         copies = 0.0
     else:
         # XLA chain: row tensors re-read by the four band contractions, and

@@ -181,6 +181,11 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         prng_impl=args.prng,
         dtype=args.table_dtype,
         stochastic_rounding=bool(args.sr),
+        # --health 1 banks the full on-device health counters (grad-norm,
+        # per-table update magnitudes) in the record; default off because
+        # they cost an extra table read per step and this is a throughput
+        # measurement. The free non-finite tripwire counter is always on.
+        health_metrics=bool(args.health),
     )
 
     if os.path.exists(args.text8):
@@ -236,6 +241,14 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     batcher = BatchIterator(corpus, cfg.batch_rows, cfg.max_sentence_len, seed=1)
     base_key = jax.random.key(7, impl=cfg.jax_prng_impl)
 
+    # Phase-timing breakdown (obs/phases.py): where the measured epoch's
+    # wall time went (input wait vs dispatch vs device backpressure), banked
+    # alongside predicted-vs-measured cost so a slow record is attributable
+    # without rerunning under xprof. Span overhead is two clock reads.
+    from word2vec_tpu.obs.phases import PhaseRecorder
+
+    phases = PhaseRecorder()
+
     # Chunked dispatch (ops/train_step.make_chunk_runner): S optimizer steps
     # per device program, so per-dispatch overhead — which through the remote
     # tunnel costs ~4-5x the 8 ms device step — amortizes to noise. The
@@ -276,10 +289,14 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         params, m = chunk_fn(params, jnp.asarray(warm[0]), base_key, 0, alphas)
         jax.block_until_ready(params)
 
+        def place(np_chunk):
+            with phases.span("h2d"):  # producer thread: overlapped time
+                return jax.device_put(np_chunk)
+
         def dispatches():
             # chunk transfers overlap compute (batcher.placed_prefetch)
             for dev_chunk, wlist in placed_prefetch(
-                chunk_batches(batcher.epoch(), S), jax.device_put,
+                chunk_batches(batcher.epoch(), S), place,
                 depth=cfg.prefetch_depth,
             ):
                 yield sum(wlist), (
@@ -292,6 +309,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     steps = 0
     chunk_metrics = []
     dropped_metrics = []
+    health_chunks = []  # per-chunk health counters (obs/health.py)
     # 1-minute load average at measurement start: on the 1-core bench host
     # a CPU-fallback number is only comparable across rounds at similar
     # host load (the r4 CPU artifact dropped 24% vs r3 with the queue
@@ -299,16 +317,21 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     # lets the artifact distinguish contention from regression)
     load_start = os.getloadavg()[0] if hasattr(os, "getloadavg") else None
     t0 = time.perf_counter()
-    for chunk_words, dispatch in dispatches():
-        params, m = dispatch(params, steps)
+    for chunk_words, dispatch in phases.timed_iter(dispatches(), "batcher_wait"):
+        with phases.span("dispatch"):
+            params, m = dispatch(params, steps)
         chunk_metrics.append(m["pairs"])
         if "hs_tail_dropped" in m:
             dropped_metrics.append(m["hs_tail_dropped"])
+        health_chunks.append(
+            {k: m[k] for k in ("nonfinite_loss", "grad_sq") if k in m}
+        )
         words += chunk_words
         steps += S
         if args.measure_steps and steps >= args.measure_steps:
             break
-    jax.block_until_ready(params)
+    with phases.span("device_wait"):
+        jax.block_until_ready(params)
     dt = time.perf_counter() - t0
     wps = words / dt
     def sum_device(xs):
@@ -365,6 +388,22 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "step_ms": round(1e3 * dt / max(1, steps), 4),
         "words_per_sec": round(wps, 1),
     }
+    # Telemetry (obs/): the phase breakdown + health counters make the
+    # predicted-vs-measured audit self-contained — an off-model number can
+    # be attributed (input-bound? divergence?) from the record alone — and
+    # the manifest slice pins provenance (device, versions, git sha).
+    from word2vec_tpu.obs import manifest as obs_manifest
+    from word2vec_tpu.obs.health import health_record
+
+    health = {"nonfinite_loss_steps": 0.0}
+    if health_chunks:
+        fetched = [jax.device_get(h) for h in health_chunks]
+        merged = {
+            k: np.concatenate([np.atleast_1d(np.asarray(h[k])) for h in fetched])
+            for k in fetched[0]
+        }
+        health = health_record(merged) or health
+
     record = {
         "metric": f"{key} words/sec ({corpus_name}, {dev.platform})",
         "value": round(wps, 1),
@@ -381,6 +420,12 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "plan_source": plan_res.source if plan_res else "flags",
         "predicted_cost": predicted,
         "measured_cost": measured,
+        "phases": phases.report(),
+        "health": health,
+        "manifest": obs_manifest.manifest_dict(
+            cfg, vocab_size=len(vocab), plan_resolution=plan_res,
+            include_config=False,
+        ),
     }
     if plan_res is not None:
         record["plan_cache_hit"] = plan_res.source == "cache"
@@ -448,6 +493,11 @@ def build_parser() -> argparse.ArgumentParser:
                     "halves table gather/scatter bytes)")
     ap.add_argument("--sr", type=int, default=0, choices=[0, 1],
                     help="stochastic rounding of table updates (bf16 tables)")
+    ap.add_argument("--health", type=int, default=0, choices=[0, 1],
+                    help="bank the full on-device health counters "
+                    "(grad-norm, per-table update magnitudes) in the "
+                    "record; off by default — they cost an extra table "
+                    "read per step (config.health_metrics)")
     ap.add_argument("--prng", choices=["threefry", "rbg"], default="threefry",
                     help="jax PRNG impl for the device draw streams; rbg is "
                     "cheaper on TPU (different stream, statistically "
@@ -628,7 +678,7 @@ def main() -> None:
         ("--hs-tail-slots", args.hs_tail_slots),
         ("--resident", args.resident), ("--fused", args.fused),
         ("--prng", args.prng), ("--table-dtype", args.table_dtype),
-        ("--sr", args.sr),
+        ("--sr", args.sr), ("--health", args.health),
         ("--autotune", args.autotune), ("--plan-cache", args.plan_cache),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:

@@ -1,0 +1,474 @@
+"""Telemetry subsystem (obs/, SURVEY §5): on-device health counters through
+the trainers' lagged drain, the DivergenceError tripwire, phase timing,
+manifest, and the exporter sinks.
+
+The metrics CONTRACT pinned here: health counters arrive via the existing
+one-step-lagged metrics drain — observed every step even with log_every=0
+(same contract as the hs tail-overflow warning) — and add NO device_get/sync
+per step beyond that drain (the dispatch-count tests)."""
+
+import io
+import json
+import os
+import re
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.obs.export import MetricsHub, prometheus_textfile
+from word2vec_tpu.obs.health import (
+    DivergenceError, HealthMonitor, health_record,
+)
+from word2vec_tpu.obs.manifest import git_sha, manifest_dict, write_manifest
+from word2vec_tpu.obs.phases import PhaseRecorder
+from word2vec_tpu.train import Trainer
+
+V, D = 30, 16
+
+# a valid Prometheus text-exposition line (comment, or sample with optional
+# labels and a float/NaN/Inf value) — the CI smoke uses the same shape
+PROM_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"(NaN|[+-]?Inf|[-+0-9.eE]+))$"
+)
+
+
+@pytest.fixture(scope="module")
+def corpus_setup():
+    rng = np.random.default_rng(0)
+    sents = [
+        [f"w{j}" for j in rng.integers(0, V, size=20)] for _ in range(60)
+    ]
+    vocab = Vocab.build(sents, min_count=1)
+    return vocab, sents
+
+
+def make_trainer(corpus_setup, log_fn=None, **kw):
+    vocab, sents = corpus_setup
+    cfg = Word2VecConfig(
+        word_dim=D, window=2, min_count=1, negative=3, batch_rows=4,
+        max_sentence_len=32, subsample_threshold=0, **kw,
+    )
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # tiny-corpus geometry advice
+        return Trainer(cfg, vocab, corpus, log_fn=log_fn)
+
+
+def poisoned_state(trainer):
+    """Initial state with NaN tables: every subsequent loss is non-finite,
+    so the divergence tripwire's step arithmetic is deterministic."""
+    state = trainer.init_state()
+    state.params = jax.tree.map(
+        lambda v: (v * float("nan")).astype(v.dtype), state.params
+    )
+    return state
+
+
+# ---------------------------------------------------------- device counters
+
+def test_step_metrics_carry_health_counters(corpus_setup):
+    """config.health_metrics extends the jit step's metrics in-program:
+    per-table update magnitudes (fused-stable key names), global grad_sq,
+    non-finite counts, device alpha."""
+    tr = make_trainer(corpus_setup, health_metrics=True, chunk_steps=1)
+    state = tr.init_state()
+    toks = jnp.asarray(
+        np.random.default_rng(1).integers(0, V, size=(4, 32), dtype=np.int32)
+    )
+    _, m = tr.step_fn(state.params, toks, jax.random.key(0), jnp.float32(0.02))
+    m = jax.device_get(m)
+    for key in (
+        "nonfinite_loss", "nonfinite_params", "grad_sq", "alpha_sum",
+        "update_sq_emb_in", "update_sq_emb_out_ns",
+    ):
+        assert key in m, sorted(m)
+    assert float(m["nonfinite_loss"]) == 0.0
+    assert float(m["nonfinite_params"]) == 0.0
+    # emb_out_ns moves on step one (emb_in's grad is zero against the
+    # zero-initialized output table — classic word2vec init)
+    assert float(m["grad_sq"]) > 0.0
+    assert float(m["update_sq_emb_out_ns"]) > 0.0
+    assert float(m["alpha_sum"]) == pytest.approx(0.02)
+    rec = health_record(m)
+    assert rec["grad_norm"] == pytest.approx(float(np.sqrt(m["grad_sq"])))
+    assert rec["nonfinite_loss_steps"] == 0.0
+
+
+def test_nonfinite_tripwire_always_on_full_counters_opt_in(corpus_setup):
+    """The free non-finite-loss counter rides every step; the table-diff
+    counters appear only under config.health_metrics (they cost an extra
+    table read per step)."""
+    tr = make_trainer(corpus_setup, chunk_steps=1)  # health_metrics=False
+    state = tr.init_state()
+    toks = jnp.asarray(
+        np.random.default_rng(1).integers(0, V, size=(4, 32), dtype=np.int32)
+    )
+    _, m = tr.step_fn(state.params, toks, jax.random.key(0), jnp.float32(0.02))
+    m = jax.device_get(m)
+    assert "nonfinite_loss" in m
+    assert "grad_sq" not in m and "nonfinite_params" not in m
+
+
+def test_nan_params_trip_the_device_counters(corpus_setup):
+    tr = make_trainer(corpus_setup, health_metrics=True, chunk_steps=1)
+    state = poisoned_state(tr)
+    toks = jnp.asarray(
+        np.random.default_rng(1).integers(0, V, size=(4, 32), dtype=np.int32)
+    )
+    _, m = tr.step_fn(state.params, toks, jax.random.key(0), jnp.float32(0.02))
+    m = jax.device_get(m)
+    assert float(m["nonfinite_loss"]) == 1.0
+    assert float(m["nonfinite_params"]) > 0.0
+
+
+# ------------------------------------------------- lagged-drain observation
+
+@pytest.mark.parametrize("chunk_steps", [1, 0], ids=["per-step", "chunked"])
+def test_health_observed_every_step_with_logging_disabled(
+    corpus_setup, chunk_steps
+):
+    """The metrics contract: health counters arrive via the lagged drain,
+    so every step is observed even with log_every=0 — the cadence the hs
+    tail-overflow warning already pinned (train.py _observe_step)."""
+    tr = make_trainer(
+        corpus_setup, health_metrics=True, chunk_steps=chunk_steps
+    )
+    state, report = tr.train(log_every=0)
+    assert report.health is not None
+    # chunked epochs may pad the trailing chunk with no-op scan slots; each
+    # is still an observation, so observations >= real steps (== on per-step)
+    assert report.health["observations"] >= report.steps
+    if chunk_steps == 1:
+        assert report.health["observations"] == report.steps
+    assert report.health["nonfinite_loss_steps"] == 0
+    assert report.health["max_streak"] == 0
+    assert report.health.get("grad_norm_cumulative", 0.0) > 0.0
+
+
+@pytest.mark.parametrize("chunk_steps", [1, 4], ids=["per-step", "chunked"])
+def test_divergence_error_fires_deterministically(corpus_setup, chunk_steps):
+    """An injected-NaN run raises DivergenceError naming the failing step:
+    with budget b and NaN from step 1, the streak trips at observation b on
+    both dispatch paths (instead of the old warn-once-and-keep-going)."""
+    budget = 3
+    tr = make_trainer(
+        corpus_setup, chunk_steps=chunk_steps, divergence_budget=budget
+    )
+    state = poisoned_state(tr)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the legacy warn-once still fires
+        with pytest.raises(DivergenceError) as exc:
+            tr.train(state=state, log_every=0)
+    e = exc.value
+    assert e.step == budget
+    assert e.streak == budget
+    assert e.first_step == 1
+    assert "step 3" in str(e) and "diverged" in str(e)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_divergence_error_fires_in_sharded_trainer(corpus_setup):
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    vocab, sents = corpus_setup
+    cfg = Word2VecConfig(
+        word_dim=D, window=2, min_count=1, negative=3, batch_rows=4,
+        max_sentence_len=32, subsample_threshold=0, chunk_steps=1,
+        divergence_budget=2,
+    )
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        tr = ShardedTrainer(cfg, vocab, corpus, dp=2)
+        state = poisoned_state(tr)
+        with pytest.raises(DivergenceError) as exc:
+            tr.train(state=state, log_every=0)
+    assert exc.value.step == 2
+
+
+# -------------------------------------------------------- dispatch counting
+
+def counting_device_get(monkeypatch):
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counted(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counted)
+    return calls
+
+
+def test_per_step_path_syncs_once_per_step_at_most(corpus_setup, monkeypatch):
+    """Pin the acceptance criterion: health observation adds no
+    device_get/sync beyond the existing lagged drain — one fetch per step
+    (plus the final-loss fetch) on the per-step path with log_every=0."""
+    tr = make_trainer(corpus_setup, health_metrics=True, chunk_steps=1)
+    calls = counting_device_get(monkeypatch)
+    state, report = tr.train(log_every=0)
+    assert report.steps > 0
+    # one lagged drain per step + the final-loss fetch
+    assert calls["n"] <= report.steps + 2
+    assert report.health["observations"] == report.steps  # yet all observed
+
+
+def test_chunked_path_syncs_once_per_chunk(corpus_setup, monkeypatch):
+    tr = make_trainer(corpus_setup, health_metrics=True, chunk_steps=5)
+    calls = counting_device_get(monkeypatch)
+    state, report = tr.train(log_every=0)
+    chunks = -(-report.steps // 5)
+    assert calls["n"] <= chunks + 2
+    assert calls["n"] < report.steps  # strictly fewer syncs than steps
+    assert report.health["observations"] >= report.steps
+
+
+# ------------------------------------------------------------ phase timing
+
+def test_phase_recorder_stats_and_verdict():
+    rec = PhaseRecorder()
+    assert rec.report() is None
+    for ms in (1.0, 2.0, 3.0, 4.0):
+        rec.note("batcher_wait", ms / 1e3)
+    rec.note("dispatch", 0.001)
+    snap = rec.snapshot()
+    assert snap["batcher_wait"]["count"] == 4
+    assert snap["batcher_wait"]["total_ms"] == pytest.approx(10.0)
+    assert snap["batcher_wait"]["p50_ms"] == pytest.approx(3.0)
+    assert snap["batcher_wait"]["p90_ms"] == pytest.approx(4.0)
+    v = rec.verdict()
+    assert v["verdict"] == "input-bound"  # 10 ms input vs 1 ms compute
+    assert v["input_fraction"] == pytest.approx(10 / 11, abs=1e-3)
+    rec.note("device_wait", 1.0)  # now compute dominates
+    assert rec.verdict()["verdict"] == "compute-bound"
+
+
+def test_phase_recorder_span_and_timed_iter():
+    rec = PhaseRecorder()
+    with rec.span("dispatch"):
+        pass
+    items = list(rec.timed_iter(iter([1, 2, 3]), "batcher_wait"))
+    assert items == [1, 2, 3]
+    snap = rec.snapshot()
+    assert snap["dispatch"]["count"] == 1
+    assert snap["batcher_wait"]["count"] == 3
+    # h2d alone gives no verdict — it is overlapped producer time
+    rec2 = PhaseRecorder()
+    rec2.note("h2d", 1.0)
+    assert rec2.verdict()["verdict"] == "indeterminate"
+
+
+def test_train_report_and_log_records_carry_phases(corpus_setup):
+    records = []
+    tr = make_trainer(
+        corpus_setup, health_metrics=True, chunk_steps=1,
+        log_fn=records.append,
+    )
+    state, report = tr.train(log_every=5)
+    assert report.phases is not None
+    names = set(report.phases["phases"])
+    assert {"batcher_wait", "dispatch", "device_wait", "h2d"} <= names
+    assert report.phases["verdict"] in ("input-bound", "compute-bound")
+    logged = [r for r in records if "grad_norm" in r]
+    assert logged, records
+    last = logged[-1]
+    assert "phases" in last and "p50_ms" in last["phases"]["dispatch"]
+    assert "update_norm_emb_in" in last
+    assert last["nonfinite_loss_steps"] == 0.0
+
+
+# -------------------------------------------------------------- hub + sinks
+
+class CloseableSink:
+    def __init__(self):
+        self.records = []
+        self.closed = 0
+
+    def __call__(self, m):
+        self.records.append(m)
+
+    def close(self):
+        self.closed += 1
+
+
+def test_metrics_hub_fans_out_and_closes():
+    a, b = CloseableSink(), CloseableSink()
+    hub = MetricsHub(a, None, b)  # None sinks are dropped
+    assert len(hub.sinks) == 2
+    hub({"step": 1})
+    assert a.records == b.records == [{"step": 1}]
+    plain = lambda m: None  # noqa: E731 — a sink without close is fine
+    hub.add(plain)
+    hub.close()
+    assert a.closed == 1 and b.closed == 1
+
+
+def test_metrics_hub_close_failure_warns_not_raises():
+    bad = CloseableSink()
+    bad.close = lambda: (_ for _ in ()).throw(OSError("disk gone"))
+    hub = MetricsHub(bad)
+    with pytest.warns(UserWarning, match="failed to close"):
+        hub.close()
+
+
+def test_jsonl_logger_is_closeable(tmp_path):
+    from word2vec_tpu.utils.logging import jsonl_logger
+
+    path = str(tmp_path / "log.jsonl")
+    log = jsonl_logger(path)
+    log({"step": 1, "loss": 0.5})
+    log.close()
+    log.close()  # idempotent
+    log({"step": 2})  # post-close writes are dropped, not crashes
+    recs = [json.loads(l) for l in open(path)]
+    assert recs == [{"step": 1, "loss": 0.5}]
+
+
+def test_progress_logger_tolerates_partial_records():
+    from word2vec_tpu.utils.logging import progress_logger
+
+    out = io.StringIO()
+    log = progress_logger(out)
+    log({"step": 1})  # no loss / words_per_sec / alpha — must not raise
+    log({"event": "resident_path", "resolved": "streaming"})
+    log({"alpha": 0.02, "loss": 0.5, "words_per_sec": 123.0, "progress": 0.5})
+    text = out.getvalue()
+    assert "nan" in text  # missing loss rendered, not crashed
+    assert "[resident_path]" in text
+
+
+def test_tensorboard_logger_degrades_without_dependency(monkeypatch, tmp_path):
+    from word2vec_tpu.utils import logging as wlog
+
+    monkeypatch.setitem(sys.modules, "tensorboardX", None)  # force ImportError
+    with pytest.warns(UserWarning, match="tensorboardX is not installed"):
+        log = wlog.tensorboard_logger(str(tmp_path / "tb"))
+    log({"step": 1, "loss": 0.5})  # no-op, no crash
+    log.close()
+
+
+def test_prometheus_textfile_exposition(tmp_path):
+    path = str(tmp_path / "metrics.prom")
+    sink = prometheus_textfile(path)
+    sink({
+        "step": 3, "loss": 0.5, "note": "skipped-string", "flag": True,
+        "phases": {"dispatch": {"p50_ms": 1.5, "count": 3}},
+    })
+    lines = open(path).read().strip().splitlines()
+    for line in lines:
+        assert PROM_LINE.match(line), line
+    text = "\n".join(lines)
+    assert "w2v_loss 0.5" in text
+    assert 'w2v_phase_p50_ms{phase="dispatch"} 1.5' in text
+    assert "skipped-string" not in text and "w2v_flag" not in text
+    # gauges update in place; event records are skipped entirely
+    sink({"loss": 0.25})
+    sink({"event": "resident_path", "budget_bytes": 1})
+    text = open(path).read()
+    assert "w2v_loss 0.25" in text and "budget_bytes" not in text
+    # non-finite values use the exposition spellings
+    sink({"loss": float("nan")})
+    assert "w2v_loss NaN" in open(path).read()
+    sink.close()
+
+
+# ---------------------------------------------------------------- manifest
+
+def test_manifest_carries_provenance(tmp_path):
+    cfg = Word2VecConfig(word_dim=D, window=2, negative=3)
+    man = manifest_dict(cfg, vocab_size=123)
+    assert man["schema"] == 1
+    assert man["plan"]["band_backend"] == "xla"
+    assert man["kernel"] == "band"
+    assert man["device"]["platform"] == "cpu"
+    assert man["versions"]["jax"]
+    assert man["config"]["word_dim"] == D
+    sha = man["git_sha"]
+    assert sha is None or re.fullmatch(r"[0-9a-f]{40}", sha)
+    slim = manifest_dict(cfg, include_config=False)
+    assert "config" not in slim
+    path = str(tmp_path / "m" / "manifest.json")
+    written = write_manifest(path, cfg, vocab_size=7, extra={"corpus_tokens": 9})
+    loaded = json.load(open(path))
+    assert loaded["vocab_size"] == 7 and loaded["corpus_tokens"] == 9
+    assert loaded["plan"] == written["plan"]
+
+
+def test_health_monitor_budget_zero_counts_without_raising():
+    mon = HealthMonitor(budget=0)
+    for step in range(1, 5):
+        mon.observe({"nonfinite_loss": 1.0}, step)
+    s = mon.summary()
+    assert s["nonfinite_loss_steps"] == 4 and s["max_streak"] == 4
+    # a finite observation resets the streak
+    mon.observe({"nonfinite_loss": 0.0}, 5)
+    assert mon.streak == 0
+
+
+# ------------------------------------------------------------- CLI end-to-end
+
+@pytest.fixture
+def cli_corpus(tmp_path):
+    rng = np.random.default_rng(0)
+    toks = []
+    for _ in range(400):
+        toks += ["x", str(rng.choice(["a", "b"])), "y",
+                 "p", str(rng.choice(["c", "d"])), "q"]
+    p = tmp_path / "corpus.txt"
+    p.write_text(" ".join(toks))
+    return str(p)
+
+
+def test_cli_metrics_dir_end_to_end(tmp_path, cli_corpus):
+    from word2vec_tpu.cli import main
+
+    mdir = str(tmp_path / "mdir")
+    rc = main([
+        "-train", cli_corpus, "-output", str(tmp_path / "vec.txt"),
+        "-size", "16", "-window", "2", "-negative", "3", "-min-count", "1",
+        "-iter", "1", "--backend", "cpu", "--batch-rows", "8",
+        "--max-sentence-len", "32", "--metrics-dir", mdir,
+        "--log-every", "5", "--quiet",
+    ])
+    assert rc == 0
+    man = json.load(open(os.path.join(mdir, "manifest.json")))
+    assert man["plan_source"] == "flags"
+    assert man["band_backend"] == "xla"
+    assert man["device"]["platform"] == "cpu"
+    assert man["corpus_tokens"] > 0
+    recs = [json.loads(l) for l in open(os.path.join(mdir, "metrics.jsonl"))]
+    steps = [r for r in recs if "grad_norm" in r]
+    assert steps, recs
+    assert "phases" in steps[-1]
+    assert "nonfinite_loss_steps" in steps[-1]
+    assert any(r.get("event") == "train_report" for r in recs)
+    for line in open(os.path.join(mdir, "metrics.prom")).read().splitlines():
+        assert PROM_LINE.match(line), line
+
+
+def test_cli_injected_nan_terminates_with_divergence_error(
+    tmp_path, cli_corpus, capsys
+):
+    from word2vec_tpu.cli import main
+
+    rc = main([
+        "-train", cli_corpus, "-output", str(tmp_path / "vec.txt"),
+        "-size", "16", "-window", "2", "-negative", "3", "-min-count", "1",
+        "-iter", "1", "--backend", "cpu", "--batch-rows", "8",
+        "--max-sentence-len", "32", "--divergence-budget", "3",
+        "--inject-nan", "--quiet",
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "DivergenceError" in err and "diverged" in err
+    assert re.search(r"failing at step \d+", err)

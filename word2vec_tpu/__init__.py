@@ -18,6 +18,7 @@ Quick start:
 
 from .config import TunePlan, Word2VecConfig
 from .data.batcher import BatchIterator, PackedCorpus
+from .obs import DivergenceError, MetricsHub, PhaseRecorder
 from .data.huffman import HuffmanCoding, build_huffman
 from .data.negative import AliasTable, build_alias_table
 from .data.vocab import Vocab
@@ -53,5 +54,8 @@ __all__ = [
     "Trainer",
     "TrainState",
     "TrainReport",
+    "DivergenceError",
+    "MetricsHub",
+    "PhaseRecorder",
     "__version__",
 ]

@@ -26,6 +26,7 @@ TPU extensions: --backend {tpu,cpu}, --dp/--sp/--tp mesh shape, --corpus-format,
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from typing import List, Optional
@@ -201,9 +202,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-every", type=int, default=100)
     p.add_argument("--log-jsonl", metavar="FILE",
                    help="append machine-readable JSONL log records to FILE")
+    p.add_argument("--metrics-dir", metavar="DIR",
+                   help="telemetry directory (obs/): writes manifest.json "
+                        "(realized plan/backend, device, versions, git sha), "
+                        "metrics.jsonl, and metrics.prom there, and enables "
+                        "the full on-device health counters unless "
+                        "--health-metrics 0")
+    p.add_argument("--prom-textfile", metavar="FILE",
+                   help="maintain a Prometheus-format textfile of the "
+                        "latest metrics at FILE (node-exporter textfile "
+                        "collector style; obs/export.py)")
+    p.add_argument("--health-metrics", type=int, choices=[0, 1], default=None,
+                   help="full on-device health counters (grad-norm, "
+                        "per-table update magnitudes, non-finite counts) in "
+                        "the step metrics (config.health_metrics; default: "
+                        "on when --metrics-dir is set, else off — they cost "
+                        "one extra table read per step)")
+    p.add_argument("--divergence-budget", type=int, default=8,
+                   help="consecutive non-finite-loss steps before the run "
+                        "aborts with a structured DivergenceError instead "
+                        "of training on NaN parameters (0 = warn only; "
+                        "config.divergence_budget; observed every step via "
+                        "the lagged metrics drain, even with --log-every 0)")
+    p.add_argument("--inject-nan", action="store_true", help=argparse.SUPPRESS)
+    # ^ fault injection for the divergence tripwire: poisons the initial
+    #   params with NaN so CI can assert the DivergenceError path end-to-end
     p.add_argument("--tensorboard", metavar="DIR",
                    help="write TensorBoard scalar summaries to DIR "
-                        "(loss/alpha/words_per_sec/progress)")
+                        "(loss/alpha/words_per_sec/progress + health "
+                        "counters; degrades to a warning without "
+                        "tensorboardX)")
     p.add_argument("--profile", metavar="DIR",
                    help="capture a jax.profiler trace of training into DIR "
                         "(view with tensorboard/xprof)")
@@ -318,6 +346,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         prng_impl=args.prng,
         dtype=args.table_dtype,
         stochastic_rounding=bool(args.stochastic_rounding),
+        # telemetry: --metrics-dir implies the full health counters unless
+        # the user explicitly opted out
+        health_metrics=bool(
+            args.health_metrics
+            if args.health_metrics is not None
+            else args.metrics_dir
+        ),
+        divergence_budget=args.divergence_budget,
     )
     try:
         cfg = ck_cfg if ck_cfg is not None else Word2VecConfig(**flag_kwargs)
@@ -505,15 +541,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         return 1
 
-    log_fn = None if args.quiet else progress_logger()
-    if args.log_jsonl or args.tensorboard:
-        from .utils.logging import jsonl_logger, tee, tensorboard_logger
+    # One MetricsHub fans every log record out to the enabled sinks and is
+    # the single close point for their file handles (obs/export.py replaces
+    # the old ad-hoc tee(...) wiring).
+    from .obs.export import MetricsHub, prometheus_textfile
 
-        log_fn = tee(
-            log_fn,
-            jsonl_logger(args.log_jsonl) if args.log_jsonl else None,
-            tensorboard_logger(args.tensorboard) if args.tensorboard else None,
-        )
+    hub = MetricsHub()
+    if not args.quiet:
+        hub.add(progress_logger())
+    metrics_dir = args.metrics_dir if is_primary else None
+    if metrics_dir:
+        os.makedirs(metrics_dir, exist_ok=True)
+    jsonl_path = args.log_jsonl or (
+        os.path.join(metrics_dir, "metrics.jsonl") if metrics_dir else None
+    )
+    prom_path = args.prom_textfile or (
+        os.path.join(metrics_dir, "metrics.prom") if metrics_dir else None
+    )
+    if jsonl_path or prom_path or args.tensorboard:
+        from .utils.logging import jsonl_logger, tensorboard_logger
+
+        if jsonl_path:
+            hub.add(jsonl_logger(jsonl_path))
+        if prom_path:
+            hub.add(prometheus_textfile(prom_path))
+        if args.tensorboard:
+            hub.add(tensorboard_logger(args.tensorboard))
+    log_fn = hub if hub.sinks else None
     if args.dp * args.tp * args.sp > 1:
         from .parallel import ShardedTrainer
 
@@ -536,9 +590,34 @@ def main(argv: Optional[List[str]] = None) -> int:
             hit = "cache hit" if pr.source == "cache" else "probed"
             print(f"autotune ({hit}, key {pr.key}): {pr.plan.to_json()}")
 
+    if metrics_dir:
+        # the manifest carries the REALIZED config (plan applied) so every
+        # record in this directory can be traced to what actually ran
+        from .obs.manifest import write_manifest
+
+        write_manifest(
+            os.path.join(metrics_dir, "manifest.json"),
+            trainer.config,
+            vocab_size=len(vocab),
+            plan_resolution=trainer.plan_resolution,
+            extra={
+                "corpus_tokens": corpus.num_tokens,
+                "corpus_rows": corpus.num_rows,
+                "resumed_from": args.resume or None,
+            },
+        )
+
     if state is not None and hasattr(trainer, "import_params"):
         # checkpoints always hold unreplicated [V, d] tables; re-shard them
         trainer.import_params(state.params, state)
+
+    if args.inject_nan:
+        # fault injection (hidden flag): poison the initial params so the
+        # divergence tripwire path is exercisable end-to-end from CI
+        state = state or trainer.init_state()
+        state.params = jax.tree.map(
+            lambda v: (v * float("nan")).astype(v.dtype), state.params
+        )
 
     def unreplicated(s: TrainState) -> TrainState:
         if hasattr(trainer, "export_params"):
@@ -564,14 +643,45 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from .utils.profiling import trace
 
+    from .obs.health import DivergenceError
+
     profile_ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
-    with profile_ctx:
-        state, report = trainer.train(
-            state=state,
-            log_every=args.log_every,
-            checkpoint_cb=ckpt_cb,
-            checkpoint_every=args.checkpoint_every,
-        )
+    try:
+        with profile_ctx:
+            state, report = trainer.train(
+                state=state,
+                log_every=args.log_every,
+                checkpoint_cb=ckpt_cb,
+                checkpoint_every=args.checkpoint_every,
+            )
+    except DivergenceError as e:
+        # structured abort: the step/counters/checkpoint hint are in the
+        # message; the metrics sinks are flushed so the JSONL/prom tail
+        # shows the run's last healthy records
+        print(f"error: DivergenceError: {e}", file=sys.stderr)
+        hub.close()
+        return 2
+    if report.health is not None or report.phases is not None:
+        # final-summary event record: the run's verdict lands in the JSONL
+        # tail (and the console, one line) without re-deriving it from logs
+        summary = {
+            "event": "train_report",
+            "steps": report.steps,
+            "words_per_sec": round(report.words_per_sec, 1),
+            "final_loss": report.final_loss,
+        }
+        if report.health is not None:
+            summary.update(
+                nonfinite_loss_steps=report.health.get("nonfinite_loss_steps"),
+                health_observations=report.health.get("observations"),
+            )
+        if report.phases is not None:
+            summary.update(
+                verdict=report.phases.get("verdict"),
+                input_fraction=report.phases.get("input_fraction"),
+            )
+        if log_fn is not None:
+            log_fn(summary)
     if args.emit_device:
         dev = jax.devices()[0]
         print(f"device: {dev.platform} {dev.device_kind}", file=sys.stderr)
@@ -613,6 +723,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.eval_analogy:
             r = evaluate_analogies(W, vocab, args.eval_analogy)
             print(f"analogy accuracy: {r.accuracy:.4f} ({r.correct}/{r.total})")
+    hub.close()
     return 0
 
 

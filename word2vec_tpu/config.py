@@ -286,6 +286,24 @@ class Word2VecConfig:
     # with slab_scatter (different index set per table).
     fused_tables: bool = False
 
+    # --- telemetry (obs/) ---
+    # Full on-device health counters (obs/health.instrument_step): global
+    # grad-norm, per-table update-magnitude stats, non-finite parameter
+    # counts and the device-side alpha, emitted through the step's metrics
+    # dict inside the existing jit/scan (zero extra dispatches). Costs one
+    # extra read of each [V, d] table per optimizer step and defeats the
+    # donation aliasing of the table buffers, so it is opt-in; the free
+    # non-finite-loss tripwire below is always on.
+    health_metrics: bool = False
+    # Consecutive non-finite-loss observations (via the trainers' lagged
+    # metrics drain — every step/chunk is an observation, independent of
+    # log_every) before the run raises obs.health.DivergenceError instead
+    # of burning device time on NaN parameters. 0 disables the tripwire
+    # (counting still feeds TrainReport.health). The CLI defaults this to 8
+    # (--divergence-budget); the library default preserves run-to-the-end
+    # semantics for existing callers.
+    divergence_budget: int = 0
+
     # --- multi-chip (no reference counterpart; replaces OpenMP Hogwild) ---
     # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
     dp_sync_every: int = 64
@@ -431,6 +449,8 @@ class Word2VecConfig:
             )
         if self.chunk_cap < 1:
             raise ValueError("chunk_cap must be >= 1")
+        if self.divergence_budget < 0:
+            raise ValueError("divergence_budget must be >= 0 (0 = off)")
         if self.prefetch_depth < 1:
             raise ValueError("prefetch_depth must be >= 1")
 

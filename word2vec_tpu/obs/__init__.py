@@ -1,0 +1,31 @@
+"""Telemetry subsystem (PR 3): health counters, phase timing, manifests,
+exporters.
+
+    obs.health   — on-device health counters inside the existing jit/scan
+                   (instrument_step), the lagged-drain HealthMonitor, and
+                   the structured DivergenceError tripwire
+    obs.phases   — host-side phase-timing breakdown (PhaseRecorder) with an
+                   input-bound-vs-compute-bound verdict
+    obs.manifest — run manifests: realized plan/backend, device, versions,
+                   git sha
+    obs.export   — MetricsHub sink fan-out + the Prometheus textfile sink
+
+Drivers (train.Trainer, parallel.ShardedTrainer, cli.py, bench.py) all
+route through here; utils/logging.py keeps the individual log sinks.
+"""
+
+from .export import MetricsHub, prometheus_textfile
+from .health import DivergenceError, HealthMonitor, health_record
+from .manifest import manifest_dict, write_manifest
+from .phases import PhaseRecorder
+
+__all__ = [
+    "MetricsHub",
+    "prometheus_textfile",
+    "DivergenceError",
+    "HealthMonitor",
+    "health_record",
+    "manifest_dict",
+    "write_manifest",
+    "PhaseRecorder",
+]

@@ -1,0 +1,152 @@
+"""Metric exporters: one fan-out hub, many sinks.
+
+`MetricsHub` replaces the ad-hoc `utils.logging.tee(...)` wiring: drivers
+hold ONE callable, sinks are registered once, and everything closeable is
+flushed/closed in one place at run end (the jsonl handle leak this PR's
+satellite fixes was exactly a missing single close point). Any callable is
+a sink — `progress_logger`, `jsonl_logger`, `tensorboard_logger`, and the
+Prometheus textfile exporter below.
+
+`prometheus_textfile(path)` maintains a node-exporter-style textfile: every
+record updates a gauge set, and the whole exposition is atomically
+rewritten (tmp + rename, so a scraping collector never reads a torn file).
+Numeric top-level record keys become `w2v_<key>` gauges; the nested
+per-phase stats dict (obs/phases.PhaseRecorder.snapshot) flattens to
+`w2v_phase_<stat>{phase="..."}`. Event records (one-off resolution notices)
+and non-numeric values are skipped — gauges are for continuous signals.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Callable, Dict, List, Optional
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+class MetricsHub:
+    """Fan out one log record to every registered sink; close them once."""
+
+    def __init__(self, *sinks: Optional[Callable[[Dict], None]]):
+        self._sinks: List[Callable[[Dict], None]] = []
+        for s in sinks:
+            self.add(s)
+
+    @property
+    def sinks(self) -> List[Callable[[Dict], None]]:
+        return list(self._sinks)
+
+    def add(self, sink: Optional[Callable[[Dict], None]]):
+        """Register a sink (None is ignored, so callers can pass optional
+        sinks unconditionally). Returns the sink for chaining."""
+        if sink is not None:
+            self._sinks.append(sink)
+        return sink
+
+    def __call__(self, record: Dict) -> None:
+        for s in self._sinks:
+            s(record)
+
+    def close(self) -> None:
+        """Flush/close every sink that supports it. Best-effort: a sink
+        failing to close must not mask a training result that is already
+        computed (the failure is warned, not raised)."""
+        for s in self._sinks:
+            close = getattr(s, "close", None)
+            if close is None:
+                continue
+            try:
+                close()
+            except Exception as e:  # noqa: BLE001 — see docstring
+                import warnings
+
+                warnings.warn(
+                    f"metrics sink {s!r} failed to close: {e}", stacklevel=2
+                )
+
+
+def _metric_name(key: str) -> str:
+    name = "w2v_" + _NAME_OK.sub("_", str(key))
+    return name
+
+
+def _label_escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class PrometheusTextfile:
+    """Gauge-set sink writing the Prometheus text exposition format."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path)) or "."
+        os.makedirs(parent, exist_ok=True)
+        # (name, labels-tuple) -> float; insertion order = exposition order
+        self._gauges: Dict = {}
+
+    def __call__(self, record: Dict) -> None:
+        if "event" in record:
+            return  # one-off notices are not gauges
+        for key, val in record.items():
+            if key == "phases" and isinstance(val, dict):
+                for phase, stats in val.items():
+                    if not isinstance(stats, dict):
+                        continue
+                    for stat, sv in stats.items():
+                        if isinstance(sv, bool) or not isinstance(sv, (int, float)):
+                            continue
+                        self._set(
+                            f"w2v_phase_{_NAME_OK.sub('_', stat)}",
+                            (("phase", str(phase)),),
+                            sv,
+                        )
+                continue
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            self._set(_metric_name(key), (), val)
+        self._write()
+
+    def _set(self, name: str, labels, value) -> None:
+        self._gauges[(name, labels)] = float(value)
+
+    @staticmethod
+    def _fmt(value: float) -> str:
+        # the exposition format spells non-finite values NaN/+Inf/-Inf
+        if value != value:
+            return "NaN"
+        if value == float("inf"):
+            return "+Inf"
+        if value == float("-inf"):
+            return "-Inf"
+        return repr(value)
+
+    def _write(self) -> None:
+        by_name: Dict[str, List] = {}
+        for (name, labels), value in self._gauges.items():
+            by_name.setdefault(name, []).append((labels, value))
+        lines = []
+        for name, series in by_name.items():
+            lines.append(f"# HELP {name} word2vec_tpu training metric")
+            lines.append(f"# TYPE {name} gauge")
+            for labels, value in series:
+                if labels:
+                    lbl = ",".join(
+                        f'{k}="{_label_escape(v)}"' for k, v in labels
+                    )
+                    lines.append(f"{name}{{{lbl}}} {self._fmt(value)}")
+                else:
+                    lines.append(f"{name} {self._fmt(value)}")
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        os.replace(tmp, self.path)
+
+    def close(self) -> None:
+        if self._gauges:
+            self._write()
+
+
+def prometheus_textfile(path: str) -> PrometheusTextfile:
+    """Factory matching the utils.logging sink-constructor idiom."""
+    return PrometheusTextfile(path)

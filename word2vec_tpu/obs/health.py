@@ -1,0 +1,245 @@
+"""On-device health counters + the host-side divergence tripwire.
+
+The reference's only health signal is the loss printed every 100 sentences
+(Word2Vec.cpp:382-385); ours until now was a single warn-once on a
+non-finite loss observed at the log cadence (so `log_every=0` runs burned
+TPU time on NaN params until the epoch ended). This module closes that gap
+in two layers:
+
+  device side — `instrument_step` wraps any kernel step built by
+    ops/train_step.make_train_step and EXTENDS ITS METRICS DICT inside the
+    existing jit/scan program, so the counters cost zero extra dispatches:
+
+      nonfinite_loss    always (a scalar compare on the loss the kernel
+                        already computes — free)
+      grad_sq, update_sq_<table>, nonfinite_params, alpha_sum
+                        only with config.health_metrics: these diff the
+                        updated tables against their pre-step values, which
+                        costs one extra read of each [V, d] table per step
+                        AND defeats the donation aliasing of the table
+                        buffers (XLA must keep the old value live), so the
+                        full counters are opt-in — throughput runs keep the
+                        free tripwire only.
+
+    All counters are float32 scalars and strictly ADDITIVE, because the
+    micro-step wrapper tree-sums metrics across sub-blocks and the chunk
+    runners lax.scan-stack them: sums over any aggregation window stay
+    meaningful (alpha_sum sums micro_steps alphas per dispatch — divide by
+    micro_steps host-side, see `health_record`).
+
+  host side — `HealthMonitor` consumes the counters through the trainers'
+    existing one-step-lagged metrics drain (train.Trainer), counts
+    CONSECUTIVE non-finite observations, and raises a structured
+    `DivergenceError` (offending step, last counters, last-good checkpoint
+    hint) once the streak exceeds config.divergence_budget. No new host
+    syncs: the monitor only ever sees metrics the drain already fetched.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+#: metrics-dict key prefix of the per-table update-magnitude counters
+UPDATE_SQ_PREFIX = "update_sq_"
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged: the non-finite streak exceeded the budget.
+
+    Structured payload for harnesses: `.step` (the observation that tripped
+    the budget), `.streak`, `.first_step` (first non-finite observation of
+    the streak's run), `.counters` (the last drained health counters), and
+    `.checkpoint_hint` (where to resume from, when the run checkpointed).
+    """
+
+    def __init__(
+        self,
+        step: int,
+        streak: int,
+        first_step: Optional[int] = None,
+        counters: Optional[Dict[str, float]] = None,
+        checkpoint_hint: Optional[str] = None,
+    ):
+        self.step = step
+        self.streak = streak
+        self.first_step = first_step
+        self.counters = dict(counters or {})
+        self.checkpoint_hint = checkpoint_hint
+        shown = {
+            k: v for k, v in self.counters.items()
+            if k in ("loss_sum", "nonfinite_loss", "nonfinite_params", "grad_sq")
+        }
+        super().__init__(
+            f"training diverged: non-finite loss for {streak} consecutive "
+            f"observations (first at step {first_step}), failing at step "
+            f"{step}; counters: {shown}; last good checkpoint: "
+            f"{checkpoint_hint or 'none taken this run'}"
+        )
+
+
+def instrument_step(
+    base: Callable, config, tp_axis: Optional[str] = None
+) -> Callable:
+    """Wrap a kernel step so its metrics carry the health counters.
+
+    Runs INSIDE the caller's jit (ops/train_step.make_train_step applies it
+    under the micro wrapper and every chunk scan), so nothing here adds a
+    dispatch or a host sync. With tensor parallelism the per-table stats are
+    psum'd over `tp_axis` first: each dim shard's partial squared norm /
+    non-finite count becomes the global value, replicated over the model
+    axis — which is exactly the invariant the sharded trainers' metrics
+    aggregation (psum over model, divided by tp) assumes of every metric.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    full = bool(getattr(config, "health_metrics", False))
+
+    def _subtables(name, new, old):
+        """(public_name, new, old) triples; the fused [V, 2, d] ns stack
+        (ops/band_step.fuse_tables) reports as its two public tables so the
+        telemetry keys don't depend on the chunk runner's fusion state."""
+        from ..ops.band_step import FUSED_KEY, FUSED_SUBTABLES
+
+        if name == FUSED_KEY:
+            for i, sub in enumerate(FUSED_SUBTABLES):
+                yield sub, new[:, i], old[:, i]
+        else:
+            yield name, new, old
+
+    def step(params, tokens, key, alpha):
+        new_params, metrics = base(params, tokens, key, alpha)
+        metrics = dict(metrics)
+        # free tripwire: the loss the kernel already computed, compared once
+        metrics["nonfinite_loss"] = (
+            ~jnp.isfinite(metrics["loss_sum"])
+        ).astype(jnp.float32)
+        if not full:
+            return new_params, metrics
+        metrics["alpha_sum"] = jnp.asarray(alpha, jnp.float32)
+        grad_sq = jnp.float32(0.0)
+        bad = jnp.float32(0.0)
+        for name in sorted(new_params):
+            for sub, new_t, old_t in _subtables(name, new_params[name], params[name]):
+                delta = new_t.astype(jnp.float32) - old_t.astype(jnp.float32)
+                sq = jnp.sum(delta * delta)
+                nf = jnp.sum(~jnp.isfinite(new_t.astype(jnp.float32)))
+                nf = nf.astype(jnp.float32)
+                if tp_axis is not None:
+                    sq = jax.lax.psum(sq, tp_axis)
+                    nf = jax.lax.psum(nf, tp_axis)
+                metrics[UPDATE_SQ_PREFIX + sub] = sq
+                grad_sq = grad_sq + sq
+                bad = bad + nf
+        metrics["grad_sq"] = grad_sq
+        metrics["nonfinite_params"] = bad
+        return new_params, metrics
+
+    return step
+
+
+def health_record(m: Dict, micro_steps: int = 1) -> Dict[str, float]:
+    """Host-side log-record fields from a fetched metrics dict.
+
+    Works on per-step scalars and chunk-stacked [S] arrays alike (sums over
+    the window; norms are sqrt-of-summed-squares, i.e. the window's
+    cumulative update magnitude). Empty when the step carries no health
+    counters (instrumentation off in an externally-built step)."""
+    rec: Dict[str, float] = {}
+    if "nonfinite_loss" in m:
+        rec["nonfinite_loss_steps"] = float(np.sum(m["nonfinite_loss"]))
+    if "nonfinite_params" in m:
+        rec["nonfinite_params"] = float(np.sum(m["nonfinite_params"]))
+    if "grad_sq" in m:
+        rec["grad_norm"] = float(np.sqrt(np.sum(m["grad_sq"])))
+    if "alpha_sum" in m:
+        rec["alpha_device"] = float(
+            np.mean(np.asarray(m["alpha_sum"])) / max(1, micro_steps)
+        )
+    for k in m:
+        if k.startswith(UPDATE_SQ_PREFIX):
+            rec["update_norm_" + k[len(UPDATE_SQ_PREFIX):]] = float(
+                np.sqrt(np.sum(m[k]))
+            )
+    return rec
+
+
+class HealthMonitor:
+    """Consecutive-non-finite tracking over the trainers' lagged drain.
+
+    `observe` (per-step loop) and `observe_chunk` (chunked drivers) are
+    called once per FETCHED metrics payload — the observation cadence is the
+    drain cadence, independent of log_every, exactly like the hs
+    tail-overflow warning. budget == 0 disables the tripwire (counting
+    still runs, for TrainReport.health)."""
+
+    def __init__(self, budget: int = 0, micro_steps: int = 1):
+        self.budget = int(budget)
+        self.micro_steps = max(1, int(micro_steps))
+        self.streak = 0
+        self.max_streak = 0
+        self.observations = 0
+        self.nonfinite_steps = 0
+        self.first_nonfinite_step: Optional[int] = None
+        self.grad_sq_total = 0.0
+        self.last: Dict[str, float] = {}
+        #: set by the trainer whenever a checkpoint lands (the error's hint)
+        self.checkpoint_hint: Optional[str] = None
+
+    # ------------------------------------------------------------ observing
+    def observe(self, m: Dict, at_step: int) -> None:
+        """One drained per-step metrics dict (scalars)."""
+        self.last = {k: float(np.sum(v)) for k, v in m.items()}
+        self.grad_sq_total += float(np.sum(m.get("grad_sq", 0.0)))
+        self._advance(float(np.sum(m.get("nonfinite_loss", 0.0))) > 0, at_step)
+
+    def observe_chunk(
+        self, m: Dict, end_step: int, real_steps: Optional[int] = None
+    ) -> None:
+        """One drained chunk's metrics ([S]-stacked). Trailing pad steps of
+        a partial chunk are observed too (an all-padding batch keeps the
+        previous loss character, so they extend — never reset — a genuine
+        streak); step attribution maps scan slot i of the `real_steps`
+        leading real slots onto end_step - real_steps + 1 + i."""
+        self.last = {k: float(np.sum(v)) for k, v in m.items()}
+        self.grad_sq_total += float(np.sum(m.get("grad_sq", 0.0)))
+        arr = np.atleast_1d(np.asarray(m.get("nonfinite_loss", 0.0)))
+        n = len(arr)
+        real = n if real_steps is None else min(real_steps, n)
+        start = end_step - real
+        for i, v in enumerate(arr):
+            self._advance(float(v) > 0, min(start + i + 1, end_step))
+
+    def _advance(self, bad: bool, at_step: int) -> None:
+        self.observations += 1
+        if not bad:
+            self.streak = 0
+            return
+        if self.streak == 0:
+            self.first_nonfinite_step = at_step
+        self.streak += 1
+        self.nonfinite_steps += 1
+        self.max_streak = max(self.max_streak, self.streak)
+        if self.budget and self.streak >= self.budget:
+            raise DivergenceError(
+                at_step,
+                self.streak,
+                first_step=self.first_nonfinite_step,
+                counters=self.last,
+                checkpoint_hint=self.checkpoint_hint,
+            )
+
+    # ------------------------------------------------------------- summary
+    def summary(self) -> Dict:
+        """TrainReport.health payload."""
+        out = {
+            "observations": self.observations,
+            "nonfinite_loss_steps": self.nonfinite_steps,
+            "max_streak": self.max_streak,
+            "divergence_budget": self.budget,
+        }
+        if self.grad_sq_total > 0.0:
+            out["grad_norm_cumulative"] = float(np.sqrt(self.grad_sq_total))
+        return out

@@ -1,0 +1,132 @@
+"""Run manifests: what exactly did this run execute?
+
+Every telemetry-enabled run writes one `manifest.json` next to its metrics
+so a JSONL record / bench artifact / prom scrape can always be traced back
+to the REALIZED configuration — not the flags the user typed, but what the
+planner resolved them to (band backend, plan source, probe count), on which
+device, under which jax/jaxlib, at which git sha. The r4 forwarding-audit
+lesson generalized: a number whose provenance can't be reconstructed from
+its own directory is not evidence.
+
+`manifest_dict` is pure assembly (usable by bench.py for its one-line JSON
+record, with `include_config=False` to keep the line short); `write_manifest`
+adds the atomic tmp+replace file write the checkpoint writer uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+SCHEMA = 1
+
+
+def git_sha() -> Optional[str]:
+    """HEAD sha of the repo this package runs from; None outside a checkout
+    (installed wheels, missing git binary) — the manifest must never make a
+    run fail."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = (out.stdout or "").strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def runtime_versions() -> Dict[str, Optional[str]]:
+    import jax
+
+    versions: Dict[str, Optional[str]] = {
+        "python": sys.version.split()[0],
+        "jax": getattr(jax, "__version__", None),
+    }
+    try:
+        import jaxlib
+
+        versions["jaxlib"] = getattr(jaxlib, "__version__", None)
+    except ImportError:
+        versions["jaxlib"] = None
+    try:
+        import numpy
+
+        versions["numpy"] = numpy.__version__
+    except ImportError:  # pragma: no cover - numpy is a hard dep
+        versions["numpy"] = None
+    try:
+        from importlib import metadata
+
+        versions["libtpu"] = metadata.version("libtpu")
+    except Exception:
+        versions["libtpu"] = None
+    return versions
+
+
+def device_info() -> Dict:
+    """Where the run actually executed (the --emit-device contract's data)."""
+    import jax
+
+    dev = jax.devices()[0]
+    return {
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+    }
+
+
+def manifest_dict(
+    config,
+    vocab_size: Optional[int] = None,
+    plan_resolution=None,
+    include_config: bool = True,
+    extra: Optional[Dict] = None,
+) -> Dict:
+    """Assemble a run manifest from the REALIZED config (pass the trainer's
+    config, which carries any applied plan — not the pre-plan one)."""
+    man: Dict = {
+        "schema": SCHEMA,
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "argv": list(sys.argv),
+        "vocab_size": vocab_size,
+        # the realized step shapes, whether they came from flags or a plan
+        "plan": config.current_plan().to_json(),
+        "plan_source": "flags",
+        "band_backend": config.band_backend,
+        "kernel": config.resolved_kernel,
+        "device": device_info(),
+        "versions": runtime_versions(),
+        "git_sha": git_sha(),
+    }
+    if plan_resolution is not None:
+        man["plan_source"] = plan_resolution.source
+        man["plan_key"] = plan_resolution.key
+        man["plan_predicted"] = plan_resolution.predicted
+        man["plan_probes"] = len(plan_resolution.probes)
+    if include_config:
+        man["config"] = dataclasses.asdict(config)
+    if extra:
+        man.update(extra)
+    return man
+
+
+def write_manifest(path: str, config, **kwargs) -> Dict:
+    """manifest_dict + atomic write; returns the written dict."""
+    man = manifest_dict(config, **kwargs)
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=2, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return man

@@ -1,0 +1,131 @@
+"""Host-side phase-timing breakdown: where does a training step's wall time go?
+
+`utils/profiling.annotate` puts named regions on the xprof timeline, but
+reading them requires capturing and opening a trace. `PhaseRecorder` is the
+always-on counterpart: a thread-safe span recorder the trainers wrap around
+the same regions —
+
+    batcher_wait — the training loop blocked pulling the next batch/chunk
+                   from the prefetch queue (the host input pipeline could
+                   not keep ahead of the device)
+    h2d          — host->device placement of a batch/chunk; runs in the
+                   prefetch PRODUCER thread, so a large h2d total alongside
+                   a small batcher_wait means the copy overlap is working
+    dispatch     — host time spent issuing the (async) device program
+    device_wait  — the loop blocked fetching already-dispatched metrics
+                   (the lagged drain): device-side backpressure
+    checkpoint   — checkpoint callback wall time
+
+— and aggregates into per-phase p50/p90 (shared percentile math with
+profiling.StepTimer) plus an input-bound-vs-compute-bound verdict. The
+verdict compares only the phases that STALL the training loop:
+batcher_wait (input side) against dispatch + device_wait (device side);
+h2d and checkpoint are reported but excluded, since overlapped producer
+time stalls nothing.
+
+Recording one span is two perf_counter reads and a lock — cheap enough to
+leave on for every run, including bench.py's measured epochs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterable, Iterator, Optional
+
+from ..utils.profiling import annotate, lap_stats
+
+#: phases that stall the training loop on the input side / device side
+INPUT_PHASES = ("batcher_wait",)
+COMPUTE_PHASES = ("dispatch", "device_wait")
+
+
+class PhaseRecorder:
+    """Thread-safe named-span recorder with bounded per-phase sample rings."""
+
+    #: per-phase sample cap: percentiles come from the most recent samples
+    #: (ring overwrite), totals/counts from every span ever recorded
+    MAX_SAMPLES = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._laps: Dict[str, list] = {}
+        self._counts: Dict[str, int] = {}
+        self._totals: Dict[str, float] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._laps.clear()
+            self._counts.clear()
+            self._totals.clear()
+
+    # ------------------------------------------------------------ recording
+    def note(self, name: str, seconds: float) -> None:
+        """Record one externally-timed span."""
+        with self._lock:
+            n = self._counts.get(name, 0)
+            self._counts[name] = n + 1
+            self._totals[name] = self._totals.get(name, 0.0) + seconds
+            laps = self._laps.setdefault(name, [])
+            if len(laps) < self.MAX_SAMPLES:
+                laps.append(seconds)
+            else:
+                laps[n % self.MAX_SAMPLES] = seconds
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        """Time a region (and annotate it on the profiler timeline)."""
+        with annotate(name):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                self.note(name, time.perf_counter() - t0)
+
+    def timed_iter(self, iterable: Iterable, name: str) -> Iterator:
+        """Yield from `iterable`, recording each next() as one `name` span
+        (the consumer-side blocked-on-producer time of a prefetch queue)."""
+        it = iter(iterable)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            self.note(name, time.perf_counter() - t0)
+            yield item
+
+    # ----------------------------------------------------------- reporting
+    def snapshot(self) -> Dict[str, Dict]:
+        """{phase: {count, total_ms, p50_ms, p90_ms, ...}} — {} before any
+        span lands, so log records can include it conditionally."""
+        with self._lock:
+            out = {}
+            for name, laps in self._laps.items():
+                s = lap_stats(laps)
+                s["count"] = self._counts[name]
+                s["total_ms"] = 1e3 * self._totals[name]
+                out[name] = s
+            return out
+
+    def verdict(self) -> Dict:
+        """Input-bound vs compute-bound, from loop-stalling totals only."""
+        with self._lock:
+            inp = sum(self._totals.get(p, 0.0) for p in INPUT_PHASES)
+            comp = sum(self._totals.get(p, 0.0) for p in COMPUTE_PHASES)
+        if inp + comp <= 0.0:
+            return {"verdict": "indeterminate", "input_fraction": None}
+        frac = inp / (inp + comp)
+        return {
+            "verdict": "input-bound" if frac > 0.5 else "compute-bound",
+            "input_fraction": round(frac, 4),
+        }
+
+    def report(self) -> Optional[Dict]:
+        """TrainReport.phases payload: per-phase stats + the verdict.
+        None when nothing was recorded (a trainer that never ran)."""
+        snap = self.snapshot()
+        if not snap:
+            return None
+        return {"phases": snap, **self.verdict()}

@@ -121,6 +121,11 @@ def _halo_exchange(tok: jnp.ndarray, w: int, axis: str) -> jnp.ndarray:
 
 
 FUSED_KEY = "emb_ns_fused"
+#: stack-axis order of the public tables inside the fused [V, 2, d] array;
+#: obs/health reports per-table update stats under these names whether or
+#: not a chunk runner has the tables fused, so telemetry keys are stable
+#: across fused_tables configurations
+FUSED_SUBTABLES = ("emb_in", "emb_out_ns")
 
 
 def fuse_tables(params: Params) -> Params:

@@ -266,6 +266,14 @@ def make_train_step(
     convergence threshold (config.auto_geometry).
     """
     base = _make_base_step(config, tables, tp_axis, dp_axis, sp_axis, fused)
+    # Telemetry (obs/health.py): extend the metrics dict in-program — the
+    # free non-finite-loss tripwire always, the full table-diff counters
+    # under config.health_metrics. Applied UNDER the micro wrapper and the
+    # chunk scans, so counters aggregate additively over every dispatch
+    # granularity with zero extra dispatches or host syncs.
+    from ..obs.health import instrument_step
+
+    base = instrument_step(base, config, tp_axis)
     k = config.micro_steps
     if k <= 1:
         return base

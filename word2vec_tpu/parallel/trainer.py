@@ -134,7 +134,10 @@ def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
         # loss/pairs are computed from full (psum'd) logits, so every model
         # shard already holds the same value; psum/tp collapses the model axis
         # (and proves replication to the vma checker), psum over data sums the
-        # genuinely distinct per-shard contributions.
+        # genuinely distinct per-shard contributions. This is the METRICS
+        # CONTRACT every kernel- or telemetry-emitted counter must satisfy:
+        # model-axis-replicated, additive over replicas (obs/health pre-psums
+        # its per-dim-shard table stats over tp for exactly this reason).
         metrics = {
             k: jax.lax.psum(jax.lax.psum(v, MODEL_AXIS) / tp, REPLICA_AXES)
             for k, v in metrics.items()
@@ -573,10 +576,11 @@ class ShardedTrainer(Trainer):
             yield flush_chunk()
 
     def _place_tokens(self, np_chunk: np.ndarray) -> jnp.ndarray:
-        sharding = NamedSharding(self.mesh, P(None, DATA_AXIS, SEQ_AXIS))
-        if self.procs == 1:
-            return jax.device_put(np_chunk, sharding)
-        return jax.make_array_from_process_local_data(sharding, np_chunk)
+        with self.phases.span("h2d"):
+            sharding = NamedSharding(self.mesh, P(None, DATA_AXIS, SEQ_AXIS))
+            if self.procs == 1:
+                return jax.device_put(np_chunk, sharding)
+            return jax.make_array_from_process_local_data(sharding, np_chunk)
 
     # ------------------------------------------------- resident-corpus hooks
     def _build_resident(self):
@@ -618,11 +622,12 @@ class ShardedTrainer(Trainer):
         )
 
     def _place(self, local_rows: np.ndarray) -> jnp.ndarray:
-        if self.procs == 1:
-            return jax.device_put(local_rows, self.token_sharding)
-        return jax.make_array_from_process_local_data(
-            self.token_sharding, local_rows
-        )
+        with self.phases.span("h2d"):
+            if self.procs == 1:
+                return jax.device_put(local_rows, self.token_sharding)
+            return jax.make_array_from_process_local_data(
+                self.token_sharding, local_rows
+            )
 
     def _post_step(self, state: TrainState) -> None:
         cfg = self.config

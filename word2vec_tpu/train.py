@@ -31,6 +31,8 @@ from .data.batcher import (
 )
 from .data.vocab import Vocab
 from .models.params import Params, init_params
+from .obs.health import HealthMonitor, health_record
+from .obs.phases import PhaseRecorder
 from .ops.tables import DeviceTables
 from .ops.train_step import jit_train_step
 
@@ -55,6 +57,13 @@ class TrainReport:
     #: per-step path, which never consults it) — mode/resolved/budget_bytes/
     #: corpus_bytes, for attributing A/B throughput differences
     resident: Optional[Dict] = None
+    #: phase-timing breakdown (obs/phases.PhaseRecorder.report): per-phase
+    #: p50/p90 over batcher_wait / h2d / dispatch / device_wait / checkpoint
+    #: plus the input-bound-vs-compute-bound verdict
+    phases: Optional[Dict] = None
+    #: health-counter summary (obs/health.HealthMonitor.summary):
+    #: observations, non-finite steps, max streak, cumulative grad norm
+    health: Optional[Dict] = None
 
 
 class Trainer:
@@ -88,6 +97,11 @@ class Trainer:
         self.vocab = vocab
         self.corpus = corpus
         self.log_fn = log_fn
+        # phase-timing spans (obs/phases.py); reset per train() run. Created
+        # before anything else because the batch-placement hooks record into
+        # it from the prefetch producer thread.
+        self.phases = PhaseRecorder()
+        self._health: Optional[HealthMonitor] = None
         if config.autotune != "off":
             # Resolve the execution plan BEFORE anything shape-dependent is
             # built: cached plans apply with zero probe cost, probe mode
@@ -225,9 +239,13 @@ class Trainer:
         self, batcher: BatchIterator, epoch_index: int, skip: int = 0
     ) -> Iterator[Tuple[jnp.ndarray, int]]:
         """Yield (device-ready tokens, words) for one epoch, `skip` optimizer
-        steps in (mid-epoch checkpoint resume)."""
+        steps in (mid-epoch checkpoint resume). Runs in the prefetch
+        PRODUCER thread, so the h2d span lands there (overlapped time, not a
+        loop stall — see obs/phases.py)."""
         for tokens, words in batcher.epoch(epoch_index, skip):
-            yield jnp.asarray(tokens), words
+            with self.phases.span("h2d"):
+                placed = jnp.asarray(tokens)
+            yield placed, words
 
     def _resume_skip(self, state: TrainState, batcher: BatchIterator) -> int:
         """Steps of state.epoch already done per the checkpointed step
@@ -299,6 +317,10 @@ class Trainer:
         last_metrics = None
         self._warned_nonfinite = False
         self._tail_drop_streak = 0
+        self.phases.reset()
+        self._health = HealthMonitor(
+            cfg.divergence_budget, micro_steps=cfg.micro_steps
+        )
         chunk_len = self._resolve_chunk_len(batcher)
         if chunk_len > 1:
             return self._train_chunked(
@@ -325,34 +347,41 @@ class Trainer:
         # state.epoch = epoch in progress; a mid-epoch checkpoint re-enters it
         # at the first undone batch (_resume_skip)
         skip = self._resume_skip(state, batcher)
-        # hs tail-overflow observation is decoupled from the log cadence:
-        # like the chunked driver (_note_metrics), every step is an
-        # observation, so the warning fires with log_every=0 too. The fetch
-        # lags one dispatched step behind so the device pipeline is never
-        # stalled to read the scalar.
-        pending_tail: Optional[Tuple[jnp.ndarray, int]] = None
+        # Health/tail observation is decoupled from the log cadence: like
+        # the chunked driver (_note_metrics), every step is an observation,
+        # so the tail warning and the divergence tripwire fire with
+        # log_every=0 too. The fetch lags one dispatched step behind so the
+        # device pipeline is never stalled to read the scalars — the ONLY
+        # per-step host sync, pinned by tests/test_obs.py.
+        pending_obs: Optional[Tuple[Dict, int]] = None
 
-        def drain_tail() -> None:
-            nonlocal pending_tail
-            if pending_tail is None:
+        def drain_obs() -> None:
+            nonlocal pending_obs
+            if pending_obs is None:
                 return
-            val, at_step = pending_tail
-            pending_tail = None
-            self._note_tail_dropped(float(jax.device_get(val)), at_step)
+            dev_metrics, at_step = pending_obs
+            pending_obs = None
+            with self.phases.span("device_wait"):
+                m = jax.device_get(dev_metrics)
+            self._observe_step(m, at_step)
 
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
-            for tokens, words in prefetch(self._batches(batcher, epoch, skip)):
+            for tokens, words in self.phases.timed_iter(
+                prefetch(self._batches(batcher, epoch, skip)), "batcher_wait"
+            ):
                 alpha = jnp.float32(self.alpha_at(state.words_done))
                 key = jax.random.fold_in(base_key, state.step)
-                state.params, metrics = self.step_fn(state.params, tokens, key, alpha)
+                with self.phases.span("dispatch"):
+                    state.params, metrics = self.step_fn(
+                        state.params, tokens, key, alpha
+                    )
                 last_metrics = metrics
                 state.step += 1
                 state.words_done += words
                 self._post_step(state)
-                drain_tail()
-                if "hs_tail_dropped" in metrics:
-                    pending_tail = (metrics["hs_tail_dropped"], state.step)
+                drain_obs()
+                pending_obs = (metrics, state.step)
                 if log_every and state.step % log_every == 0:
                     m = jax.device_get(metrics)
                     loss = float(m["loss_sum"]) / max(1.0, float(m["pairs"]))
@@ -385,16 +414,20 @@ class Trainer:
                             rec["clip_engaged_rows"] = float(m["clip_engaged"])
                         if "hs_tail_dropped" in m:
                             rec["hs_tail_dropped"] = float(m["hs_tail_dropped"])
+                        rec.update(health_record(m, cfg.micro_steps))
+                        ph = self.phases.snapshot()
+                        if ph:
+                            rec["phases"] = ph
                         self.log_fn(rec)
                 if checkpoint_every and checkpoint_cb and state.step % checkpoint_every == 0:
-                    checkpoint_cb(state)
+                    self._run_checkpoint(checkpoint_cb, state)
             state.epoch = epoch + 1  # epoch completed
             skip = 0  # only the resumed epoch re-enters mid-way
 
         self._finalize(state)
         # ensure all device work is done before timing
         jax.block_until_ready(state.params)
-        drain_tail()  # the last step's overflow observation still counts
+        drain_obs()  # the last step's health/overflow observation counts
         wall = time.perf_counter() - t0
         final_loss = float("nan")
         if last_metrics is not None:
@@ -408,6 +441,8 @@ class Trainer:
             final_loss=final_loss,
             loss_history=loss_hist,
             resident=self.resident_resolution,
+            phases=self.phases.report(),
+            health=self._health.summary(),
         )
         return state, report
 
@@ -449,31 +484,39 @@ class Trainer:
         if self._resident is None and self.chunk_fn is None:
             self.chunk_fn = self._build_chunk_fn()
         self._last_chunk_loss = float("nan")
-        pending: Optional[Tuple[Dict, int, int, float, int, bool]] = None
+        pending: Optional[Tuple[Dict, int, int, float, int, bool, int]] = None
 
         def drain() -> None:
             nonlocal pending
             if pending is None:
                 return
-            metrics, at_step, at_epoch, at_alpha, at_words, do_log = pending
+            (metrics, at_step, at_epoch, at_alpha, at_words, do_log,
+             real_steps) = pending
             pending = None
-            m = jax.device_get(metrics)  # blocks only on an already-queued chunk
+            with self.phases.span("device_wait"):
+                # blocks only on an already-queued chunk
+                m = jax.device_get(metrics)
             self._note_metrics(
-                m, at_step, at_epoch, at_alpha, at_words, t0, loss_hist, do_log
+                m, at_step, at_epoch, at_alpha, at_words, t0, loss_hist,
+                do_log, real_steps,
             )
 
         skip = self._resume_skip(state, batcher)
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
-            for words_list, dispatch in self._chunk_dispatches(
-                state, batcher, base_key, epoch, skip, chunk_len
+            for words_list, dispatch in self.phases.timed_iter(
+                self._chunk_dispatches(
+                    state, batcher, base_key, epoch, skip, chunk_len
+                ),
+                "batcher_wait",
             ):
                 alphas = np.empty(chunk_len, np.float32)
                 wd = state.words_done
                 for i in range(chunk_len):
                     alphas[i] = self.alpha_at(wd)
                     wd += words_list[i] if i < len(words_list) else 0
-                state.params, metrics = dispatch(jnp.asarray(alphas))
+                with self.phases.span("dispatch"):
+                    state.params, metrics = dispatch(jnp.asarray(alphas))
                 prev_step = state.step
                 state.step += len(words_list)
                 state.words_done = wd
@@ -487,7 +530,8 @@ class Trainer:
                 )
                 pending = (
                     metrics, state.step, epoch,
-                    float(alphas[len(words_list) - 1]), state.words_done, do_log,
+                    float(alphas[len(words_list) - 1]), state.words_done,
+                    do_log, len(words_list),
                 )
                 if (
                     checkpoint_every
@@ -495,7 +539,7 @@ class Trainer:
                     and state.step // checkpoint_every
                     != prev_step // checkpoint_every
                 ):
-                    checkpoint_cb(state)
+                    self._run_checkpoint(checkpoint_cb, state)
             state.epoch = epoch + 1
             skip = 0  # only the resumed epoch re-enters mid-way
 
@@ -511,6 +555,8 @@ class Trainer:
             final_loss=self._last_chunk_loss,
             loss_history=loss_hist,
             resident=self.resident_resolution,
+            phases=self.phases.report(),
+            health=self._health.summary() if self._health else None,
         )
 
     def _build_chunk_fn(self):
@@ -663,8 +709,27 @@ class Trainer:
 
         Called from the prefetch PRODUCER thread so the transfer overlaps the
         consumer's dispatched compute; must therefore be thread-safe (pure
-        jax.device_put / asarray calls are)."""
-        return jnp.asarray(np_chunk)
+        jax.device_put / asarray calls are; PhaseRecorder locks)."""
+        with self.phases.span("h2d"):
+            return jnp.asarray(np_chunk)
+
+    def _observe_step(self, m: Dict, at_step: int) -> None:
+        """One fetched per-step metrics dict, observed through the lagged
+        drain — the shared funnel for the hs tail warning and the health
+        monitor's divergence tripwire (obs/health.py). Raises
+        DivergenceError when the non-finite streak exceeds the budget."""
+        if "hs_tail_dropped" in m:
+            self._note_tail_dropped(float(np.sum(m["hs_tail_dropped"])), at_step)
+        if self._health is not None:
+            self._health.observe(m, at_step)
+
+    def _run_checkpoint(self, checkpoint_cb, state: TrainState) -> None:
+        """Checkpoint callback under a phase span, noting the landing step
+        as the divergence tripwire's last-good hint."""
+        with self.phases.span("checkpoint"):
+            checkpoint_cb(state)
+        if self._health is not None:
+            self._health.checkpoint_hint = f"step {state.step}"
 
     def _note_tail_dropped(self, dropped: float, at_step: int) -> None:
         """Escalate persistent two-tier hs tail overflow from a metric to a
@@ -706,11 +771,14 @@ class Trainer:
         t0: float,
         loss_hist: List[float],
         do_log: bool,
+        real_steps: Optional[int] = None,
     ) -> None:
         """Aggregate a fetched chunk's per-step metrics into loss history,
-        the divergence warning, and the log stream (chunk boundaries are the
-        logging granularity of the chunked driver; do_log mirrors the
-        per-step loop's `step % log_every == 0` gate)."""
+        the divergence warning/tripwire, and the log stream (chunk
+        boundaries are the logging granularity of the chunked driver;
+        do_log mirrors the per-step loop's `step % log_every == 0` gate).
+        `real_steps` = non-padded scan slots, for the health monitor's
+        step attribution."""
         loss_sum = float(np.sum(m["loss_sum"]))
         pairs = float(np.sum(m["pairs"]))
         loss = loss_sum / max(1.0, pairs)
@@ -732,6 +800,10 @@ class Trainer:
             self._note_tail_dropped(
                 float(np.sum(m["hs_tail_dropped"])), at_step
             )
+        if self._health is not None:
+            # per-scan-step divergence tracking (same drain, no extra sync);
+            # raises DivergenceError past the consecutive-non-finite budget
+            self._health.observe_chunk(m, at_step, real_steps)
         if not do_log:
             return
         loss_hist.append(loss)
@@ -757,4 +829,8 @@ class Trainer:
                 # (config.hs_tail_slots): slots whose updates were dropped
                 # by the +6-sigma bound — statistically 0 on real corpora
                 rec["hs_tail_dropped"] = float(np.sum(m["hs_tail_dropped"]))
+            rec.update(health_record(m, self.config.micro_steps))
+            ph = self.phases.snapshot()
+            if ph:
+                rec["phases"] = ph
             self.log_fn(rec)

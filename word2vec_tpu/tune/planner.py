@@ -43,6 +43,10 @@ from . import cost_model
 # fingerprint: everything that invalidates a cached plan but is neither a
 # cache-key dimension nor a plan dimension (see cache.py). schema bumps
 # force re-probes when the planner's own semantics change.
+# Deliberately EXCLUDED: the telemetry fields (health_metrics,
+# divergence_budget) — the full health counters add a roughly uniform
+# per-step cost that does not reorder step-shape candidates, and keying on
+# them would orphan every banked seed plan for an observability overlay.
 FINGERPRINT_FIELDS = (
     "model", "train_method", "negative", "window", "max_sentence_len",
     "dtype", "compute_dtype", "stochastic_rounding", "slab_scatter",

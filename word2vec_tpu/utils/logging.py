@@ -2,21 +2,33 @@
 
 The reference's observability is a single in-place printf of alpha + percent
 every 100 sentences (Word2Vec.cpp:382-385). Here every log record is a dict
-(step, epoch, alpha, loss, progress, words_per_sec) routed through a callback;
-`progress_logger` renders the reference-style single-line console view with
-the north-star words/sec added, and `jsonl_logger` writes machine-readable
-JSONL for dashboards.
+(step, epoch, alpha, loss, progress, words_per_sec, plus whatever health /
+phase telemetry the run enables) routed through a callback; `progress_logger`
+renders the reference-style single-line console view with the north-star
+words/sec added, and `jsonl_logger` writes machine-readable JSONL for
+dashboards.
+
+Sinks are composed through `obs.export.MetricsHub` (one fan-out callable,
+one close point); `tee` remains for direct library use. Every sink that
+holds a resource exposes `.close()` so the hub — or an atexit fallback —
+can flush it: a jsonl log that loses its tail on interpreter teardown is
+worse than no log.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import sys
 from typing import Callable, Dict, IO, Optional
 
 
 def progress_logger(stream: IO = sys.stderr) -> Callable[[Dict], None]:
-    """Reference-style one-line progress (Word2Vec.cpp:384) + words/sec."""
+    """Reference-style one-line progress (Word2Vec.cpp:384) + words/sec.
+
+    Tolerates partial records: telemetry event records and health-only
+    records need not carry loss/words_per_sec, and a missing key renders as
+    its neutral value instead of raising KeyError mid-training."""
 
     def log(m: Dict) -> None:
         if "event" in m:
@@ -26,8 +38,10 @@ def progress_logger(stream: IO = sys.stderr) -> Callable[[Dict], None]:
             stream.write(f"\n[{m['event']}] {detail}\n")
         else:
             stream.write(
-                f"\ralpha: {m['alpha']:.6f}  progress: {100 * m.get('progress', 0):6.2f}%  "
-                f"loss: {m['loss']:.4f}  {m['words_per_sec']:,.0f} words/sec "
+                f"\ralpha: {m.get('alpha', float('nan')):.6f}  "
+                f"progress: {100 * m.get('progress', 0):6.2f}%  "
+                f"loss: {m.get('loss', float('nan')):.4f}  "
+                f"{m.get('words_per_sec', 0.0):,.0f} words/sec "
             )
         stream.flush()
 
@@ -35,34 +49,78 @@ def progress_logger(stream: IO = sys.stderr) -> Callable[[Dict], None]:
 
 
 def jsonl_logger(path: str) -> Callable[[Dict], None]:
+    """Append machine-readable JSONL records to `path`.
+
+    The returned callable carries a `.close()` (idempotent) that flushes and
+    releases the file handle; it is also registered with atexit as a
+    fallback, so a driver that never reaches its close point still flushes
+    the log on interpreter exit instead of leaking the handle."""
     f = open(path, "a", buffering=1)
+    state = {"open": True}
 
     def log(m: Dict) -> None:
-        f.write(json.dumps(m) + "\n")
+        if state["open"]:
+            f.write(json.dumps(m, default=str) + "\n")
 
+    def close() -> None:
+        if state["open"]:
+            state["open"] = False
+            try:
+                f.flush()
+            finally:
+                f.close()
+
+    log.close = close
+    atexit.register(close)
     return log
 
 
 def tensorboard_logger(logdir: str) -> Callable[[Dict], None]:
-    """Scalar summaries (loss, alpha, words/sec, progress) per step for
-    TensorBoard — the SURVEY §5 "optional TensorBoard scalars" hook. Uses
-    tensorboardX, which writes standard event files without a TF dependency.
+    """Scalar summaries (loss, alpha, words/sec, progress, health counters)
+    per step for TensorBoard — the SURVEY §5 "optional TensorBoard scalars"
+    hook. Uses tensorboardX, which writes standard event files without a TF
+    dependency; when tensorboardX is not installed the sink degrades to a
+    one-line warning and a no-op (a missing optional viewer must not kill a
+    training run that only incidentally asked for it).
     """
-    from tensorboardX import SummaryWriter
+    try:
+        from tensorboardX import SummaryWriter
+    except ImportError:
+        import warnings
+
+        warnings.warn(
+            "tensorboardX is not installed; TensorBoard logging to "
+            f"{logdir!r} is disabled (pip install tensorboardX to enable)",
+            stacklevel=2,
+        )
+
+        def noop(m: Dict) -> None:
+            pass
+
+        noop.close = lambda: None
+        return noop
 
     writer = SummaryWriter(logdir)
 
     def log(m: Dict) -> None:
+        if "event" in m:
+            return
         step = int(m.get("step", 0))
-        for key in ("loss", "alpha", "words_per_sec", "progress"):
-            if key in m:
-                writer.add_scalar(f"train/{key}", float(m[key]), step)
+        for key, val in m.items():
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            if key in ("step", "epoch"):
+                continue
+            writer.add_scalar(f"train/{key}", float(val), step)
         writer.flush()
 
+    log.close = writer.close
     return log
 
 
 def tee(*loggers: Optional[Callable[[Dict], None]]) -> Callable[[Dict], None]:
+    """Minimal fan-out for direct library use; drivers use obs.MetricsHub
+    (same contract, plus sink close handling)."""
     active = [l for l in loggers if l is not None]
 
     def log(m: Dict) -> None:

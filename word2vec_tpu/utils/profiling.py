@@ -53,6 +53,27 @@ def annotate(name: str):
     return jax.profiler.TraceAnnotation(name)
 
 
+def lap_stats(laps: List[float]) -> dict:
+    """Percentile stats over a list of wall-clock laps (seconds -> ms).
+
+    Shared by StepTimer and obs/phases.PhaseRecorder so the p50/p90 a bench
+    reports and the p50/p90 a phase breakdown reports are the same math
+    (nearest-rank percentile: ceil(q*n) - 1)."""
+    if not laps:
+        return {"laps": 0}
+    laps = sorted(laps)
+    n = len(laps)
+    p90 = max(0, -(-9 * n // 10) - 1)
+    return {
+        "laps": n,
+        "mean_ms": 1e3 * sum(laps) / n,
+        "p50_ms": 1e3 * laps[n // 2],
+        "p90_ms": 1e3 * laps[p90],
+        "min_ms": 1e3 * laps[0],
+        "max_ms": 1e3 * laps[-1],
+    }
+
+
 class StepTimer:
     """Steady-state step timing: call `lap(result)` once per step.
 
@@ -77,20 +98,7 @@ class StepTimer:
         self._t = now
 
     def stats(self) -> dict:
-        if not self.laps:
-            return {"laps": 0}
-        laps = sorted(self.laps)
-        n = len(laps)
-        # nearest-rank percentile: ceil(q*n) - 1
-        p90 = max(0, -(-9 * n // 10) - 1)
-        return {
-            "laps": n,
-            "mean_ms": 1e3 * sum(laps) / n,
-            "p50_ms": 1e3 * laps[n // 2],
-            "p90_ms": 1e3 * laps[p90],
-            "min_ms": 1e3 * laps[0],
-            "max_ms": 1e3 * laps[-1],
-        }
+        return lap_stats(self.laps)
 
 
 # --------------------------------------------------------------------------

@@ -448,6 +448,143 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     return record
 
 
+def run_fault_drill(args: argparse.Namespace, platform_note: str | None) -> dict:
+    """`--faults`: measure RECOVERY OVERHEAD instead of raw throughput.
+
+    Two supervised end-to-end Trainer runs on the same synthetic corpus —
+    one clean, one with the fault plan active (NaN injection, checkpoint
+    OSError, stalls; resilience/faults.py) under auto-recovery
+    (resilience/supervisor.py). The emitted record carries both walls and
+    their difference: what a divergence-rollback-retry actually costs at
+    this shape, as a number that can be banked and compared round over
+    round. The clean run is preceded by an untimed warmup pass so compile
+    time doesn't masquerade as (negative) fault overhead.
+    """
+    import tempfile
+
+    import jax
+
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.data.batcher import BatchIterator, PackedCorpus
+    from word2vec_tpu.io.checkpoint import save_checkpoint
+    from word2vec_tpu.resilience import faults as faults_mod
+    from word2vec_tpu.resilience.faults import FaultPlan
+    from word2vec_tpu.resilience.shutdown import ShutdownHandler
+    from word2vec_tpu.resilience.supervisor import Supervisor
+    from word2vec_tpu.train import Trainer
+    from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+    # the drill runs the full pipeline 3x (warmup, clean, faulted): keep the
+    # corpus smoke-sized unless the caller explicitly sized it down further
+    tokens = min(args.tokens, 300_000)
+    cfg = Word2VecConfig(
+        model=args.model,
+        train_method=args.train_method,
+        negative=args.negative if args.train_method == "ns" else 0,
+        word_dim=args.dim,
+        window=args.window,
+        batch_rows=args.batch_rows,
+        max_sentence_len=args.max_len,
+        chunk_cap=args.chunk_cap,
+        band_backend=args.band_backend,
+        prng_impl=args.prng,
+        divergence_budget=4,
+        seed=0,
+    )
+    vocab = zipf_vocab(71000, 17_000_000)
+    flat = np.concatenate(zipf_corpus_ids(vocab, tokens, seed=0))
+    ids = [flat[i:i + 1000] for i in range(0, len(flat), 1000)]
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+
+    spe = BatchIterator(
+        corpus, cfg.batch_rows, cfg.max_sentence_len
+    ).steps_per_epoch()
+    spec = args.faults
+    if spec == "default":
+        # one NaN divergence past the mid-epoch checkpoint: the canonical
+        # rollback-and-retry scenario
+        spec = f"nan@{max(1, (spe * 3) // 5)}"
+    checkpoint_every = max(2, spe // 4)
+
+    trainer = Trainer(cfg, vocab, corpus)
+    handler = ShutdownHandler().install()  # sigterm faults stop cooperatively
+    trainer.install_shutdown(handler)
+    base = tempfile.mkdtemp(prefix="w2v_fault_drill_")
+
+    def timed_run(name: str, plan: FaultPlan | None):
+        ck = os.path.join(base, f"ck_{name}")
+
+        def cb(s):
+            save_checkpoint(ck, s, trainer.config, vocab, keep=2)
+
+        trainer.fault_plan = plan
+        prev = faults_mod.activate(plan) if plan is not None else None
+        t0 = time.perf_counter()
+        try:
+            if plan is not None:
+                sup = Supervisor(
+                    trainer, checkpoint_dir=ck, max_retries=2,
+                    alpha_scale=0.5,
+                )
+                _, rep = sup.run(
+                    state=trainer.init_state(), log_every=0,
+                    checkpoint_cb=cb, checkpoint_every=checkpoint_every,
+                )
+            else:
+                _, rep = trainer.train(
+                    state=trainer.init_state(), log_every=0,
+                    checkpoint_cb=cb, checkpoint_every=checkpoint_every,
+                )
+        finally:
+            if plan is not None:
+                faults_mod.activate(prev)
+            trainer.fault_plan = None
+        return time.perf_counter() - t0, rep
+
+    try:
+        timed_run("warmup", None)  # compile + checkpoint paths warm
+        clean_wall, clean_rep = timed_run("clean", None)
+        plan = FaultPlan.parse(spec)
+        fault_wall, fault_rep = timed_run("faulted", plan)
+    finally:
+        handler.uninstall()
+
+    dev = jax.devices()[0]
+    key = config_key(
+        args.model, args.train_method, args.dim, args.window, cfg.negative
+    )
+    overhead = fault_wall - clean_wall
+    record = {
+        "metric": f"{key} recovery overhead ({tokens // 1000}k zipf, "
+                  f"{dev.platform})",
+        "value": round(overhead, 3),
+        "unit": "s",
+        "vs_baseline": None,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "faults": spec,
+        "fault_log": plan.log,
+        "clean_wall_s": round(clean_wall, 3),
+        "faulted_wall_s": round(fault_wall, 3),
+        "overhead_pct": round(100.0 * overhead / max(clean_wall, 1e-9), 1),
+        "clean_words_per_sec": round(clean_rep.words_per_sec, 1),
+        # effective: the CLEAN run's useful words over the FAULTED wall —
+        # the last retry's own words_per_sec would count resumed progress
+        # it never retrained and flatter the faulted run
+        "faulted_effective_words_per_sec": round(
+            clean_rep.total_words / max(fault_wall, 1e-9), 1
+        ),
+        "recoveries": fault_rep.recoveries or [],
+        "interrupted": fault_rep.interrupted,
+        "divergence_budget": cfg.divergence_budget,
+        "checkpoint_every_steps": checkpoint_every,
+        "steps_per_epoch": spe,
+    }
+    if platform_note:
+        record["tpu_fallback_reason"] = platform_note
+    return record
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
     # text8 is ~17M tokens; the synthetic default matches it so the headline
@@ -518,6 +655,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--plan-cache", default="",
                     help="plan-cache JSON path (default: $W2V_PLAN_CACHE or "
                     "~/.cache/word2vec_tpu/plan_cache.json)")
+    ap.add_argument("--faults", nargs="?", const="default", default="",
+                    metavar="SPEC",
+                    help="recovery-overhead drill instead of the throughput "
+                    "bench: run clean vs fault-injected+auto-recovered and "
+                    "emit the measured overhead (resilience/faults.py spec; "
+                    "bare --faults = one NaN divergence past the mid-epoch "
+                    "checkpoint)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke preset: shrink the synthetic corpus to "
                     "~60s of CPU wall time (still the real pipeline at the "
@@ -579,7 +723,10 @@ def inner_main(args: argparse.Namespace) -> None:
             # jax.config call; config.update after import wins over both.
             jax.config.update("jax_platforms", "cpu")
         # --prng flows through cfg.prng_impl into explicit key impls (run())
-        emit(run(args, args.fallback_reason))
+        if args.faults:
+            emit(run_fault_drill(args, args.fallback_reason))
+        else:
+            emit(run(args, args.fallback_reason))
     except Exception as e:  # noqa: BLE001 — the contract is one JSON line, always
         import traceback
 
@@ -683,6 +830,8 @@ def main() -> None:
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:
         child_cmd += [flag, str(val)]
+    if args.faults:
+        child_cmd += ["--faults", args.faults]
     try:
         out = subprocess.run(
             child_cmd, capture_output=True, text=True, timeout=args.run_timeout

@@ -130,3 +130,68 @@ def test_checkpoint_roundtrip(tmp_path, vocab):
     save_checkpoint(path, state, cfg, vocab)
     s3, _, _ = load_checkpoint(path)
     assert s3.step == 18
+
+
+# --------------------------- malformed-input diagnostics (resilience PR) ---
+class TestMalformedEmbeddingFiles:
+    """Loader errors must name the file and position, not surface as
+    IndexError/struct.error from deep inside the parse."""
+
+    def test_text_bad_header(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("not a header\nfoo 1 2 3\n")
+        with pytest.raises(ValueError, match=r"bad\.txt.*line 1.*header"):
+            load_embeddings_text(str(p))
+
+    def test_text_header_too_short(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("3\n")
+        with pytest.raises(ValueError, match=r"line 1.*malformed header"):
+            load_embeddings_text(str(p))
+
+    def test_text_row_dim_mismatch_names_line(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("2 4\nalpha 1 2 3 4\nbeta 1 2\n")
+        with pytest.raises(ValueError, match=r"line 3.*'beta'.*2 values.*4"):
+            load_embeddings_text(str(p))
+
+    def test_text_truncated_rows(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("3 2\nalpha 1 2\n")
+        with pytest.raises(ValueError, match=r"line 3.*ends after 1 rows"):
+            load_embeddings_text(str(p))
+
+    def test_text_non_numeric_value(self, tmp_path):
+        p = tmp_path / "bad.txt"
+        p.write_text("1 2\nalpha 1 oops\n")
+        with pytest.raises(ValueError, match=r"line 2.*non-numeric"):
+            load_embeddings_text(str(p))
+
+    def test_binary_truncated_header(self, tmp_path):
+        p = tmp_path / "bad.bin"
+        p.write_bytes(b"\x01\x02")
+        with pytest.raises(ValueError, match=r"bad\.bin.*truncated header"):
+            load_embeddings_binary(str(p))
+
+    def test_binary_truncated_row_names_word(self, tmp_path, vocab, matrix):
+        p = str(tmp_path / "v.bin")
+        save_embeddings_binary(p, vocab.words, matrix)
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[:-12])  # cut into the last row
+        with pytest.raises(ValueError, match=r"word #2 \('fox'\).*truncated row"):
+            load_embeddings_binary(p)
+
+    def test_binary_wrong_layout_detected(self, tmp_path, vocab, matrix):
+        """A google-layout file read as reference layout yields absurd raw
+        int64 dims — the loader must refuse with a layout hint, not
+        allocate petabytes."""
+        p = str(tmp_path / "v.bin")
+        save_embeddings_binary(p, vocab.words, matrix, layout="google")
+        with pytest.raises(ValueError, match="binary-layout"):
+            load_embeddings_binary(p, layout="reference")
+
+    def test_binary_google_garbage_header(self, tmp_path):
+        p = tmp_path / "bad.bin"
+        p.write_bytes(b"12 x\nrest")
+        with pytest.raises(ValueError, match="non-integer header"):
+            load_embeddings_binary(str(p), layout="google")

@@ -193,7 +193,32 @@ def build_parser() -> argparse.ArgumentParser:
                    "similarity (benchmarks/CBOW_GRADED_CALIB_r5.jsonl)")
     p.add_argument("--checkpoint-dir", metavar="DIR")
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="STEPS")
-    p.add_argument("--resume", metavar="DIR", help="resume from checkpoint dir")
+    p.add_argument("--checkpoint-keep", type=int, default=1, metavar="N",
+                   help="previous checkpoints retained as rollback targets "
+                        "(<dir>.old ... .old{N}); --auto-recover depends on "
+                        "N >= 1 (io/checkpoint.py retention)")
+    p.add_argument("--resume", metavar="DIR", help="resume from checkpoint "
+                   "dir (integrity-checked; a corrupt checkpoint is "
+                   "quarantined as .corrupt and the .old backup loads "
+                   "instead)")
+    p.add_argument("--auto-recover", type=int, default=0, metavar="N",
+                   help="supervised divergence recovery: on DivergenceError "
+                        "roll back to the last-good checkpoint (integrity + "
+                        "finite-params validated, .old fallback), rescale "
+                        "alpha (--recover-alpha-scale), advance the shuffle "
+                        "seed, and retry up to N times before exiting rc=2 "
+                        "(resilience/supervisor.py; needs --checkpoint-dir "
+                        "+ --checkpoint-every for rollback targets)")
+    p.add_argument("--recover-alpha-scale", type=float, default=0.5,
+                   metavar="S",
+                   help="multiply init_alpha by S on every auto-recovery "
+                        "(1.0 = keep the schedule)")
+    p.add_argument("--faults", metavar="SPEC", default="",
+                   help="fault-injection plan for chaos testing "
+                        "(resilience/faults.py): comma-separated "
+                        "kind[@step][:key=val], e.g. 'nan@40,sigterm@80' or "
+                        "'ckpt_oserror:times=2,stall@10:secs=0.5'; or a "
+                        ".json plan file")
     p.add_argument("--eval-ws353", metavar="FILE",
                    help="WordSim-353 csv/tsv for post-train eval")
     p.add_argument("--eval-analogy", metavar="FILE",
@@ -225,8 +250,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "config.divergence_budget; observed every step via "
                         "the lagged metrics drain, even with --log-every 0)")
     p.add_argument("--inject-nan", action="store_true", help=argparse.SUPPRESS)
-    # ^ fault injection for the divergence tripwire: poisons the initial
-    #   params with NaN so CI can assert the DivergenceError path end-to-end
+    # ^ legacy alias for `--faults nan@0` (poison the initial params), kept
+    #   so existing CI invocations of the divergence tripwire don't break
     p.add_argument("--tensorboard", metavar="DIR",
                    help="write TensorBoard scalar summaries to DIR "
                         "(loss/alpha/words_per_sec/progress + health "
@@ -281,11 +306,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .config import Word2VecConfig
     from .data.batcher import PackedCorpus
     from .data.vocab import Vocab
-    from .io.checkpoint import load_checkpoint, save_checkpoint
+    from .io.checkpoint import CheckpointError, load_checkpoint, save_checkpoint
     from .io.embeddings import save_word2vec
     from .models.params import export_matrix
+    from .resilience.faults import Fault, FaultPlan
     from .train import Trainer
     from .utils.logging import progress_logger
+
+    # Fault plan + resilience knobs: validated before any expensive work
+    # (a chaos run with a typo'd spec must fail in milliseconds, not after
+    # the corpus scan).
+    try:
+        fault_plan = FaultPlan.parse(args.faults)
+        if args.inject_nan:  # legacy alias
+            fault_plan.faults.append(Fault("nan", step=0))
+    except (ValueError, OSError) as e:
+        print(f"error: bad --faults spec: {e}", file=sys.stderr)
+        return 1
+    if args.checkpoint_keep < 0:
+        print("error: --checkpoint-keep must be >= 0", file=sys.stderr)
+        return 1
+    if args.auto_recover < 0:
+        print("error: --auto-recover must be >= 0", file=sys.stderr)
+        return 1
+    if args.auto_recover and not (0.0 < args.recover_alpha_scale <= 1.0):
+        print("error: --recover-alpha-scale must be in (0, 1]", file=sys.stderr)
+        return 1
 
     # Resume: the checkpoint's config and vocab are authoritative — resuming
     # against a rebuilt vocab would silently re-attribute embedding rows; and
@@ -295,7 +341,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ck_cfg = None
     ck_vocab = None
     if args.resume:
-        state, ck_cfg, ck_vocab = load_checkpoint(args.resume)
+        try:
+            state, ck_cfg, ck_vocab = load_checkpoint(args.resume)
+        except CheckpointError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
         if not args.quiet:
             print(f"resumed from {args.resume} at step {state.step}")
 
@@ -611,14 +661,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         # checkpoints always hold unreplicated [V, d] tables; re-shard them
         trainer.import_params(state.params, state)
 
-    if args.inject_nan:
-        # fault injection (hidden flag): poison the initial params so the
-        # divergence tripwire path is exercisable end-to-end from CI
-        state = state or trainer.init_state()
-        state.params = jax.tree.map(
-            lambda v: (v * float("nan")).astype(v.dtype), state.params
-        )
-
     def unreplicated(s: TrainState) -> TrainState:
         if hasattr(trainer, "export_params"):
             return TrainState(
@@ -631,10 +673,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.checkpoint_dir and args.checkpoint_every:
         def ckpt_cb(s):
             # unreplicated() may run the pmean sync — a collective — so ALL
-            # processes must enter it; only the file write is primary-gated
+            # processes must enter it; only the file write is primary-gated.
+            # trainer.config (not the captured cfg): a supervisor recovery
+            # may have rescaled alpha / advanced the seed, and the
+            # checkpoint must pin what the run is ACTUALLY using.
             snap = unreplicated(s)
             if is_primary:
-                save_checkpoint(args.checkpoint_dir, snap, cfg, vocab)
+                save_checkpoint(
+                    args.checkpoint_dir, snap, trainer.config, vocab,
+                    keep=args.checkpoint_keep,
+                )
 
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
@@ -644,11 +692,52 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .utils.profiling import trace
 
     from .obs.health import DivergenceError
+    from .obs.manifest import update_manifest
+    from .resilience import faults as _faults
+    from .resilience.shutdown import EXIT_PREEMPTED, ShutdownHandler
+
+    manifest_path = (
+        os.path.join(metrics_dir, "manifest.json") if metrics_dir else None
+    )
+
+    # Preemption-safe shutdown: SIGTERM/SIGINT request a cooperative stop at
+    # the next step boundary (multihost-agreed); the run then checkpoints
+    # and exits EXIT_PREEMPTED so a scheduler can requeue with --resume.
+    handler = ShutdownHandler().install()
+    trainer.install_shutdown(handler)
+
+    # Supervised auto-recovery: DivergenceError rolls back to the last-good
+    # checkpoint and retries instead of killing the run.
+    run_train = trainer.train
+    supervisor = None
+    if args.auto_recover:
+        from .resilience.supervisor import Supervisor
+
+        if not (args.checkpoint_dir and args.checkpoint_every) and not args.quiet:
+            print(
+                "warning: --auto-recover without --checkpoint-dir/"
+                "--checkpoint-every has no rollback target; recovery "
+                "restarts from a fresh init",
+                file=sys.stderr,
+            )
+        supervisor = Supervisor(
+            trainer,
+            checkpoint_dir=args.checkpoint_dir,
+            max_retries=args.auto_recover,
+            alpha_scale=args.recover_alpha_scale,
+            log_fn=log_fn,
+        )
+        run_train = supervisor.run
+
+    prev_plan = None
+    if fault_plan:
+        trainer.fault_plan = fault_plan
+        prev_plan = _faults.activate(fault_plan)
 
     profile_ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
     try:
         with profile_ctx:
-            state, report = trainer.train(
+            state, report = run_train(
                 state=state,
                 log_every=args.log_every,
                 checkpoint_cb=ckpt_cb,
@@ -659,8 +748,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         # message; the metrics sinks are flushed so the JSONL/prom tail
         # shows the run's last healthy records
         print(f"error: DivergenceError: {e}", file=sys.stderr)
+        if manifest_path:
+            update_manifest(manifest_path, {
+                "shutdown": "diverged",
+                "divergence": e.record(),
+                "recoveries": supervisor.recoveries if supervisor else [],
+            })
         hub.close()
         return 2
+    finally:
+        # restore signal dispositions and the process-wide fault plan on
+        # every exit path — main() runs in-process under tests, and a
+        # leaked SIGTERM handler would outlive the run it protects
+        handler.uninstall()
+        if fault_plan:
+            _faults.activate(prev_plan)
     if report.health is not None or report.phases is not None:
         # final-summary event record: the run's verdict lands in the JSONL
         # tail (and the console, one line) without re-deriving it from logs
@@ -680,8 +782,52 @@ def main(argv: Optional[List[str]] = None) -> int:
                 verdict=report.phases.get("verdict"),
                 input_fraction=report.phases.get("input_fraction"),
             )
+        if report.interrupted:
+            summary["interrupted"] = report.interrupted
+        if report.recoveries:
+            summary["recoveries"] = len(report.recoveries)
         if log_fn is not None:
             log_fn(summary)
+
+    # How the run ended, recorded where how it started already is: the
+    # manifest distinguishes a clean completion from a preempted one, and
+    # carries any auto-recovery history.
+    preempted = report.interrupted == "preempted"
+    if manifest_path:
+        update_manifest(manifest_path, {
+            "shutdown": "preempted" if preempted else "clean",
+            "final_step": state.step,
+            "recoveries": report.recoveries or [],
+        })
+
+    if preempted:
+        # Preemption-safe exit: checkpoint the stopped-at-boundary state,
+        # skip export/eval (the run is not finished — a scheduler requeues
+        # it with --resume), exit with the distinct requeue rc.
+        if args.checkpoint_dir:
+            snap = unreplicated(state)  # collective-capable: all processes
+            if is_primary:
+                save_checkpoint(
+                    args.checkpoint_dir, snap, trainer.config, vocab,
+                    keep=args.checkpoint_keep,
+                )
+        sig = handler.signum
+        print(
+            f"preempted (signal {sig}): stopped at step {state.step}; "
+            + (
+                f"checkpoint saved to {args.checkpoint_dir}; requeue with "
+                f"--resume {args.checkpoint_dir}"
+                if args.checkpoint_dir
+                else "WARNING: no --checkpoint-dir, progress not persisted"
+            ),
+            file=sys.stderr,
+        )
+        if args.emit_device:
+            dev = jax.devices()[0]
+            print(f"device: {dev.platform} {dev.device_kind}", file=sys.stderr)
+        hub.close()
+        return EXIT_PREEMPTED
+
     if args.emit_device:
         dev = jax.devices()[0]
         print(f"device: {dev.platform} {dev.device_kind}", file=sys.stderr)
@@ -693,7 +839,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.checkpoint_dir:
         snap = unreplicated(state)  # collective-capable: all processes enter
         if is_primary:
-            save_checkpoint(args.checkpoint_dir, snap, cfg, vocab)
+            save_checkpoint(
+                args.checkpoint_dir, snap, trainer.config, vocab,
+                keep=args.checkpoint_keep,
+            )
 
     # matrix choice per main.cpp:196-202
     if hasattr(trainer, "export_params"):

@@ -213,6 +213,15 @@ def prefetch(iterator: Iterator, depth: int = 2) -> Iterator:
     generator early (exception in the training loop, GeneratorExit), the
     producer thread is signalled to stop rather than blocking forever on the
     bounded queue.
+
+    Producer-failure contract (pinned by tests/test_batcher.py): an
+    exception anywhere in the producer — the underlying iterator, batch
+    assembly, or a placed_prefetch device put — RE-RAISES in the consumer
+    after the items produced before it drain; it never hangs the consumer
+    or silently ends the epoch short. The consumer's queue wait is
+    additionally guarded against the producer dying without its sentinel
+    (interpreter teardown killing the daemon thread): a dead producer with
+    an empty queue raises RuntimeError instead of blocking forever.
     """
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     stop = threading.Event()
@@ -242,7 +251,18 @@ def prefetch(iterator: Iterator, depth: int = 2) -> Iterator:
     t.start()
     try:
         while True:
-            item = q.get()
+            try:
+                item = q.get(timeout=1.0)
+            except queue.Empty:
+                if not t.is_alive() and q.empty():
+                    # sentinel never arrived: the producer was torn down
+                    # without running its finally (daemon-thread kill)
+                    if err:
+                        raise err[0]
+                    raise RuntimeError(
+                        "prefetch producer thread died without a sentinel"
+                    )
+                continue
             if item is sentinel:
                 if err:
                     raise err[0]

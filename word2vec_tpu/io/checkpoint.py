@@ -1,4 +1,4 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume, with integrity manifests and last-good retention.
 
 The reference has only dormant partial persistence (vocab + embedding files,
 never reloaded by its CLI — SURVEY §5). Here checkpointing is first-class:
@@ -7,32 +7,193 @@ epoch, config} plus the vocabulary, so an interrupted run resumes exactly on
 the alpha schedule (Word2Vec.cpp:379-380 depends only on words_done).
 
 Format: one directory per checkpoint —
-    state.npz     all embedding tables + integer counters
-    config.json   the Word2VecConfig
-    vocab.txt     `index count word` lines (reference format, Word2Vec.cpp:171)
-Writes are atomic (tmp dir + rename), so a crash mid-save never corrupts the
-latest checkpoint.
+    state.npz       all embedding tables + integer counters
+    config.json     the Word2VecConfig
+    vocab.txt       `index count word` lines (reference format, Word2Vec.cpp:171)
+    integrity.json  sha256 of every other file, written last
+
+Durability contract (the resilience subsystem builds on all three):
+  * writes are atomic (tmp dir + rename) AND retried with bounded backoff
+    on OSError — a flaky network filesystem gets a few chances before the
+    failure surfaces;
+  * the previous checkpoint is RETAINED as `<path>.old` (and `.old2`, ...,
+    up to `keep`) instead of deleted after a successful write, so a
+    rollback target always exists — the divergence supervisor
+    (resilience/supervisor.py) depends on this;
+  * the loader verifies the sha256 manifest and the parse itself; a
+    truncated/corrupt checkpoint is QUARANTINED (renamed `<dir>.corrupt`)
+    and the loader falls back along the backup chain instead of crashing
+    the resume. Checkpoints without an integrity manifest (pre-manifest
+    writers) load with parse-level checking only.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import shutil
 import tempfile
-from typing import Optional, Tuple
+import time
+import zipfile
+from typing import Callable, Iterator, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..config import Word2VecConfig
 from ..data.vocab import Vocab
+from ..resilience import faults as _faults
 from ..train import TrainState
 
+INTEGRITY_FILE = "integrity.json"
 
+
+class CheckpointError(RuntimeError):
+    """A checkpoint failed integrity/parse validation — or, out of
+    load_checkpoint, every candidate did (the message lists what was
+    tried)."""
+
+
+#: everything a torn/corrupt checkpoint can raise out of the parse
+#: (BadZipFile: truncated npz; ValueError: short buffers / bad json /
+#: bad config fields; KeyError: missing arrays; OSError: unreadable files)
+_CORRUPT_ERRORS = (
+    CheckpointError,
+    zipfile.BadZipFile,
+    ValueError,
+    KeyError,
+    OSError,
+)
+
+
+# --------------------------------------------------------------- integrity
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def write_integrity(dirpath: str) -> dict:
+    """Hash every regular file in `dirpath` into its integrity manifest.
+    Called LAST during a save, so a manifest's presence certifies that every
+    named file was completely written when the hash was taken."""
+    files = {
+        e.name: _sha256(e.path)
+        for e in sorted(os.scandir(dirpath), key=lambda e: e.name)
+        if e.is_file() and e.name != INTEGRITY_FILE
+    }
+    man = {"schema": 1, "algo": "sha256", "files": files}
+    with open(os.path.join(dirpath, INTEGRITY_FILE), "w") as f:
+        json.dump(man, f, indent=2)
+        f.write("\n")
+    return man
+
+
+def verify_checkpoint(path: str) -> None:
+    """Validate `path` against its integrity manifest; raises CheckpointError
+    on a missing or mismatched file. A checkpoint without a manifest (older
+    writer) passes — the parse-level checks in the loader still apply."""
+    man_path = os.path.join(path, INTEGRITY_FILE)
+    if not os.path.exists(man_path):
+        return
+    try:
+        with open(man_path) as f:
+            man = json.load(f)
+        files = dict(man["files"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise CheckpointError(f"{path}: unreadable integrity manifest: {e}")
+    for name, want in files.items():
+        fp = os.path.join(path, name)
+        if not os.path.exists(fp):
+            raise CheckpointError(f"{path}: missing file {name!r} named by "
+                                  "the integrity manifest")
+        got = _sha256(fp)
+        if got != want:
+            raise CheckpointError(
+                f"{path}: sha256 mismatch on {name!r} "
+                f"(manifest {want[:12]}…, file {got[:12]}…)"
+            )
+
+
+# ------------------------------------------------------------ backup chain
+def backup_name(path: str, k: int) -> str:
+    """k-th retained backup: `.old` (most recent previous), `.old2`, ..."""
+    return path + ".old" + ("" if k == 1 else str(k))
+
+
+#: how far the candidate scan looks for backups (far above any sane
+#: --checkpoint-keep; quarantine can leave gaps, so the scan doesn't stop
+#: at the first missing index)
+_SCAN_LIMIT = 16
+
+
+def checkpoint_candidates(path: str) -> Iterator[str]:
+    """The load order: the checkpoint itself, then its backups newest-first."""
+    yield path
+    for k in range(1, _SCAN_LIMIT + 1):
+        b = backup_name(path, k)
+        if os.path.isdir(b):
+            yield b
+
+
+def _quarantine(path: str) -> Optional[str]:
+    """Rename a corrupt checkpoint dir aside (never clobbering an earlier
+    quarantine); returns the new name, or None when the rename itself fails
+    (the load fallback must proceed regardless)."""
+    base = path + ".corrupt"
+    dst = base
+    n = 2
+    while os.path.exists(dst):
+        dst = base + str(n)
+        n += 1
+    try:
+        os.replace(path, dst)
+        return dst
+    except OSError:
+        return None
+
+
+# ------------------------------------------------------------------- save
 def save_checkpoint(path: str, state: TrainState, config: Word2VecConfig,
-                    vocab: Optional[Vocab] = None) -> None:
+                    vocab: Optional[Vocab] = None, keep: int = 1,
+                    retries: int = 3, backoff: float = 0.05) -> None:
+    """Atomic checkpoint write with integrity manifest and retention.
+
+    `keep` previous checkpoints are retained (`.old` ... `.old{keep}`);
+    keep=0 restores the old delete-after-success behavior. OSError during
+    the write (full disk hiccup, flaky NFS, an injected `ckpt_oserror`
+    fault) is retried up to `retries` times with exponential backoff before
+    surfacing — a checkpoint that fails to land must be loud, but not
+    because of one transient error.
+    """
+    if keep < 0:
+        raise ValueError(f"keep must be >= 0, got {keep}")
+    last: Optional[OSError] = None
+    for attempt in range(retries + 1):
+        if attempt:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint write to {path!r} failed ({last}); "
+                f"retry {attempt}/{retries}",
+                stacklevel=2,
+            )
+            time.sleep(backoff * (2 ** (attempt - 1)))
+        try:
+            _save_once(path, state, config, vocab, keep)
+            return
+        except OSError as e:
+            last = e
+    raise last  # type: ignore[misc]
+
+
+def _save_once(path: str, state: TrainState, config: Word2VecConfig,
+               vocab: Optional[Vocab], keep: int) -> None:
+    _faults.raise_if_active("ckpt_oserror", where=path)
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
     tmp = tempfile.mkdtemp(dir=parent, prefix=".ckpt_tmp_")
@@ -62,26 +223,38 @@ def save_checkpoint(path: str, state: TrainState, config: Word2VecConfig,
             json.dump(dataclasses.asdict(config), f, indent=2)
         if vocab is not None:
             vocab.save(os.path.join(tmp, "vocab.txt"))
-        # Atomic overwrite: move the old checkpoint aside first so a crash at
-        # any point leaves either the old or the new checkpoint recoverable
-        # (the loader falls back to `<path>.old`).
-        backup = path + ".old"
+        write_integrity(tmp)  # last: its presence certifies a complete write
+        # Atomic overwrite with retention: rotate the backup chain, move the
+        # old checkpoint to `.old`, land the new one. A crash at any point
+        # leaves either the old or the new checkpoint recoverable (the
+        # loader walks path, .old, .old2, ...).
         if os.path.isdir(path):
-            if os.path.isdir(backup):
-                shutil.rmtree(backup)
-            os.replace(path, backup)
+            for k in range(max(keep, 1), 1, -1):
+                src = backup_name(path, k - 1)
+                if os.path.isdir(src):
+                    dst = backup_name(path, k)
+                    if os.path.isdir(dst):
+                        shutil.rmtree(dst)
+                    os.replace(src, dst)
+            first = backup_name(path, 1)
+            if os.path.isdir(first):
+                shutil.rmtree(first)
+            os.replace(path, first)
         os.replace(tmp, path)
-        shutil.rmtree(backup, ignore_errors=True)
+        # prune beyond the retention window (keep=0: drop `.old` too, the
+        # pre-retention behavior — rollback-dependent callers keep >= 1)
+        for k in range(keep + 1, _SCAN_LIMIT + 1):
+            b = backup_name(path, k)
+            if os.path.isdir(b):
+                shutil.rmtree(b, ignore_errors=True)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
 
 
-def load_checkpoint(path: str) -> Tuple[TrainState, Word2VecConfig, Optional[Vocab]]:
-    if not os.path.exists(os.path.join(path, "state.npz")):
-        backup = path + ".old"
-        if os.path.exists(os.path.join(backup, "state.npz")):
-            path = backup  # crash landed between move-aside and replace
+# ------------------------------------------------------------------- load
+def _load_dir(path: str) -> Tuple[TrainState, Word2VecConfig, Optional[Vocab]]:
+    """Parse one specific checkpoint dir (no fallback, no quarantine)."""
     with np.load(os.path.join(path, "state.npz")) as z:
         nonnative = (
             json.loads(str(z["__dtypes"])) if "__dtypes" in z.files else {}
@@ -109,3 +282,53 @@ def load_checkpoint(path: str) -> Tuple[TrainState, Word2VecConfig, Optional[Voc
     vocab_path = os.path.join(path, "vocab.txt")
     vocab = Vocab.load(vocab_path) if os.path.exists(vocab_path) else None
     return state, config, vocab
+
+
+def load_checkpoint(
+    path: str,
+    fallback: bool = True,
+    quarantine: bool = True,
+    validate: Optional[
+        Callable[[TrainState, Word2VecConfig, Optional[Vocab]], None]
+    ] = None,
+) -> Tuple[TrainState, Word2VecConfig, Optional[Vocab]]:
+    """Load the newest GOOD checkpoint at `path`.
+
+    Candidates are tried newest-first (`path`, `.old`, `.old2`, ...). A
+    candidate fails on integrity mismatch (verify_checkpoint), any parse
+    error of a truncated/torn dir, or a caller-supplied `validate(state,
+    config, vocab)` raising (the supervisor validates params are finite —
+    a checkpoint saved after divergence is not a rollback target). Failed
+    candidates are quarantined (renamed `.corrupt*`) so the next save's
+    rotation never resurrects them; `fallback=False` restricts the search
+    to `path` itself. Raises CheckpointError when nothing loads.
+    """
+    tried: List[str] = []
+    for cand in checkpoint_candidates(path):
+        if not os.path.exists(os.path.join(cand, "state.npz")):
+            tried.append(f"{cand}: missing state.npz")
+            if not fallback:
+                break
+            continue
+        try:
+            verify_checkpoint(cand)
+            out = _load_dir(cand)
+            if validate is not None:
+                validate(*out)
+            return out
+        except _CORRUPT_ERRORS as e:
+            import warnings
+
+            tried.append(f"{cand}: {type(e).__name__}: {e}")
+            moved = _quarantine(cand) if quarantine else None
+            warnings.warn(
+                f"corrupt checkpoint {cand!r} ({type(e).__name__}: {e})"
+                + (f"; quarantined as {moved!r}" if moved else "")
+                + ("; falling back" if fallback else ""),
+                stacklevel=2,
+            )
+        if not fallback:
+            break
+    raise CheckpointError(
+        f"no loadable checkpoint at {path!r}; tried: " + "; ".join(tried)
+    )

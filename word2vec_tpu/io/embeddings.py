@@ -41,14 +41,41 @@ def save_embeddings_text(path: str, words: Sequence[str], matrix: np.ndarray) ->
 
 
 def load_embeddings_text(path: str) -> Tuple[List[str], np.ndarray]:
-    """Parse the text format (loader mirror: Word2Vec.cpp:473-494)."""
+    """Parse the text format (loader mirror: Word2Vec.cpp:473-494).
+
+    Malformed input raises ValueError naming the file and 1-based line —
+    not an IndexError three stack frames deep: embedding files arrive from
+    other tools and partial downloads, and "bad header in foo.txt line 1"
+    is actionable where "invalid literal for int()" is not.
+    """
     with open(path, "r", encoding="utf-8") as f:
         header = f.readline().split()
-        rows, cols = int(header[0]), int(header[1])
+        if len(header) < 2:
+            raise ValueError(
+                f"{path}: line 1: malformed header {' '.join(header)!r} "
+                "(expected 'rows cols')"
+            )
+        try:
+            rows, cols = int(header[0]), int(header[1])
+        except ValueError:
+            raise ValueError(
+                f"{path}: line 1: non-integer header {' '.join(header)!r} "
+                "(expected 'rows cols')"
+            ) from None
+        if rows < 0 or cols <= 0:
+            raise ValueError(
+                f"{path}: line 1: impossible dims {rows} x {cols}"
+            )
         words: List[str] = []
         mat = np.empty((rows, cols), dtype=np.float32)
         for i in range(rows):
-            parts = f.readline().rstrip("\n").split(" ")
+            line = f.readline()
+            if not line:
+                raise ValueError(
+                    f"{path}: line {i + 2}: file ends after {i} rows "
+                    f"(header promised {rows})"
+                )
+            parts = line.rstrip("\n").split(" ")
             words.append(parts[0])
             # tolerate the reference's trailing-space quirk by filtering empties
             vals = [p for p in parts[1:] if p]
@@ -56,7 +83,18 @@ def load_embeddings_text(path: str) -> Tuple[List[str], np.ndarray]:
             # by other tools; accept both
             if len(vals) == 1 and "," in vals[0]:
                 vals = vals[0].split(",")
-            mat[i] = np.asarray(vals[:cols], dtype=np.float32)
+            if len(vals) < cols:
+                raise ValueError(
+                    f"{path}: line {i + 2}: row {parts[0]!r} has "
+                    f"{len(vals)} values, header promised {cols}"
+                )
+            try:
+                mat[i] = np.asarray(vals[:cols], dtype=np.float32)
+            except ValueError:
+                raise ValueError(
+                    f"{path}: line {i + 2}: row {parts[0]!r} has a "
+                    "non-numeric value"
+                ) from None
     return words, mat
 
 
@@ -83,20 +121,53 @@ def save_embeddings_binary(
 def load_embeddings_binary(
     path: str, layout: str = "reference"
 ) -> Tuple[List[str], np.ndarray]:
-    """Binary load (loader mirror: Word2Vec.cpp:442-471)."""
+    """Binary load (loader mirror: Word2Vec.cpp:442-471).
+
+    Truncated/garbage input raises ValueError naming the file, the word
+    index, and what was expected — the raw struct/frombuffer errors (or a
+    silent short read) would otherwise surface as shape mismatches far
+    from the cause.
+    """
     with open(path, "rb") as f:
         if layout == "reference":
-            rows = struct.unpack("<q", f.read(8))[0]
-            f.read(1)  # ' '
-            cols = struct.unpack("<q", f.read(8))[0]
-            f.read(1)  # '\n'
+            raw = f.read(18)  # <q>' '<q>'\n'
+            if len(raw) < 18:
+                raise ValueError(
+                    f"{path}: truncated header ({len(raw)} bytes; the "
+                    "reference layout needs 18) — wrong --binary-layout?"
+                )
+            rows = struct.unpack("<q", raw[0:8])[0]
+            cols = struct.unpack("<q", raw[9:17])[0]
         elif layout == "google":
             header = b""
             while not header.endswith(b"\n"):
-                header += f.read(1)
-            rows, cols = (int(x) for x in header.split())
+                c = f.read(1)
+                if not c:
+                    raise ValueError(
+                        f"{path}: EOF before the header newline — not a "
+                        "google-layout binary file"
+                    )
+                header += c
         else:
             raise ValueError(f"unknown layout {layout!r}")
+        if layout == "google":
+            fields = header.split()
+            if len(fields) != 2:
+                raise ValueError(
+                    f"{path}: malformed header {header!r} "
+                    "(expected 'rows cols')"
+                )
+            try:
+                rows, cols = (int(x) for x in fields)
+            except ValueError:
+                raise ValueError(
+                    f"{path}: non-integer header {header!r}"
+                ) from None
+        if rows < 0 or cols <= 0:
+            raise ValueError(
+                f"{path}: impossible dims {rows} x {cols} — wrong "
+                "--binary-layout for this file?"
+            )
         words: List[str] = []
         mat = np.empty((rows, cols), dtype=np.float32)
         row_bytes = cols * 4
@@ -107,8 +178,16 @@ def load_embeddings_binary(
                 if not c or c == b" ":
                     break
                 wb += c
-            words.append(wb.decode("utf-8"))
-            mat[i] = np.frombuffer(f.read(row_bytes), dtype="<f4")
+            word = wb.decode("utf-8", errors="replace")
+            raw = f.read(row_bytes)
+            if len(raw) < row_bytes:
+                raise ValueError(
+                    f"{path}: word #{i} ({word!r}): truncated row "
+                    f"({len(raw)} of {row_bytes} bytes; header promised "
+                    f"{rows} rows x {cols} cols)"
+                )
+            words.append(word)
+            mat[i] = np.frombuffer(raw, dtype="<f4")
             f.read(1)  # '\n'
     return words, mat
 

@@ -78,6 +78,18 @@ class DivergenceError(RuntimeError):
             f"{checkpoint_hint or 'none taken this run'}"
         )
 
+    def record(self) -> Dict:
+        """Structured event payload — what the resilience supervisor logs
+        and manifests carry for a divergence, without re-parsing the
+        message string."""
+        return {
+            "event": "divergence",
+            "step": self.step,
+            "streak": self.streak,
+            "first_step": self.first_step,
+            "checkpoint_hint": self.checkpoint_hint,
+        }
+
 
 def instrument_step(
     base: Callable, config, tp_axis: Optional[str] = None

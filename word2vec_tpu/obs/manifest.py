@@ -130,3 +130,28 @@ def write_manifest(path: str, config, **kwargs) -> Dict:
         f.write("\n")
     os.replace(tmp, path)
     return man
+
+
+def update_manifest(path: str, fields: Dict) -> Optional[Dict]:
+    """Merge `fields` into an existing manifest (atomic rewrite).
+
+    The resilience wiring uses this to record how a run ENDED — `shutdown:
+    clean|preempted|diverged`, recovery events — in the same file that
+    already pins how it started, so one read answers both. Returns the
+    updated dict, or None when the manifest is missing/unreadable: the
+    update must never fail a run whose training result already exists."""
+    try:
+        with open(path) as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    man.update(fields)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(man, f, indent=2, default=str)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return man

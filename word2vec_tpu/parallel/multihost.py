@@ -185,3 +185,15 @@ def global_agree_sum(value: int) -> int:
     import numpy as np
 
     return _global_agree(value, np.sum)
+
+
+def global_agree_max(value: int) -> int:
+    """Maximum of a per-process integer across all processes. Used as the
+    any-of vote of the preemption protocol (resilience/shutdown.py): one
+    host's SIGTERM flag becomes everyone's stop verdict at the same step
+    boundary, so all processes leave the collective loop together instead
+    of stranding the survivors in a step the evicted host never joins.
+    Single-process: identity."""
+    import numpy as np
+
+    return _global_agree(value, np.max)

@@ -648,6 +648,23 @@ class ShardedTrainer(Trainer):
             state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
 
+    def install_shutdown(self, handler, agree_every: int = 0) -> None:
+        """Multihost-aware cooperative stop: a preemption notice usually
+        hits ONE host, but every process must leave the collective step
+        loop at the same global step or the survivors hang in a collective
+        the stopped host never joins. The stop check therefore resolves
+        the local flag through multihost.global_agree_max at a fixed step
+        cadence (default: the replica-sync dispatch cadence, so a stop
+        lands where replicas reconcile anyway). Single-process meshes get
+        the plain flag read — no collective."""
+        if agree_every <= 0:
+            agree_every = max(
+                1, self.config.dp_sync_every // self.config.micro_steps
+            )
+        self.stop_check = handler.make_stop_check(
+            process_count=self.procs, agree_every=agree_every
+        )
+
     # ------------------------------------------------------------- planning
     def plan_constraints(self):
         """Mesh-aware constraints for the autotuned planner: the pallas

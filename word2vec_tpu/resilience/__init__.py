@@ -1,0 +1,60 @@
+"""Resilience subsystem: surviving the failures preemptible fleets actually
+have.
+
+On preemptible TPU slices the dominant failure modes are (a) eviction
+mid-epoch (SIGTERM with a short grace window), (b) NaN divergence burning
+chip time until a human notices, and (c) torn/corrupt checkpoints that turn
+"resume" into "retrain". The reference C++ has no persistence story at all
+(SURVEY §5); this package closes the loop end to end:
+
+  shutdown.py   — preemption-safe cooperative stop: a SIGTERM/SIGINT handler
+                  that requests a stop at the next step boundary
+                  (multihost-aware via parallel/multihost.global_agree_max),
+                  so the driver can write a final checkpoint and exit with a
+                  distinct requeue-able rc (EXIT_PREEMPTED).
+  supervisor.py — auto-recovery from divergence: catches obs.health's
+                  DivergenceError, rolls back to the last-good checkpoint
+                  (io/checkpoint's .old retention + integrity fallback),
+                  optionally rescales alpha and advances the shuffle seed,
+                  and retries a bounded number of times.
+  faults.py     — a declarative FaultPlan (NaN at step k, checkpoint-write
+                  OSError, slow-batcher stall, SIGTERM at step k) used by
+                  tests, the CI chaos job, and `bench.py --faults` so
+                  recovery overhead is a measured number, not a hope.
+
+Checkpoint integrity (sha256 per-file manifests, quarantine of corrupt
+checkpoints, backup-chain fallback) lives in io/checkpoint.py — the loader
+owns it — and the supervisor builds on it.
+
+Submodules are imported lazily: io/checkpoint.py consults `faults` for its
+injection point, and an eager `from .supervisor import ...` here would close
+an import cycle through io/checkpoint -> resilience.faults.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "Fault",
+    "FaultPlan",
+    "ShutdownHandler",
+    "Supervisor",
+    "EXIT_PREEMPTED",
+]
+
+_LAZY = {
+    "Fault": ("word2vec_tpu.resilience.faults", "Fault"),
+    "FaultPlan": ("word2vec_tpu.resilience.faults", "FaultPlan"),
+    "ShutdownHandler": ("word2vec_tpu.resilience.shutdown", "ShutdownHandler"),
+    "EXIT_PREEMPTED": ("word2vec_tpu.resilience.shutdown", "EXIT_PREEMPTED"),
+    "Supervisor": ("word2vec_tpu.resilience.supervisor", "Supervisor"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(name) from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)

@@ -1,0 +1,208 @@
+"""Declarative fault injection: the chaos plan behind the chaos tests.
+
+Generalizes the hidden `--inject-nan` CLI flag (PR 3) into a small plan
+language. A `FaultPlan` is a list of `Fault`s, each naming WHAT breaks and
+WHEN, parsed from a compact spec string:
+
+    nan@40                  poison the params with NaN at step-boundary 40
+    stall@10:secs=0.5       sleep 0.5s at step-boundary 10 (slow batcher)
+    sigterm@25              deliver SIGTERM to this process at boundary 25
+    ckpt_oserror:times=2    the next 2 checkpoint writes raise OSError
+
+Tokens are comma-separated; `@k` pins the optimizer-step boundary at (or
+after — chunked dispatch observes boundaries per chunk) which the fault
+fires, `:key=val` sets extras (`times` = firings before the fault is spent,
+default 1; `secs` = stall duration). A spec that is a path to a `.json`
+file is loaded as `[{"kind": ..., "step": ..., ...}, ...]`.
+
+Two delivery channels:
+  * step faults (nan/stall/sigterm) — the trainers call
+    `FaultPlan.on_step(state)` at every observed step boundary (per-step
+    loop: every optimizer step; chunked: every chunk boundary, plus once
+    before the first dispatch so `nan@0` poisons the initial params the way
+    `--inject-nan` did).
+  * event faults (ckpt_oserror) — code with an injection point calls
+    `faults.raise_if_active(kind)`; the module-level active plan (set with
+    `activate()`) decides. io/checkpoint.save_checkpoint is the only such
+    point today, exercising its bounded retry/backoff.
+
+Every firing is appended to `plan.log` so tests and the bench's fault run
+can assert WHAT actually fired, not just observe the wreckage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import Dict, List, Optional
+
+#: fault kinds delivered at optimizer-step boundaries by the trainers
+STEP_KINDS = ("nan", "stall", "sigterm")
+#: fault kinds delivered at named injection points via raise_if_active()
+EVENT_KINDS = ("ckpt_oserror",)
+KINDS = STEP_KINDS + EVENT_KINDS
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str
+    step: int = 0          # boundary at/after which a step fault fires
+    times: int = 1         # firings before the fault is spent
+    secs: float = 0.25     # stall duration (kind == "stall")
+    fired: int = 0         # firings so far (mutable state)
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (known: {', '.join(KINDS)})"
+            )
+        if self.step < 0:
+            raise ValueError(f"fault step must be >= 0, got {self.step}")
+        if self.times < 1:
+            raise ValueError(f"fault times must be >= 1, got {self.times}")
+
+    @property
+    def spent(self) -> bool:
+        return self.fired >= self.times
+
+    def to_json(self) -> Dict:
+        return {
+            "kind": self.kind, "step": self.step, "times": self.times,
+            "secs": self.secs, "fired": self.fired,
+        }
+
+
+def _parse_token(tok: str) -> Fault:
+    """One spec token: kind[@step][:key=val]..."""
+    parts = tok.strip().split(":")
+    head, extras = parts[0], parts[1:]
+    if "@" in head:
+        kind, _, step_s = head.partition("@")
+        try:
+            step = int(step_s)
+        except ValueError:
+            raise ValueError(
+                f"bad fault token {tok!r}: step {step_s!r} is not an integer"
+            ) from None
+    else:
+        kind, step = head, 0
+    kwargs: Dict = {"kind": kind.strip(), "step": step}
+    for ex in extras:
+        key, sep, val = ex.partition("=")
+        if not sep:
+            raise ValueError(f"bad fault token {tok!r}: expected key=val, got {ex!r}")
+        key = key.strip()
+        if key == "times":
+            kwargs["times"] = int(val)
+        elif key == "secs":
+            kwargs["secs"] = float(val)
+        else:
+            raise ValueError(f"bad fault token {tok!r}: unknown key {key!r}")
+    return Fault(**kwargs)
+
+
+class FaultPlan:
+    """An ordered set of injections plus a log of what actually fired."""
+
+    def __init__(self, faults: Optional[List[Fault]] = None):
+        self.faults: List[Fault] = list(faults or [])
+        #: every firing: {"kind", "step", "at_step"} (at_step = observed
+        #: boundary for step faults; the injection point's name for events)
+        self.log: List[Dict] = []
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse a comma-separated spec string, or a path to a JSON file."""
+        spec = (spec or "").strip()
+        if not spec:
+            return cls()
+        if spec.endswith(".json") or os.path.isfile(spec):
+            with open(spec) as f:
+                raw = json.load(f)
+            return cls([
+                Fault(**{k: v for k, v in d.items() if k != "fired"})
+                for d in raw
+            ])
+        return cls([_parse_token(t) for t in spec.split(",") if t.strip()])
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def to_json(self) -> List[Dict]:
+        return [f.to_json() for f in self.faults]
+
+    # ----------------------------------------------------- step delivery
+    def on_step(self, state, trainer=None) -> None:
+        """Deliver every due, unspent step fault at this boundary.
+
+        `state` is a train.TrainState (needs .step and .params); `trainer`
+        is unused today but keeps the hook forward-compatible (a fault that
+        needs the config or the phase recorder can reach them). Chunked
+        dispatch calls this at chunk boundaries, so a fault pinned inside a
+        chunk fires at the first boundary past its step — the plan's step is
+        a not-before bound, not an exact landing."""
+        for f in self.faults:
+            if f.kind not in STEP_KINDS or f.spent or state.step < f.step:
+                continue
+            f.fired += 1
+            self.log.append(
+                {"kind": f.kind, "step": f.step, "at_step": state.step}
+            )
+            if f.kind == "nan":
+                import jax
+
+                state.params = jax.tree.map(
+                    lambda v: (v * float("nan")).astype(v.dtype), state.params
+                )
+            elif f.kind == "stall":
+                time.sleep(f.secs)
+            elif f.kind == "sigterm":
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    # ---------------------------------------------------- event delivery
+    def fire_event(self, kind: str, where: str = "") -> bool:
+        """Consume one firing of an unspent event fault of `kind`; returns
+        whether one fired (the injection point decides what to raise)."""
+        for f in self.faults:
+            if f.kind == kind and not f.spent:
+                f.fired += 1
+                self.log.append(
+                    {"kind": kind, "step": f.step, "at_step": where or kind}
+                )
+                return True
+        return False
+
+
+# ------------------------------------------------------ module-level plan
+# Event-fault injection points (io/checkpoint.save_checkpoint) consult the
+# process-wide active plan: threading a plan object through every call
+# chain that might write a checkpoint would couple the io layer to the
+# chaos harness for no benefit. Tests activate/deactivate around the block
+# under test.
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def activate(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install `plan` as the process-wide event-fault plan; returns the
+    previous one (restore it in a finally when scoping)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = plan
+    return prev
+
+
+def active() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def raise_if_active(kind: str, where: str = "") -> None:
+    """Injection point: raise the fault's error if the active plan has an
+    unspent fault of `kind`. No-op (and zero overhead beyond a None check)
+    without an active plan."""
+    if _ACTIVE is not None and _ACTIVE.fire_event(kind, where):
+        if kind == "ckpt_oserror":
+            raise OSError(f"injected fault: {kind} at {where or 'checkpoint'}")
+        raise RuntimeError(f"injected fault: {kind}")

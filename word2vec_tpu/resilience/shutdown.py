@@ -1,0 +1,128 @@
+"""Preemption-safe shutdown: cooperative stop at the next step boundary.
+
+Preemptible TPU fleets deliver SIGTERM with a short grace window before the
+hard kill. Dying mid-step wastes every step since the last checkpoint and —
+with an unlucky landing inside a checkpoint write — used to risk a torn
+checkpoint too. The protocol here:
+
+  1. `ShutdownHandler.install()` registers SIGTERM/SIGINT handlers that only
+     SET A FLAG. The first signal is a request; a second delivery of the
+     same signal restores the original disposition and re-raises it, so an
+     operator's double Ctrl-C (or a scheduler escalating to a second
+     SIGTERM) still kills a wedged process the classic way.
+  2. The trainers poll `stop_check(step)` at every optimizer-step (or
+     chunk) boundary and return cleanly with `TrainReport.interrupted =
+     "preempted"` instead of raising — params are consistent, replicas are
+     synced by the normal `_finalize` path.
+  3. The CLI writes a final checkpoint, marks the run manifest
+     `shutdown: preempted`, and exits with EXIT_PREEMPTED so an external
+     scheduler can distinguish "requeue me with --resume" from success (0)
+     and divergence (2).
+
+Multihost: a preemption usually hits ONE host, but every process must leave
+the collective step loop at the same global step or the survivors hang in a
+collective the stopped host never joins. `make_stop_check` therefore
+resolves the flag through `parallel/multihost.global_agree_max` at a fixed
+step cadence (`agree_every`): all processes call the collective at the same
+boundaries and all see the same verdict. Single-process stop checks are a
+plain flag read — no collective, no overhead.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Callable, List, Optional
+
+#: exit code of a preempted-but-checkpointed run (EX_TEMPFAIL: "try again
+#: later" — the conventional requeue signal, distinct from 0=ok, 1=usage
+#: error, 2=diverged)
+EXIT_PREEMPTED = 75
+
+#: the default request signals: the scheduler's eviction notice and the
+#: operator's Ctrl-C
+DEFAULT_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class ShutdownHandler:
+    """Flag-setting signal handler with second-signal escalation."""
+
+    def __init__(self, signals=DEFAULT_SIGNALS):
+        self.signals = tuple(signals)
+        self.requested = False
+        #: the signal number that requested the stop (None until then)
+        self.signum: Optional[int] = None
+        self._previous: List = []
+        self._installed = False
+
+    # ------------------------------------------------------------ install
+    def install(self) -> "ShutdownHandler":
+        """Register the handlers; returns self for chaining. Safe to call
+        only from the main thread (Python's signal rule); callers off the
+        main thread get a no-op with a warning rather than a crash."""
+        if self._installed:
+            return self
+        try:
+            self._previous = [
+                (s, signal.signal(s, self._handle)) for s in self.signals
+            ]
+        except ValueError:  # not the main thread
+            import warnings
+
+            warnings.warn(
+                "ShutdownHandler.install() outside the main thread: signal "
+                "handlers cannot be registered; preemption-safe shutdown "
+                "is disabled for this run.",
+                stacklevel=2,
+            )
+            self._previous = []
+            return self
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        """Restore the original dispositions (idempotent)."""
+        for s, prev in self._previous:
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):
+                pass
+        self._previous = []
+        self._installed = False
+
+    def _handle(self, signum, frame) -> None:
+        if self.requested:
+            # second delivery: the cooperative window is over — restore the
+            # original disposition and re-deliver so the default action
+            # (terminate) or the operator's own handler runs
+            self.uninstall()
+            signal.raise_signal(signum)
+            return
+        self.requested = True
+        self.signum = signum
+
+    # ------------------------------------------------------- stop checks
+    def make_stop_check(
+        self, process_count: int = 1, agree_every: int = 16
+    ) -> Callable[[int], bool]:
+        """A `stop_check(step) -> bool` for the trainers.
+
+        Single-process: a flag read, every step. Multi-process: the flag is
+        resolved through a global max at step boundaries where
+        `step % agree_every == 0` — every process calls the collective at
+        the same boundaries (step counters advance in lockstep), so nobody
+        enters it alone; between boundaries the check returns False even on
+        the host that caught the signal, because stopping unilaterally
+        would strand the others in the next collective step."""
+        if process_count <= 1:
+            return lambda step: self.requested
+
+        from ..parallel.multihost import global_agree_max
+
+        every = max(1, int(agree_every))
+
+        def check(step: int) -> bool:
+            if step % every != 0:
+                return False
+            return global_agree_max(int(self.requested)) > 0
+
+        return check

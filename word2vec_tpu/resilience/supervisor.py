@@ -1,0 +1,159 @@
+"""Supervised auto-recovery from divergence.
+
+PR 3's tripwire turned "train on NaN params until the epoch ends" into a
+structured `DivergenceError` — but the error still killed the run, wasting
+every step since the last checkpoint. The supervisor closes the loop with
+the recover-from-last-good discipline large-batch training systems rely on:
+
+    try train -> DivergenceError -> roll back to the last GOOD checkpoint
+    (io/checkpoint's backup chain + integrity validation + a finite-params
+    check, so a checkpoint that itself captured NaN tables is rejected and
+    quarantined) -> optionally rescale alpha and advance the shuffle seed
+    (a divergence is often batch-order + learning-rate conditioned; the
+    seed bump re-deals the poisoned order, the alpha backoff shrinks the
+    step that overshot) -> retry, up to `max_retries` times -> re-raise.
+
+The trainer instance is REUSED across retries: the jitted step functions
+depend on neither seed nor init_alpha (both are host-side inputs), so a
+recovery costs a checkpoint load, not a recompile. Every recovery is
+recorded (`Supervisor.recoveries`, also attached to the final
+TrainReport.recoveries and logged as an "auto_recover" event) so manifests
+and harnesses can see that — and how — a run healed itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs.health import DivergenceError
+
+
+def validate_finite_params(state, config=None, vocab=None) -> None:
+    """load_checkpoint validator: every table must be all-finite. A
+    checkpoint taken after the params went NaN is not a rollback target —
+    treating it as corrupt sends the loader down the backup chain."""
+    from ..io.checkpoint import CheckpointError
+
+    for k, v in state.params.items():
+        a = np.asarray(v)
+        if a.dtype != np.float32:
+            a = a.astype(np.float32)
+        if not np.all(np.isfinite(a)):
+            raise CheckpointError(
+                f"non-finite values in checkpointed table {k!r} "
+                "(captured after divergence)"
+            )
+
+
+class Supervisor:
+    """Retry `trainer.train` across DivergenceErrors with rollback.
+
+    Parameters:
+      trainer         a train.Trainer (or ShardedTrainer; rollback re-shards
+                      through its import_params hook)
+      checkpoint_dir  where the run's checkpoints land; None means every
+                      recovery restarts from a fresh init (still bounded)
+      max_retries     recoveries before the DivergenceError propagates
+      alpha_scale     multiplied into config.init_alpha per recovery
+                      (1.0 = keep the schedule; 0.5 halves it each time)
+      reseed          advance config.seed per recovery so the retry sees a
+                      different batch order and draw streams
+    """
+
+    def __init__(
+        self,
+        trainer,
+        checkpoint_dir: Optional[str] = None,
+        max_retries: int = 1,
+        alpha_scale: float = 0.5,
+        reseed: bool = True,
+        log_fn=None,
+    ):
+        if max_retries < 1:
+            raise ValueError(f"max_retries must be >= 1, got {max_retries}")
+        if not (0.0 < alpha_scale <= 1.0):
+            raise ValueError(
+                f"alpha_scale must be in (0, 1], got {alpha_scale}"
+            )
+        self.trainer = trainer
+        self.checkpoint_dir = checkpoint_dir
+        self.max_retries = int(max_retries)
+        self.alpha_scale = float(alpha_scale)
+        self.reseed = bool(reseed)
+        self.log_fn = log_fn
+        #: one record per recovery ("auto_recover" events)
+        self.recoveries: List[Dict] = []
+
+    def run(self, state=None, **train_kwargs):
+        """trainer.train with supervised retries; same return contract.
+        The final report carries `recoveries` when any recovery happened."""
+        attempt = 0
+        while True:
+            try:
+                out_state, report = self.trainer.train(state=state, **train_kwargs)
+                if self.recoveries:
+                    report.recoveries = list(self.recoveries)
+                return out_state, report
+            except DivergenceError as e:
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                state = self._recover(e, attempt)
+
+    # ------------------------------------------------------------ recovery
+    def _recover(self, err: DivergenceError, attempt: int):
+        from ..io.checkpoint import CheckpointError, load_checkpoint
+
+        state = None
+        rolled_back_to: Optional[str] = None
+        if self.checkpoint_dir:
+            try:
+                state, _ck_cfg, _ck_vocab = load_checkpoint(
+                    self.checkpoint_dir, validate=validate_finite_params
+                )
+                rolled_back_to = f"step {state.step}"
+            except CheckpointError:
+                state = None
+        if state is None:
+            # no checkpoint landed before the divergence (or none survived
+            # validation): restart from init — with the seed bump below the
+            # re-init is a genuinely different draw, not a replay
+            rolled_back_to = "fresh init"
+
+        # Rescale alpha / advance the shuffle seed on the live trainer. Both
+        # are host-side inputs of the compiled step (alpha is a per-step
+        # argument, the seed feeds the batcher permutation and the device
+        # draw-stream keys), so no rebuild or recompile happens here.
+        cfg = self.trainer.config
+        new_fields = {}
+        if self.alpha_scale != 1.0:
+            new_fields["init_alpha"] = cfg.init_alpha * self.alpha_scale
+        if self.reseed:
+            new_fields["seed"] = cfg.seed + 1
+        if new_fields:
+            self.trainer.config = dataclasses.replace(cfg, **new_fields)
+
+        if state is None:
+            state = self.trainer.init_state()
+        elif hasattr(self.trainer, "import_params"):
+            # checkpoints hold unreplicated [V, d] tables; re-shard them
+            self.trainer.import_params(state.params, state)
+
+        rec = {
+            "event": "auto_recover",
+            "attempt": attempt,
+            "max_retries": self.max_retries,
+            "failed_step": err.step,
+            "streak": err.streak,
+            "rolled_back_to": rolled_back_to,
+            "resume_step": state.step,
+            "init_alpha": self.trainer.config.init_alpha,
+            "seed": self.trainer.config.seed,
+        }
+        self.recoveries.append(rec)
+        if self.log_fn:
+            self.log_fn(dict(rec))
+        return state

@@ -64,6 +64,14 @@ class TrainReport:
     #: health-counter summary (obs/health.HealthMonitor.summary):
     #: observations, non-finite steps, max streak, cumulative grad norm
     health: Optional[Dict] = None
+    #: why the run ended before its epochs did: "preempted" when a
+    #: cooperative stop (resilience/shutdown.py) landed at a step boundary,
+    #: None for a complete run. Params are consistent and replica-synced
+    #: either way (_finalize runs on both paths).
+    interrupted: Optional[str] = None
+    #: auto-recovery events, attached by resilience.Supervisor when the run
+    #: rolled back and retried past a DivergenceError
+    recoveries: Optional[List[Dict]] = None
 
 
 class Trainer:
@@ -85,6 +93,15 @@ class Trainer:
     #: how the autotuned planner resolved (config.autotune != "off"):
     #: a tune.PlanResolution, for bench/CLI observability
     plan_resolution = None
+    #: cooperative-stop poll (resilience/shutdown.ShutdownHandler
+    #: .make_stop_check): called with state.step at every optimizer-step /
+    #: chunk boundary; returning True ends the run cleanly with
+    #: TrainReport.interrupted = "preempted". Wire via install_shutdown().
+    stop_check: Optional[Callable[[int], bool]] = None
+    #: fault-injection plan (resilience/faults.FaultPlan) — None in
+    #: production; chaos tests and `--faults` set it. Duck-typed: anything
+    #: with .on_step(state, trainer) works.
+    fault_plan = None
 
     def __init__(
         self,
@@ -263,6 +280,21 @@ class Trainer:
     def _post_step(self, state: TrainState) -> None:
         """Called after every optimizer step (sharded: periodic sync)."""
 
+    def install_shutdown(self, handler, agree_every: int = 16) -> None:
+        """Wire a resilience.ShutdownHandler's cooperative stop into this
+        trainer. Single-chip: a per-boundary flag read (`agree_every` is
+        unused — there is nobody to agree with); ShardedTrainer overrides
+        with the multihost agreement cadence."""
+        self.stop_check = handler.make_stop_check(process_count=1)
+
+    def _check_stop(self, state: TrainState) -> bool:
+        """One step/chunk-boundary poll of the resilience hooks: deliver any
+        due injected faults, then ask the cooperative-stop check. Shared by
+        the per-step and chunked drivers so the two can't drift."""
+        if self.fault_plan is not None:
+            self.fault_plan.on_step(state, self)
+        return self.stop_check is not None and self.stop_check(state.step)
+
     def _finalize(self, state: TrainState) -> None:
         """Called once after the last epoch (sharded: final sync)."""
 
@@ -305,6 +337,11 @@ class Trainer:
             }
             jax.block_until_ready(state.params)
         state = state or self.init_state()
+        if self.fault_plan is not None:
+            # entry boundary: a fault pinned at/before the entry step
+            # (nan@0, or nan@s on a resumed run) applies before the first
+            # dispatch — the --inject-nan semantics, generalized
+            self.fault_plan.on_step(state, self)
         batcher = BatchIterator(
             self.corpus, cfg.batch_rows, cfg.max_sentence_len, seed=cfg.seed
         )
@@ -354,6 +391,7 @@ class Trainer:
         # device pipeline is never stalled to read the scalars — the ONLY
         # per-step host sync, pinned by tests/test_obs.py.
         pending_obs: Optional[Tuple[Dict, int]] = None
+        interrupted: Optional[str] = None
 
         def drain_obs() -> None:
             nonlocal pending_obs
@@ -421,6 +459,15 @@ class Trainer:
                         self.log_fn(rec)
                 if checkpoint_every and checkpoint_cb and state.step % checkpoint_every == 0:
                     self._run_checkpoint(checkpoint_cb, state)
+                if self._check_stop(state):
+                    # cooperative stop (preemption): leave at this step
+                    # boundary with state.step/epoch mid-epoch-consistent —
+                    # a checkpoint of this state resumes exactly here
+                    # (_resume_skip), so requeue-and---resume loses nothing
+                    interrupted = "preempted"
+                    break
+            if interrupted:
+                break
             state.epoch = epoch + 1  # epoch completed
             skip = 0  # only the resumed epoch re-enters mid-way
 
@@ -443,6 +490,7 @@ class Trainer:
             resident=self.resident_resolution,
             phases=self.phases.report(),
             health=self._health.summary(),
+            interrupted=interrupted,
         )
         return state, report
 
@@ -484,6 +532,7 @@ class Trainer:
         if self._resident is None and self.chunk_fn is None:
             self.chunk_fn = self._build_chunk_fn()
         self._last_chunk_loss = float("nan")
+        interrupted: Optional[str] = None
         pending: Optional[Tuple[Dict, int, int, float, int, bool, int]] = None
 
         def drain() -> None:
@@ -540,6 +589,14 @@ class Trainer:
                     != prev_step // checkpoint_every
                 ):
                     self._run_checkpoint(checkpoint_cb, state)
+                if self._check_stop(state):
+                    # cooperative stop at a chunk boundary (fault steps
+                    # pinned inside a chunk also land here — the chunk is
+                    # the dispatch atom)
+                    interrupted = "preempted"
+                    break
+            if interrupted:
+                break
             state.epoch = epoch + 1
             skip = 0  # only the resumed epoch re-enters mid-way
 
@@ -557,6 +614,7 @@ class Trainer:
             resident=self.resident_resolution,
             phases=self.phases.report(),
             health=self._health.summary() if self._health else None,
+            interrupted=interrupted,
         )
 
     def _build_chunk_fn(self):

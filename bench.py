@@ -659,9 +659,12 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SPEC",
                     help="recovery-overhead drill instead of the throughput "
                     "bench: run clean vs fault-injected+auto-recovered and "
-                    "emit the measured overhead (resilience/faults.py spec; "
-                    "bare --faults = one NaN divergence past the mid-epoch "
-                    "checkpoint)")
+                    "emit the measured overhead (resilience/faults.py spec, "
+                    "incl. the hang kinds — 'hang@K:secs=S' measures an "
+                    "S-second main-loop wedge as overhead; bare --faults = "
+                    "one NaN divergence past the mid-epoch checkpoint; the "
+                    "idle-watchdog cost itself is banked by "
+                    "benchmarks/watchdog_overhead.py)")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke preset: shrink the synthetic corpus to "
                     "~60s of CPU wall time (still the real pipeline at the "

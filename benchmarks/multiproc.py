@@ -20,6 +20,16 @@ by process rank — so the gate is statistical, like benchmarks/parity.py.
 
 One JSON line to stdout:
     python benchmarks/multiproc.py [--procs 2] [--devices-per-proc 4]
+
+Chaos mode (`--chaos 'peer_dead@8'`): the kill-one-of-N drill for the
+distributed watchdog (resilience/watchdog.py). One rank gets the fault
+(SIGKILL at a step boundary — a LOST host, no cooperative anything); every
+rank runs with --step-deadline/--sync-deadline. The drill asserts the
+survivors EXIT within the deadlines (EXIT_STALLED from the step watchdog or
+EXIT_PREEMPTED from a bounded collective's SyncTimeout) instead of hanging
+in a collective the dead peer never joins — the pre-watchdog behavior was
+N-1 processes blocked forever. Emits one JSON line with per-rank exit codes
+and exit walls; no eval comparison (the run is deliberately truncated).
 """
 
 from __future__ import annotations
@@ -63,6 +73,98 @@ def cli_cmd(train: str, vocab: str, out: str, dp: int, tp: int = 1,
     ]
 
 
+def _run_chaos(args, result, tmp, procs, logs, victim, t0) -> None:
+    """Kill-one-of-N: wait for every rank with per-rank exit timing, assert
+    the survivors exit within the deadlines, emit one JSON line."""
+    import signal as _signal
+
+    from word2vec_tpu.resilience.shutdown import EXIT_PREEMPTED
+    from word2vec_tpu.resilience.watchdog import EXIT_STALLED
+
+    result["chaos"] = args.chaos
+    result["victim_rank"] = victim
+    result["step_deadline_s"] = args.step_deadline
+    result["sync_deadline_s"] = args.sync_deadline
+
+    exit_at = {}
+    hard_deadline = time.time() + args.timeout
+    while len(exit_at) < len(procs) and time.time() < hard_deadline:
+        for r, p in enumerate(procs):
+            if r not in exit_at and p.poll() is not None:
+                exit_at[r] = time.perf_counter() - t0
+        time.sleep(0.2)
+    hung = sorted(r for r in range(len(procs)) if r not in exit_at)
+    for r in hung:
+        procs[r].kill()
+        procs[r].wait()
+
+    def tail(r):
+        logs[r].seek(0)
+        return logs[r].read().strip().splitlines()[-8:]
+
+    result["rcs"] = [p.returncode for p in procs]
+    result["exit_walls_s"] = {
+        str(r): round(exit_at[r], 1) for r in sorted(exit_at)
+    }
+    if hung:
+        result["error"] = (
+            f"ranks {hung} still running after {args.timeout:.0f}s — "
+            "survivors HUNG instead of aborting"
+        )
+        result["log_tails"] = [tail(r) for r in hung]
+        print(json.dumps(result))
+        return
+
+    victim_rc = procs[victim].returncode
+    # SIGKILL shows as -9; a sigterm@ chaos spec would exit EXIT_PREEMPTED
+    result["victim_rc"] = victim_rc
+    if victim_rc not in (-int(_signal.SIGKILL), EXIT_PREEMPTED):
+        result["error"] = f"victim rank {victim} exited rc={victim_rc}, " \
+                          "expected SIGKILL(-9) or EXIT_PREEMPTED"
+        result["log_tails"] = [tail(victim)]
+        print(json.dumps(result))
+        return
+
+    # survivors: a bounded abort is EXIT_STALLED (step watchdog caught the
+    # wedged collective as a missed boundary) or EXIT_PREEMPTED (a bounded
+    # agree/heartbeat collective raised SyncTimeout)
+    ok_rcs = (EXIT_STALLED, EXIT_PREEMPTED)
+    survivors = [r for r in range(len(procs)) if r != victim]
+    result["survivor_rcs"] = {str(r): procs[r].returncode for r in survivors}
+    # exit budget: the wedge is noticed within max(deadlines) of the
+    # victim's death, plus the fire/abort machinery — 3x + slack covers the
+    # monitor interval and the bounded final-checkpoint attempt
+    budget = 3.0 * max(args.step_deadline, args.sync_deadline) + 10.0
+    result["survivor_exit_after_victim_s"] = {
+        str(r): round(exit_at[r] - exit_at[victim], 1) for r in survivors
+    }
+    result["exit_budget_s"] = budget
+    bad = [
+        r for r in survivors
+        if procs[r].returncode not in ok_rcs
+        or exit_at[r] - exit_at[victim] > budget
+    ]
+    if bad:
+        result["error"] = (
+            f"survivor ranks {bad} did not abort cleanly within the budget"
+        )
+        result["log_tails"] = [tail(r) for r in bad]
+        print(json.dumps(result))
+        return
+
+    # how each survivor ended, from its own manifest (stalled | peer_lost)
+    shutdowns = {}
+    for r in survivors:
+        try:
+            with open(os.path.join(tmp, f"m{r}", "manifest.json")) as f:
+                shutdowns[str(r)] = json.load(f).get("shutdown")
+        except (OSError, ValueError):
+            shutdowns[str(r)] = None
+    result["survivor_shutdowns"] = shutdowns
+    result["ok"] = True
+    print(json.dumps(result))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--procs", type=int, default=2)
@@ -83,6 +185,20 @@ def main() -> None:
                     "distributed backend on the second objective)")
     ap.add_argument("--hs-dense-top", type=int, default=0,
                     help="two-tier hs dense tier (config.hs_dense_top)")
+    ap.add_argument("--chaos", metavar="SPEC", default="",
+                    help="kill-one-of-N drill: deliver SPEC (e.g. "
+                    "'peer_dead@8') to --chaos-rank only, run every rank "
+                    "with the step/sync deadlines, and assert the "
+                    "survivors exit within them instead of hanging")
+    ap.add_argument("--chaos-rank", type=int, default=-1,
+                    help="rank receiving the chaos fault (-1 = the LAST "
+                    "rank, keeping process 0 — the jax.distributed "
+                    "coordinator — alive so the drill tests collective "
+                    "hang detection, not coordinator loss)")
+    ap.add_argument("--step-deadline", type=float, default=8.0,
+                    help="chaos mode: --step-deadline forwarded to every rank")
+    ap.add_argument("--sync-deadline", type=float, default=8.0,
+                    help="chaos mode: --sync-deadline forwarded to every rank")
     args = ap.parse_args()
 
     from word2vec_tpu.utils.synthetic import topic_corpus, topic_similarity_pairs
@@ -131,6 +247,11 @@ def main() -> None:
         }
 
         # --- multi-process run -------------------------------------------
+        victim = None
+        if args.chaos:
+            victim = (
+                args.chaos_rank if args.chaos_rank >= 0 else args.procs - 1
+            )
         port = free_port()
         t0 = time.perf_counter()
         procs = []
@@ -142,19 +263,41 @@ def main() -> None:
                 "W2V_NUM_PROCS": str(args.procs),
                 "W2V_PROC_ID": str(r),
             }
+            extra = ["--multihost", "--sync-mode", args.sync_mode]
+            if args.chaos:
+                extra += [
+                    # small pinned geometry: auto sizing on this corpus gives
+                    # ~1 dispatch per epoch, so a step-pinned fault would
+                    # never fire and there would be no boundaries to beat
+                    "--batch-rows", "8",
+                    # tight sync cadence so the heartbeat/agree collectives
+                    # (the bounded channel) actually run before the drill ends
+                    "--dp-sync-every", "4",
+                    # per-step boundaries: the watchdog's adaptive deadline
+                    # needs steady beats, and the fault lands promptly
+                    "--chunk-steps", "1",
+                    "--step-deadline", str(args.step_deadline),
+                    "--sync-deadline", str(args.sync_deadline),
+                    "--checkpoint-dir", f"ck{r}", "--checkpoint-every", "5",
+                    "--metrics-dir", f"m{r}",
+                ]
+                if r == victim:
+                    extra += ["--faults", args.chaos]
             # child output goes to FILES, not pipes: an undrained pipe fills
             # at ~64 KiB and deadlocks the child against our wait()
             log = open(os.path.join(tmp, f"rank{r}.log"), "w+")
             logs.append(log)
             procs.append(subprocess.Popen(
                 cli_cmd(f"shard{r}", "vocab.txt", "vec_mp.txt", dp, args.tp,
-                        args.iters,
-                        ("--multihost", "--sync-mode", args.sync_mode),
+                        args.iters, tuple(extra),
                         method=args.train_method,
                         dense_top=args.hs_dense_top),
                 cwd=tmp, env=env,
                 stdout=log, stderr=subprocess.STDOUT, text=True,
             ))
+        if args.chaos:
+            _run_chaos(args, result, tmp, procs, logs, victim, t0)
+            return
         deadline = time.time() + args.timeout
         rcs = []
         for p in procs:

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Measure the idle step-watchdog's overhead on the CPU drill shape.
+
+The watchdog contract (resilience/watchdog.py) is that ARMING costs nothing
+observable: beat() is one clock read + a lock, the monitor thread wakes a
+few times per second, and no device sync or dispatch is added. This harness
+pins that as a banked number instead of a hope: it trains the same
+synthetic shape with and without an armed watchdog (alternating reps,
+median wall), and times beat() itself against the run's own p50 step time.
+
+One JSON line to stdout (bank as benchmarks/WATCHDOG_OVERHEAD_cpu.json):
+    python benchmarks/watchdog_overhead.py [--tokens 200000] [--reps 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=200_000)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--batch-rows", type=int, default=64)
+    ap.add_argument("--deadline", type=float, default=60.0)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.resilience.watchdog import StepWatchdog
+    from word2vec_tpu.train import Trainer
+    from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=5, word_dim=args.dim,
+        window=5, batch_rows=args.batch_rows, max_sentence_len=192,
+        min_count=1, iters=1, seed=0,
+        chunk_steps=1,  # per-step boundaries: the worst case for beat count
+    )
+    vocab = zipf_vocab(71000, 17_000_000)
+    flat = np.concatenate(zipf_corpus_ids(vocab, args.tokens, seed=0))
+    ids = [flat[i:i + 1000] for i in range(0, len(flat), 1000)]
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    trainer = Trainer(cfg, vocab, corpus)
+
+    def timed_run(with_watchdog: bool):
+        wd = None
+        if with_watchdog:
+            wd = StepWatchdog(deadline=args.deadline)
+        trainer.watchdog = wd
+        t0 = time.perf_counter()
+        _, rep = trainer.train(state=trainer.init_state(), log_every=0)
+        wall = time.perf_counter() - t0
+        trainer.watchdog = None
+        assert wd is None or not wd.fired.is_set()
+        return wall, rep
+
+    timed_run(False)  # warmup: compile out of the measurement
+    base_walls, wd_walls, steps = [], [], 0
+    p50_step_ms = None
+    for _ in range(args.reps):  # alternate to decorrelate host drift
+        w, rep = timed_run(False)
+        base_walls.append(w)
+        steps = rep.steps
+        w, rep = timed_run(True)
+        wd_walls.append(w)
+
+    # beat microcost against the run's own step time
+    wd = StepWatchdog(deadline=args.deadline)
+    trainer.watchdog = wd
+    _, rep = trainer.train(state=trainer.init_state(), log_every=0)
+    p50_step_ms = wd.step_stats()["p50_ms"]
+    n = 100_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        wd.beat(i)
+    per_beat_us = 1e6 * (time.perf_counter() - t0) / n
+    trainer.watchdog = None
+
+    base = statistics.median(base_walls)
+    withwd = statistics.median(wd_walls)
+    overhead_pct = 100.0 * (withwd - base) / base
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": f"idle step-watchdog overhead "
+                  f"({args.tokens // 1000}k zipf, {dev.platform})",
+        "value": round(overhead_pct, 2),
+        "unit": "% wall",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "steps_per_run": steps,
+        "reps": args.reps,
+        "base_wall_s": [round(w, 3) for w in base_walls],
+        "watchdog_wall_s": [round(w, 3) for w in wd_walls],
+        "median_base_s": round(base, 3),
+        "median_watchdog_s": round(withwd, 3),
+        "p50_step_ms": round(p50_step_ms, 3),
+        "beat_cost_us": round(per_beat_us, 3),
+        "beat_cost_pct_of_step": round(
+            100.0 * per_beat_us / (1e3 * p50_step_ms), 4
+        ),
+        "deadline_s": args.deadline,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,3 +52,37 @@ def test_two_process_training_matches_single_process():
     assert result["multiproc"]["cos_margin"] > 0.3, result
     assert result["singleproc"]["cos_margin"] > 0.3, result
     assert abs(result["delta_cos_margin"]) < 0.05, result
+
+
+def test_kill_one_of_n_survivors_exit_within_deadline():
+    """Distributed-watchdog acceptance (resilience/watchdog.py): SIGKILL one
+    of 3 real jax.distributed processes mid-run (peer_dead@6) and assert
+    the survivors EXIT within the step/sync deadlines — EXIT_STALLED (the
+    step watchdog caught the wedged collective) or EXIT_PREEMPTED (a
+    bounded agree collective raised SyncTimeout) — instead of hanging in a
+    collective the dead peer never joins, which was the pre-watchdog
+    behavior."""
+    from word2vec_tpu.resilience.shutdown import EXIT_PREEMPTED
+    from word2vec_tpu.resilience.watchdog import EXIT_STALLED
+
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "multiproc.py"),
+            "--procs", "3", "--devices-per-proc", "2",
+            "--tokens", "120000", "--iters", "2",
+            "--chaos", "peer_dead@6",
+            "--step-deadline", "8", "--sync-deadline", "8",
+            "--timeout", "300",
+        ],
+        capture_output=True, text=True, timeout=420,
+        env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result.get("ok"), result
+    assert result["victim_rc"] == -9  # SIGKILL: a genuinely lost host
+    for r, rc in result["survivor_rcs"].items():
+        assert rc in (EXIT_STALLED, EXIT_PREEMPTED), result
+    for r, dt in result["survivor_exit_after_victim_s"].items():
+        assert dt <= result["exit_budget_s"], result

@@ -85,6 +85,44 @@ class TestFaultPlan:
         p = FaultPlan.parse(str(f))
         assert p.faults[0].kind == "nan" and p.faults[0].step == 7
 
+    def test_parse_hang_and_peer_dead_kinds(self):
+        p = FaultPlan.parse("hang@10,peer_dead@25,hang@3:secs=7")
+        assert [(f.kind, f.step) for f in p.faults] == [
+            ("hang", 10), ("peer_dead", 25), ("hang", 3)]
+        # a hang's default sleep outlives any sane step deadline; other
+        # kinds keep the short stall default
+        assert p.faults[0].secs == 3600.0
+        assert p.faults[1].secs == 0.25
+        assert p.faults[2].secs == 7.0
+
+    def test_parse_error_names_clause_and_offset(self):
+        """Satellite: a typo'd spec names the offending clause + offset,
+        not a generic ValueError."""
+        with pytest.raises(ValueError,
+                           match=r"clause 2 \('bogus@x'\) at offset 7"):
+            FaultPlan.parse("nan@40,bogus@x")
+        with pytest.raises(ValueError,
+                           match=r"clause 1 \('wibble@3'\) at offset 0: "
+                                 r"unknown fault kind"):
+            FaultPlan.parse("wibble@3")
+        # offsets respect earlier clauses and stripped whitespace
+        with pytest.raises(ValueError, match=r"clause 3 .* at offset 15"):
+            FaultPlan.parse("nan@1,stall@2, sigterm@zzz")
+        with pytest.raises(ValueError, match=r"unknown key 'wat'"):
+            FaultPlan.parse("nan@3:wat=1")
+        with pytest.raises(ValueError, match=r"bad value 'x' for key 'secs'"):
+            FaultPlan.parse("stall@2:secs=x")
+        with pytest.raises(ValueError, match=r"step must be >= 0"):
+            FaultPlan.parse("nan@2,nan@-3")
+
+    def test_parse_json_error_names_entry(self, tmp_path):
+        f = tmp_path / "plan.json"
+        f.write_text(json.dumps(
+            [{"kind": "nan"}, {"kind": "bogus"}]
+        ))
+        with pytest.raises(ValueError, match="entry 1"):
+            FaultPlan.parse(str(f))
+
     def test_nan_fault_fires_once_and_logs(self):
         p = FaultPlan([Fault("nan", step=3)])
         state = TrainState(params={"W": jax.numpy.ones((2, 2))}, step=2)
@@ -213,6 +251,38 @@ class TestCheckpointDurability:
             faults_mod.activate(prev)
         # the failed save never touched the landed checkpoint
         assert load_checkpoint(ck)[0].step == 1
+
+    def test_integrity_meta_carries_vocab_hash(self, tmp_path):
+        """Satellite: the checkpoint's integrity.json metadata pins the
+        vocabulary content hash — the --resume corpus guard's fingerprint —
+        without breaking verification."""
+        from word2vec_tpu.io.checkpoint import read_integrity_meta
+
+        cfg, vocab, corpus = _setup()
+        params = Trainer(cfg, vocab, corpus).init_state().params
+        ck = str(tmp_path / "ck")
+        save_checkpoint(ck, TrainState(params=params, step=1), cfg, vocab)
+        meta = read_integrity_meta(ck)
+        assert meta["vocab_hash"] == vocab.content_hash()
+        verify_checkpoint(ck)  # meta doesn't perturb the file hashes
+        # no vocab -> no hash, and the reader degrades to {}
+        ck2 = str(tmp_path / "ck2")
+        save_checkpoint(ck2, TrainState(params=params, step=1), cfg)
+        assert read_integrity_meta(ck2) == {}
+
+    def test_vocab_content_hash_sensitivity(self):
+        from word2vec_tpu.data.vocab import Vocab
+
+        v1 = zipf_vocab(10, 100)
+        v2 = zipf_vocab(10, 100)
+        assert v1.content_hash() == v2.content_hash()  # deterministic
+        bumped = Vocab(v1.words, v1.counts.copy())
+        bumped.counts[0] += 1
+        assert bumped.content_hash() != v1.content_hash()  # count-sensitive
+        renamed = Vocab(["zz"] + list(v1.words[1:]), v1.counts)
+        assert renamed.content_hash() != v1.content_hash()  # word-sensitive
+        reordered = Vocab(list(reversed(v1.words)), v1.counts[::-1])
+        assert reordered.content_hash() != v1.content_hash()  # row-sensitive
 
     def test_finite_validator_rejects_nan_checkpoint(self, tmp_path):
         cfg, vocab, corpus = _setup()
@@ -465,6 +535,58 @@ def test_cli_rejects_bad_faults_spec(corpus_file, capsys):
 
     assert main(_common(corpus_file) + ["--faults", "bogus@2"]) == 1
     assert "bad --faults spec" in capsys.readouterr().err
+
+
+def test_resume_fallback_epoch_restart_warns_and_flags():
+    """Satellite: an out-of-range checkpointed step counter no longer falls
+    back to epoch restart SILENTLY — it warns, logs a structured event, and
+    flags trainer.resume_fallback for the manifest."""
+    cfg, vocab, corpus = _setup(iters=1)
+    events = []
+    t = Trainer(cfg, vocab, corpus, log_fn=events.append)
+    st = t.init_state()
+    st.step = 9999  # far past any epoch of this geometry
+    with pytest.warns(UserWarning, match="out of range .* epoch_restart"):
+        st2, rep = t.train(state=st, log_every=0)
+    assert t.resume_fallback == "epoch_restart"
+    fb = [e for e in events if e.get("event") == "resume_fallback"]
+    assert fb and fb[0]["mode"] == "epoch_restart" and fb[0]["step"] == 9999
+    # a clean resume never sets the flag
+    t2 = Trainer(cfg, vocab, corpus)
+    t2.train(log_every=0)
+    assert t2.resume_fallback is None
+
+
+def test_cli_records_resume_fallback_in_manifest(tmp_path, corpus_file):
+    from word2vec_tpu.cli import main
+    from word2vec_tpu.config import Word2VecConfig as _C
+
+    # craft a checkpoint whose step counter is out of range for its own
+    # config (a geometry-drift artifact a library writer could produce)
+    cfg = _C(model="sg", train_method="ns", negative=2, word_dim=8,
+             window=5, batch_rows=4, max_sentence_len=32, min_count=1,
+             iters=2, seed=0)
+    from word2vec_tpu.data.batcher import PackedCorpus as _PC
+    from word2vec_tpu.data.corpus import load_corpus
+
+    vocab, flat = load_corpus(corpus_file, min_count=1)
+    corpus = _PC.from_flat(flat, cfg.max_sentence_len)
+    t = Trainer(cfg, vocab, corpus)
+    ck = str(tmp_path / "ck")
+    st = t.init_state()
+    st.step = 10_000
+    save_checkpoint(ck, st, cfg, vocab)
+
+    mdir = str(tmp_path / "mdir")
+    with pytest.warns(UserWarning, match="out of range"):
+        rc = main(_common(corpus_file) + [
+            "-output", str(tmp_path / "v.txt"),
+            "--resume", ck, "--metrics-dir", mdir,
+        ])
+    assert rc == 0
+    man = json.load(open(os.path.join(mdir, "manifest.json")))
+    assert man["resume_fallback"] == "epoch_restart"
+    assert man["shutdown"] == "clean"
 
 
 def test_cli_resume_from_corrupt_falls_back_to_old(tmp_path, corpus_file):

@@ -1,0 +1,488 @@
+"""Distributed watchdog (word2vec_tpu/resilience/watchdog.py): step-deadline
+stall detection, deadline-bounded collectives, and peer-liveness heartbeats.
+
+The three load-bearing guarantees, pinned end to end:
+  * a run that stops reaching step boundaries is SHOT within the effective
+    deadline — with all-thread stacks, the wedged phase named from the
+    PhaseRecorder's open spans, `shutdown: stalled` in the manifest, and
+    EXIT_STALLED so schedulers requeue with --resume (byte-for-byte, like
+    every other resume);
+  * an idle watchdog is free: no extra device sync/dispatch per step, and a
+    beat costs well under 1% of a step (the overhead contract, also banked
+    by benchmarks/watchdog_overhead.py);
+  * a bounded collective raises SyncTimeout instead of hanging forever when
+    a peer never joins (the kill-one-of-N drill in test_multiproc.py runs
+    the real multi-process version).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.obs.phases import PhaseRecorder
+from word2vec_tpu.resilience.faults import FaultPlan
+from word2vec_tpu.resilience.shutdown import EXIT_PREEMPTED, ShutdownHandler
+from word2vec_tpu.resilience.watchdog import (
+    EXIT_STALLED,
+    PeerAgreement,
+    StepWatchdog,
+    SyncTimeout,
+    bounded_call,
+    set_sync_deadline,
+    sync_deadline,
+)
+from word2vec_tpu.train import Trainer
+from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup(**kw):
+    kw.setdefault("iters", 2)
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        batch_rows=4, max_sentence_len=16, min_count=1, seed=9, **kw,
+    )
+    vocab = zipf_vocab(40, 4000)
+    ids = zipf_corpus_ids(vocab, 3000, seed=5)
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    return cfg, vocab, corpus
+
+
+# ------------------------------------------------------------ StepWatchdog
+class TestStepWatchdog:
+    def test_fires_when_no_beat_lands(self):
+        rec = {}
+        wd = StepWatchdog(deadline=0.2, grace_secs=0.2,
+                          on_fire=lambda r: rec.update(r))
+        wd.arm()
+        try:
+            assert wd.fired.wait(3.0), "watchdog never fired"
+        finally:
+            wd.disarm()
+        assert rec["event"] == "stalled"
+        assert rec["elapsed_s"] >= 0.2
+        # fired within ~2x the deadline (deadline + monitor interval)
+        assert rec["elapsed_s"] < 2 * 0.2 + 0.2
+        assert "main-loop" in rec["phase"]  # nothing was open
+
+    def test_beats_keep_it_quiet_and_disarm_stops_it(self):
+        wd = StepWatchdog(deadline=0.2, grace_secs=0.2,
+                          on_fire=lambda r: None)
+        wd.arm()
+        for step in range(8):
+            wd.beat(step)
+            time.sleep(0.03)
+        assert not wd.fired.is_set()
+        wd.disarm()
+        time.sleep(0.5)  # well past the deadline, but disarmed
+        assert not wd.fired.is_set()
+
+    def test_adaptive_deadline_tracks_rolling_p90(self):
+        wd = StepWatchdog(deadline=0.05, factor=4.0, grace_secs=9.0,
+                          on_fire=lambda r: None)
+        # simulate steady 100ms boundaries without waiting for them
+        wd._beats = 10
+        wd._laps = [0.1] * 10
+        eff = wd.effective_deadline()
+        assert eff == pytest.approx(4.0 * 0.1, rel=0.05)
+        # a configured deadline larger than factor*p90 wins
+        wd.deadline = 3.0
+        assert wd.effective_deadline() == 3.0
+
+    def test_grace_window_before_min_beats(self):
+        wd = StepWatchdog(deadline=0.1, grace_secs=7.0, min_beats=2,
+                          on_fire=lambda r: None)
+        assert wd.effective_deadline() == 7.0  # compile grace
+        wd.beat(1)
+        assert wd.effective_deadline() == 7.0  # still < min_beats
+        wd.beat(2)
+        assert wd.effective_deadline() < 7.0  # adaptive now
+
+    def test_stall_artifacts_and_manifest(self, tmp_path):
+        mdir = str(tmp_path / "mdir")
+        man = tmp_path / "mdir" / "manifest.json"
+        os.makedirs(mdir)
+        man.write_text(json.dumps({"schema": 1, "shutdown": None}))
+        rec = {}
+        done = threading.Event()
+
+        def on_fire(r):
+            rec.update(r)
+            done.set()
+
+        phases = PhaseRecorder()
+        wd = StepWatchdog(deadline=0.15, grace_secs=0.15, phases=phases,
+                          metrics_dir=mdir, manifest_path=str(man),
+                          on_fire=on_fire)
+        # wedge a device_wait span open in another thread, like a drain
+        # blocked on a dead collective
+        release = threading.Event()
+
+        def wedged():
+            with phases.span("device_wait"):
+                release.wait(5.0)
+
+        t = threading.Thread(target=wedged, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        wd.arm()
+        wd.beat(7)
+        try:
+            assert done.wait(3.0)
+        finally:
+            release.set()
+            wd.disarm()
+        assert rec["step"] == 7
+        assert rec["phase"] == "device_wait"
+        assert rec["open_spans"]["device_wait"] > 0
+        stall = json.loads((tmp_path / "mdir" / "stall.json").read_text())
+        assert stall["phase"] == "device_wait" and stall["step"] == 7
+        stacks = (tmp_path / "mdir" / "stall_stacks.txt").read_text()
+        assert "Thread" in stacks and "wedged" in stacks
+        man_out = json.loads(man.read_text())
+        assert man_out["shutdown"] == "stalled"
+        assert man_out["stall"]["step"] == 7
+
+    def test_exit_code_distinct(self):
+        assert EXIT_STALLED not in (0, 1, 2, EXIT_PREEMPTED)
+
+    def test_rejects_nonpositive_deadline(self):
+        with pytest.raises(ValueError):
+            StepWatchdog(deadline=0)
+
+
+# ----------------------------------------------------- PhaseRecorder spans
+class TestOpenSpans:
+    def test_open_and_wedged(self):
+        rec = PhaseRecorder()
+        assert rec.open_spans() == {}
+        assert rec.wedged_phase() is None
+        with rec.span("h2d"):
+            with rec.span("device_wait"):
+                opens = rec.open_spans()
+                assert set(opens) == {"h2d", "device_wait"}
+                assert opens["h2d"] >= opens["device_wait"]
+                # loop-stalling phase beats the overlapped h2d
+                assert rec.wedged_phase() == "device_wait"
+            assert rec.wedged_phase() == "h2d"  # only non-stalling left
+        assert rec.open_spans() == {}
+        assert rec.wedged_phase() is None
+
+    def test_timed_iter_next_is_an_open_span(self):
+        rec = PhaseRecorder()
+        seen = {}
+
+        def gen():
+            seen.update(rec.open_spans())
+            yield 1
+
+        assert list(rec.timed_iter(gen(), "batcher_wait")) == [1]
+        assert "batcher_wait" in seen  # open WHILE blocked in next()
+        assert rec.open_spans() == {}  # closed afterwards
+
+    def test_exception_inside_span_still_closes(self):
+        rec = PhaseRecorder()
+        with pytest.raises(RuntimeError):
+            with rec.span("checkpoint"):
+                raise RuntimeError("boom")
+        assert rec.open_spans() == {}
+
+
+# ------------------------------------------------------------ bounded_call
+class TestBoundedCall:
+    def test_no_deadline_is_a_plain_call(self):
+        assert bounded_call(lambda: 42) == 42
+
+    def test_returns_value_under_deadline(self):
+        assert bounded_call(lambda: 7, deadline=2.0) == 7
+
+    def test_times_out_with_named_what(self):
+        with pytest.raises(SyncTimeout, match="agree channel"):
+            bounded_call(lambda: time.sleep(5), what="agree channel",
+                         deadline=0.1)
+
+    def test_propagates_exceptions(self):
+        def boom():
+            raise KeyError("inner")
+
+        with pytest.raises(KeyError):
+            bounded_call(boom, deadline=2.0)
+
+    def test_module_deadline_scoping(self):
+        prev = set_sync_deadline(0.1)
+        try:
+            assert sync_deadline() == 0.1
+            with pytest.raises(SyncTimeout):
+                bounded_call(lambda: time.sleep(5), what="x")
+        finally:
+            set_sync_deadline(prev)
+        # 0/None disables
+        prev = set_sync_deadline(0)
+        try:
+            assert sync_deadline() is None
+        finally:
+            set_sync_deadline(prev)
+
+
+# ---------------------------------------------------------- PeerAgreement
+class TestPeerAgreement:
+    def test_off_boundary_is_local_and_false(self):
+        h = ShutdownHandler()
+        h.requested = True
+        pa = PeerAgreement(h, agree_every=16)
+        assert pa.check(7) is False  # no collective off the cadence
+
+    def test_on_boundary_resolves_flag(self):
+        # process_count == 1: global_heartbeat is the identity row, so the
+        # verdict is this process's own flag — the single-host degenerate
+        # of the fleet-wide max vote
+        h = ShutdownHandler()
+        pa = PeerAgreement(h, agree_every=16,
+                           step_time_fn=lambda: 12.5)
+        assert pa.check(16) is False
+        h.requested = True
+        assert pa.check(32) is True
+
+    def test_straggler_warning_names_process(self):
+        events = []
+        pa = PeerAgreement(ShutdownHandler(), agree_every=4,
+                           log_fn=events.append)
+        rows = np.asarray([
+            [0.0, 0.0, 8.0, 20.0],
+            [1.0, 0.0, 8.0, 21.0],
+            [2.0, 0.0, 8.0, 500.0],  # the slow host
+        ])
+        with pytest.warns(UserWarning, match="process 2 is a straggler"):
+            pa.inspect(rows, 8)
+        assert events and events[0]["event"] == "straggler"
+        assert events[0]["process"] == 2
+        # warned once, not every boundary
+        pa.inspect(rows, 12)
+        assert len([e for e in events if e["event"] == "straggler"]) == 1
+
+    def test_desync_warning(self):
+        pa = PeerAgreement(ShutdownHandler(), agree_every=4)
+        rows = np.asarray([[0.0, 0.0, 8.0, 1.0], [1.0, 0.0, 4.0, 1.0]])
+        with pytest.warns(UserWarning, match="desynchronized"):
+            pa.inspect(rows, 8)
+
+
+# ------------------------------------------------------ trainer integration
+def counting_device_get(monkeypatch):
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counted(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counted)
+    return calls
+
+
+@pytest.mark.parametrize("chunk_steps", [1, 0])
+def test_trainer_beats_every_boundary_no_fire(chunk_steps):
+    cfg, vocab, corpus = _setup(chunk_steps=chunk_steps)
+    t = Trainer(cfg, vocab, corpus)
+    t.watchdog = wd = StepWatchdog(deadline=60.0)
+    state, rep = t.train(log_every=0)
+    assert not wd.fired.is_set()
+    assert not wd._armed  # disarmed on exit
+    if chunk_steps == 1:
+        assert wd._beats == rep.steps  # one beat per optimizer step
+    else:
+        # chunked dispatch beats at chunk boundaries: fewer, but present
+        assert 1 <= wd._beats <= rep.steps
+    assert wd.step_stats().get("laps", 0) >= 1
+
+
+def test_idle_watchdog_overhead_contract(monkeypatch):
+    """Satellite acceptance: an idle watchdog adds NO device sync beyond
+    the existing lagged drain (dispatch-count pin, same bound as
+    tests/test_obs.py) and a beat costs <1% of a measured step."""
+    cfg, vocab, corpus = _setup(chunk_steps=1)
+    t = Trainer(cfg, vocab, corpus)
+    t.watchdog = wd = StepWatchdog(deadline=60.0)
+    calls = counting_device_get(monkeypatch)
+    state, rep = t.train(log_every=0)
+    # one lagged drain per step + the final-loss fetch — identical to the
+    # no-watchdog bound: the watchdog added zero fetches
+    assert calls["n"] <= rep.steps + 2
+    # beat microcost vs the run's own p50 step time
+    p50_s = wd.step_stats()["p50_ms"] / 1e3
+    n = 10_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        wd.beat(i)
+    per_beat = (time.perf_counter() - t0) / n
+    assert per_beat < 0.01 * p50_s, (
+        f"beat costs {per_beat * 1e6:.1f}us vs p50 step {p50_s * 1e3:.2f}ms"
+    )
+
+
+def test_hang_fault_trips_watchdog_in_process():
+    """--faults hang@K wedges the loop at boundary K; the armed watchdog
+    names the stall (on_fire test mode — the CLI path os._exits instead)."""
+    cfg, vocab, corpus = _setup(chunk_steps=1, iters=1)
+    t = Trainer(cfg, vocab, corpus)
+    t.fault_plan = FaultPlan.parse("hang@3:secs=1.5")
+    rec = {}
+    # grace covers the compile; after min_beats the adaptive deadline is
+    # max(0.25, 4 x p90 of ~ms steps) = 0.25s, well under the 1.5s hang
+    t.watchdog = wd = StepWatchdog(
+        deadline=0.25, grace_secs=30.0, on_fire=lambda r: rec.update(r),
+    )
+    state, rep = t.train(log_every=0)  # completes after the 1.5s sleep
+    assert wd.fired.is_set()
+    assert rec["step"] == 3
+    assert t.fault_plan.log[0]["kind"] == "hang"
+    assert rep.steps > 3  # the run went on; only the CLI converts to exit
+
+
+# ------------------------------------------------------------- CLI chaos
+@pytest.fixture
+def corpus_file(tmp_path):
+    rng = np.random.default_rng(0)
+    toks = []
+    for _ in range(400):
+        toks += ["x", str(rng.choice(["a", "b"])), "y",
+                 "p", str(rng.choice(["c", "d"])), "q"]
+    p = tmp_path / "corpus.txt"
+    p.write_text(" ".join(toks))
+    return str(p)
+
+
+def _common(corpus_file):
+    return [
+        "-train", corpus_file, "-size", "8", "-negative", "2",
+        "-min-count", "1", "--backend", "cpu", "--batch-rows", "4",
+        "--max-sentence-len", "32", "--chunk-steps", "1", "--quiet",
+    ]
+
+
+def test_cli_stall_exits_stalled_then_resume_parity(tmp_path, corpus_file):
+    """Tentpole acceptance: a hang past --step-deadline exits EXIT_STALLED
+    within ~2x the deadline, with a stack dump + phase verdict in the
+    metrics dir and `shutdown: stalled` in the manifest; --resume then
+    reproduces the uninterrupted run byte-for-byte.
+
+    The stalled run is a SUBPROCESS: the watchdog's fire path os._exits by
+    design (a wedged main thread can't unwind), which would kill pytest
+    in-process."""
+    from word2vec_tpu.cli import main
+
+    ck = str(tmp_path / "ck")
+    mdir = str(tmp_path / "mdir")
+    common = _common(corpus_file)
+    deadline = 2.0
+    t0 = time.perf_counter()
+    out = subprocess.run(
+        [sys.executable, "-m", "word2vec_tpu.cli", *common,
+         "-output", str(tmp_path / "v_stall.txt"), "-iter", "3",
+         "--seed", "3", "--checkpoint-dir", ck, "--checkpoint-every", "5",
+         "--faults", "hang@10:secs=120", "--step-deadline", str(deadline),
+         "--metrics-dir", mdir],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO},
+    )
+    wall = time.perf_counter() - t0
+    assert out.returncode == EXIT_STALLED, out.stderr[-2000:]
+    # the whole run (incl. startup+compile) beat the 120s sleep by a mile:
+    # the stall itself was detected within ~2x the deadline
+    assert wall < 120, wall
+    assert "watchdog: no step boundary" in out.stderr
+    stall = json.loads(open(os.path.join(mdir, "stall.json")).read())
+    assert stall["step"] >= 10
+    assert stall["elapsed_s"] <= 2 * deadline + 1.0
+    assert "phase" in stall and "boundary_stats" in stall
+    assert os.path.getsize(os.path.join(mdir, "stall_stacks.txt")) > 0
+    man = json.load(open(os.path.join(mdir, "manifest.json")))
+    assert man["shutdown"] == "stalled"
+    assert not os.path.exists(tmp_path / "v_stall.txt")  # no export
+
+    # clean run + resume from the stalled checkpoint: byte-for-byte parity
+    vec_clean = str(tmp_path / "clean.txt")
+    vec_res = str(tmp_path / "resumed.txt")
+    assert main(common + ["-output", vec_clean, "-iter", "3",
+                          "--seed", "3"]) == 0
+    assert main(common + ["-output", vec_res, "-iter", "3", "--seed", "3",
+                          "--resume", ck]) == 0
+    assert open(vec_clean).read() == open(vec_res).read()
+
+
+def test_cli_rejects_bad_deadlines(corpus_file, capsys):
+    from word2vec_tpu.cli import main
+
+    assert main(_common(corpus_file) + ["--step-deadline", "-1"]) == 1
+    assert "--step-deadline" in capsys.readouterr().err
+    assert main(_common(corpus_file) + ["--sync-deadline", "-0.5"]) == 1
+    assert "--sync-deadline" in capsys.readouterr().err
+
+
+# ------------------------------------------------- resume vocab guard (CLI)
+def test_cli_resume_vocab_mismatch_guard(tmp_path, corpus_file):
+    """Satellite acceptance: --resume against a corpus that rebuilds to a
+    different vocabulary fails naming both paths; --allow-vocab-mismatch
+    overrides; the same corpus resumes clean."""
+    from word2vec_tpu.cli import main
+
+    ck = str(tmp_path / "ck")
+    common = _common(corpus_file)
+    rc = main(common + ["-output", str(tmp_path / "v.txt"), "-iter", "3",
+                        "--seed", "3", "--checkpoint-dir", ck,
+                        "--checkpoint-every", "5",
+                        "--faults", "sigterm@8"])
+    assert rc == EXIT_PREEMPTED  # mid-run checkpoint to resume from
+
+    # a DIFFERENT corpus: overlapping words so the override can still train
+    other = tmp_path / "other.txt"
+    other.write_text(" ".join(["x", "y", "p", "q", "zebra"] * 200))
+    mismatch = [
+        "-train", str(other), "-size", "8", "-negative", "2",
+        "-min-count", "1", "--backend", "cpu", "--batch-rows", "4",
+        "--max-sentence-len", "32", "--chunk-steps", "1", "--quiet",
+        "-output", str(tmp_path / "v2.txt"), "--resume", ck,
+    ]
+    rc = main(mismatch)
+    assert rc == 1
+    # the error names both paths (stderr asserted via capsys-free check of
+    # behavior: the override proceeds, proving it was the guard that fired)
+    assert main(mismatch + ["--allow-vocab-mismatch"]) == 0
+
+    # the ORIGINAL corpus still resumes without complaint
+    assert main(common + ["-output", str(tmp_path / "v3.txt"),
+                          "--resume", ck]) == 0
+
+
+def test_cli_resume_vocab_mismatch_error_text(tmp_path, corpus_file, capsys):
+    from word2vec_tpu.cli import main
+
+    ck = str(tmp_path / "ck")
+    common = _common(corpus_file)
+    rc = main(common + ["-output", str(tmp_path / "v.txt"), "-iter", "2",
+                        "--checkpoint-dir", ck, "--checkpoint-every", "5"])
+    assert rc == 0
+    capsys.readouterr()
+    other = tmp_path / "other.txt"
+    other.write_text(" ".join(["x", "y", "p", "q", "w2"] * 100))
+    rc = main([
+        "-train", str(other), "-size", "8", "-negative", "2",
+        "-min-count", "1", "--backend", "cpu", "--batch-rows", "4",
+        "--max-sentence-len", "32", "--quiet",
+        "-output", str(tmp_path / "v2.txt"), "--resume", ck,
+    ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert str(other) in err and ck in err  # names BOTH paths
+    assert "--allow-vocab-mismatch" in err

@@ -216,9 +216,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--faults", metavar="SPEC", default="",
                    help="fault-injection plan for chaos testing "
                         "(resilience/faults.py): comma-separated "
-                        "kind[@step][:key=val], e.g. 'nan@40,sigterm@80' or "
+                        "kind[@step][:key=val], e.g. 'nan@40,sigterm@80', "
+                        "'hang@10:secs=300', 'peer_dead@25', or "
                         "'ckpt_oserror:times=2,stall@10:secs=0.5'; or a "
                         ".json plan file")
+    p.add_argument("--step-deadline", type=float, default=0.0, metavar="SECS",
+                   help="step-deadline watchdog (resilience/watchdog.py; "
+                        "0 = off): if no step/chunk boundary lands within "
+                        "max(SECS, 4x rolling-p90 boundary time) — first "
+                        "compile covered by a grace window — dump all "
+                        "thread stacks + the wedged phase to --metrics-dir, "
+                        "mark the manifest 'shutdown: stalled', and exit "
+                        "76 (EXIT_STALLED) so schedulers requeue with "
+                        "--resume. Set SECS above your worst checkpoint + "
+                        "mid-run compile wall")
+    p.add_argument("--sync-deadline", type=float, default=0.0, metavar="SECS",
+                   help="deadline on cross-process collectives (multihost "
+                        "agree/heartbeat + replica sync; 0 = off/unbounded): "
+                        "a dead peer turns the infinite collective hang "
+                        "into a coordinated abort — survivors checkpoint "
+                        "where safe and exit 75 (EXIT_PREEMPTED) for "
+                        "requeue with --resume")
+    p.add_argument("--allow-vocab-mismatch", action="store_true",
+                   help="skip the --resume vocabulary-compatibility guard "
+                        "(by default a resume whose corpus rebuilds to a "
+                        "DIFFERENT vocabulary than the checkpoint's — "
+                        "content-hash compared — is an error: training "
+                        "would silently re-attribute embedding rows)")
     p.add_argument("--eval-ws353", metavar="FILE",
                    help="WordSim-353 csv/tsv for post-train eval")
     p.add_argument("--eval-analogy", metavar="FILE",
@@ -331,6 +355,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     if args.auto_recover and not (0.0 < args.recover_alpha_scale <= 1.0):
         print("error: --recover-alpha-scale must be in (0, 1]", file=sys.stderr)
+        return 1
+    if args.step_deadline < 0:
+        print("error: --step-deadline must be >= 0", file=sys.stderr)
+        return 1
+    if args.sync_deadline < 0:
+        print("error: --sync-deadline must be >= 0", file=sys.stderr)
         return 1
 
     # Resume: the checkpoint's config and vocab are authoritative — resuming
@@ -530,7 +560,47 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     if ck_vocab is not None:
         vocab = ck_vocab
-        flat = native.encode_file(args.train, vocab, mode)
+        if args.read_vocab and Vocab.load(
+            args.read_vocab
+        ).content_hash() != vocab.content_hash() and not args.allow_vocab_mismatch:
+            print(
+                f"error: -read-vocab {args.read_vocab} holds a different "
+                f"vocabulary than the checkpoint at {args.resume} "
+                "(content-hash mismatch); resuming would re-attribute "
+                "embedding rows. Drop -read-vocab (the checkpoint's vocab "
+                "is authoritative) or pass --allow-vocab-mismatch.",
+                file=sys.stderr,
+            )
+            return 1
+        if not args.read_vocab and not args.allow_vocab_mismatch:
+            # Resume-compatibility guard: rebuild the vocabulary this corpus
+            # + the checkpoint's min_count produce and compare content
+            # hashes. A different corpus used to train SILENTLY against the
+            # checkpoint's vocab — every row's meaning drifts while the loss
+            # looks healthy. Hash-equal vocabularies encode identically
+            # (deterministic sort), so the rebuilt ids are reused — the
+            # guard costs one vocab count pass, not a second encode.
+            rb_vocab, rb_flat = load_corpus(
+                args.train, fmt=args.corpus_format, min_count=cfg.min_count,
+                max_vocab=args.max_vocab,
+            )
+            if rb_vocab.content_hash() != vocab.content_hash():
+                print(
+                    f"error: the corpus at {args.train} rebuilds to a "
+                    f"different vocabulary ({len(rb_vocab)} words) than the "
+                    f"checkpoint at {args.resume} pins ({len(vocab)} words, "
+                    "content-hash mismatch): this is not the corpus the "
+                    "checkpoint was trained on (or -min-count/--max-vocab "
+                    "differ from the original run). Resuming would silently "
+                    "re-attribute embedding rows; pass "
+                    "--allow-vocab-mismatch to train the checkpoint's "
+                    "vocab against this corpus anyway.",
+                    file=sys.stderr,
+                )
+                return 1
+            flat = rb_flat
+        else:
+            flat = native.encode_file(args.train, vocab, mode)
     elif args.read_vocab:
         vocab = Vocab.load(args.read_vocab)  # Word2Vec.cpp:179-196
         flat = native.encode_file(args.train, vocab, mode)
@@ -694,7 +764,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .obs.health import DivergenceError
     from .obs.manifest import update_manifest
     from .resilience import faults as _faults
+    from .resilience import watchdog as _watchdog
     from .resilience.shutdown import EXIT_PREEMPTED, ShutdownHandler
+    from .resilience.watchdog import SyncTimeout
 
     manifest_path = (
         os.path.join(metrics_dir, "manifest.json") if metrics_dir else None
@@ -704,6 +776,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     # the next step boundary (multihost-agreed); the run then checkpoints
     # and exits EXIT_PREEMPTED so a scheduler can requeue with --resume.
     handler = ShutdownHandler().install()
+
+    # Step-deadline watchdog: a run that stops reaching step boundaries is
+    # shot (EXIT_STALLED) with stacks + the wedged phase in the metrics dir
+    # instead of burning chip time invisibly. Installed BEFORE
+    # install_shutdown so the multihost stop check's heartbeat can read the
+    # watchdog's step-time p50.
+    if args.step_deadline:
+        trainer.watchdog = _watchdog.StepWatchdog(
+            deadline=args.step_deadline,
+            phases=trainer.phases,
+            metrics_dir=metrics_dir,
+            manifest_path=manifest_path,
+        )
+    # Deadline-bounded collectives: process-wide, consumed by
+    # parallel/multihost's agree/heartbeat allgathers and the sharded
+    # trainer's replica-sync wait. Restored in the finally below — main()
+    # runs in-process under tests, and a leaked deadline would bound some
+    # other run's collectives.
+    prev_sync_deadline = _watchdog.set_sync_deadline(
+        args.sync_deadline or None
+    )
     trainer.install_shutdown(handler)
 
     # Supervised auto-recovery: DivergenceError rolls back to the last-good
@@ -756,11 +849,66 @@ def main(argv: Optional[List[str]] = None) -> int:
             })
         hub.close()
         return 2
+    except SyncTimeout as e:
+        # Coordinated abort-to-requeue: a peer died or wedged and a bounded
+        # collective timed out on THIS host. Every survivor takes this same
+        # path (their collectives time out too), so nobody is stranded.
+        # Checkpoint where safe — the last boundary-consistent state, via a
+        # bounded save, since a sharded export itself runs collectives that
+        # may hang against the dead peer — then exit the requeue rc.
+        print(f"error: {e}", file=sys.stderr)
+        last = getattr(trainer, "last_state", None)
+        saved = False
+        if args.checkpoint_dir and last is not None:
+            def _final_save():
+                # unreplicated() may run mesh collectives — against a dead
+                # peer those can hang too, hence the bounded wrapper
+                snap = unreplicated(last)
+                if is_primary:
+                    save_checkpoint(
+                        args.checkpoint_dir, snap, trainer.config, vocab,
+                        keep=args.checkpoint_keep,
+                    )
+
+            try:
+                _watchdog.bounded_call(
+                    _final_save,
+                    what="final checkpoint after peer loss",
+                    deadline=args.sync_deadline or 30.0,
+                )
+                saved = True
+            except Exception as ce:  # noqa: BLE001 — best-effort abort path
+                print(
+                    f"warning: final checkpoint not written ({ce}); the "
+                    "last periodic checkpoint is the resume point",
+                    file=sys.stderr,
+                )
+        if manifest_path:
+            update_manifest(manifest_path, {
+                "shutdown": "peer_lost",
+                "sync_timeout": {"what": e.what, "deadline_s": e.deadline},
+                "final_checkpoint": saved,
+            })
+        print(
+            f"peer lost: aborting at step "
+            f"{getattr(last, 'step', '?')} for requeue"
+            + (
+                f"; requeue with --resume {args.checkpoint_dir}"
+                if args.checkpoint_dir else
+                "; WARNING: no --checkpoint-dir, progress rides on the "
+                "last periodic checkpoint only"
+            ),
+            file=sys.stderr,
+        )
+        hub.close()
+        return EXIT_PREEMPTED
     finally:
-        # restore signal dispositions and the process-wide fault plan on
-        # every exit path — main() runs in-process under tests, and a
-        # leaked SIGTERM handler would outlive the run it protects
+        # restore signal dispositions, the process-wide fault plan, and the
+        # process-wide sync deadline on every exit path — main() runs
+        # in-process under tests, and a leaked SIGTERM handler or deadline
+        # would outlive the run it protects
         handler.uninstall()
+        _watchdog.set_sync_deadline(prev_sync_deadline)
         if fault_plan:
             _faults.activate(prev_plan)
     if report.health is not None or report.phases is not None:
@@ -794,11 +942,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     # carries any auto-recovery history.
     preempted = report.interrupted == "preempted"
     if manifest_path:
-        update_manifest(manifest_path, {
+        end_fields = {
             "shutdown": "preempted" if preempted else "clean",
             "final_step": state.step,
             "recoveries": report.recoveries or [],
-        })
+        }
+        if getattr(trainer, "resume_fallback", None):
+            # an out-of-range checkpointed step counter fell back to epoch
+            # restart (train._resume_skip) — recorded so the manifest shows
+            # data was re-trained, not resumed
+            end_fields["resume_fallback"] = trainer.resume_fallback
+        update_manifest(manifest_path, end_fields)
 
     if preempted:
         # Preemption-safe exit: checkpoint the stopped-at-boundary state,

@@ -122,6 +122,23 @@ class Vocab:
         for sentence in sentences:
             yield self.encode(sentence)
 
+    def content_hash(self) -> str:
+        """sha256 over the ordered (index, word, count) content.
+
+        The resume-compatibility fingerprint: two Vocab objects hash equal
+        iff they assign the same words to the same rows with the same
+        counts — exactly the condition under which a checkpoint's embedding
+        rows keep their meaning and the deterministic corpus encoding is
+        identical. Stored in every checkpoint's integrity.json metadata
+        (io/checkpoint.py) and compared by the CLI's --resume guard against
+        the vocabulary the current corpus rebuilds to."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for i, (w, c) in enumerate(zip(self.words, self.counts)):
+            h.update(f"{i}\t{w}\t{int(c)}\n".encode("utf-8"))
+        return h.hexdigest()
+
     # ------------------------------------------------------------ persistence
     def save(self, path: str) -> None:
         """Write `index count word` lines (reference: Word2Vec.cpp:171-177)."""

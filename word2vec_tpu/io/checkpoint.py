@@ -77,20 +77,36 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
-def write_integrity(dirpath: str) -> dict:
+def write_integrity(dirpath: str, meta: Optional[dict] = None) -> dict:
     """Hash every regular file in `dirpath` into its integrity manifest.
     Called LAST during a save, so a manifest's presence certifies that every
-    named file was completely written when the hash was taken."""
+    named file was completely written when the hash was taken. `meta`
+    carries content-level fingerprints (today: the vocabulary's
+    content_hash) that external tools can read without parsing the
+    checkpoint itself; verification ignores it."""
     files = {
         e.name: _sha256(e.path)
         for e in sorted(os.scandir(dirpath), key=lambda e: e.name)
         if e.is_file() and e.name != INTEGRITY_FILE
     }
     man = {"schema": 1, "algo": "sha256", "files": files}
+    if meta:
+        man["meta"] = dict(meta)
     with open(os.path.join(dirpath, INTEGRITY_FILE), "w") as f:
         json.dump(man, f, indent=2)
         f.write("\n")
     return man
+
+
+def read_integrity_meta(path: str) -> dict:
+    """The `meta` block of a checkpoint's integrity manifest ({} when the
+    manifest or the block is missing/unreadable — metadata reads must never
+    fail a resume)."""
+    try:
+        with open(os.path.join(path, INTEGRITY_FILE)) as f:
+            return dict(json.load(f).get("meta") or {})
+    except (OSError, ValueError, TypeError, AttributeError):
+        return {}
 
 
 def verify_checkpoint(path: str) -> None:
@@ -223,7 +239,12 @@ def _save_once(path: str, state: TrainState, config: Word2VecConfig,
             json.dump(dataclasses.asdict(config), f, indent=2)
         if vocab is not None:
             vocab.save(os.path.join(tmp, "vocab.txt"))
-        write_integrity(tmp)  # last: its presence certifies a complete write
+        meta = (
+            {"vocab_hash": vocab.content_hash()} if vocab is not None else None
+        )
+        # written last: its presence certifies a complete write; the meta
+        # block carries the vocab fingerprint for the --resume corpus guard
+        write_integrity(tmp, meta=meta)
         # Atomic overwrite with retention: rotate the backup chain, move the
         # old checkpoint to `.old`, land the new one. A crash at any point
         # leaves either the old or the new checkpoint recoverable (the

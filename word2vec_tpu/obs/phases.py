@@ -53,12 +53,18 @@ class PhaseRecorder:
         self._laps: Dict[str, list] = {}
         self._counts: Dict[str, int] = {}
         self._totals: Dict[str, float] = {}
+        # currently-OPEN spans per thread: {thread id: [(name, t0), ...]}.
+        # The stall watchdog (resilience/watchdog.py) reads this to name the
+        # wedged phase of a hung step — a span that never closes is exactly
+        # the evidence completed-lap stats can't show.
+        self._active: Dict[int, list] = {}
 
     def reset(self) -> None:
         with self._lock:
             self._laps.clear()
             self._counts.clear()
             self._totals.clear()
+            self._active.clear()
 
     # ------------------------------------------------------------ recording
     def note(self, name: str, seconds: float) -> None:
@@ -73,14 +79,30 @@ class PhaseRecorder:
             else:
                 laps[n % self.MAX_SAMPLES] = seconds
 
+    def _enter(self, name: str, t0: float) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            self._active.setdefault(tid, []).append((name, t0))
+
+    def _exit(self) -> None:
+        tid = threading.get_ident()
+        with self._lock:
+            stack = self._active.get(tid)
+            if stack:
+                stack.pop()
+            if not stack:
+                self._active.pop(tid, None)
+
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:
         """Time a region (and annotate it on the profiler timeline)."""
         with annotate(name):
             t0 = time.perf_counter()
+            self._enter(name, t0)
             try:
                 yield
             finally:
+                self._exit()
                 self.note(name, time.perf_counter() - t0)
 
     def timed_iter(self, iterable: Iterable, name: str) -> Iterator:
@@ -89,12 +111,45 @@ class PhaseRecorder:
         it = iter(iterable)
         while True:
             t0 = time.perf_counter()
+            self._enter(name, t0)
             try:
                 item = next(it)
             except StopIteration:
                 return
+            finally:
+                self._exit()
             self.note(name, time.perf_counter() - t0)
             yield item
+
+    # ------------------------------------------------------- liveness view
+    def open_spans(self) -> Dict[str, float]:
+        """{phase: seconds open} of every currently-OPEN span, keeping the
+        oldest occurrence per name across threads. Empty between spans."""
+        now = time.perf_counter()
+        with self._lock:
+            out: Dict[str, float] = {}
+            for stack in self._active.values():
+                for name, t0 in stack:
+                    age = now - t0
+                    if age > out.get(name, -1.0):
+                        out[name] = age
+            return out
+
+    def wedged_phase(self) -> Optional[str]:
+        """The phase most plausibly responsible for a stalled step: the
+        longest-open LOOP-STALLING span (batcher_wait / dispatch /
+        device_wait / checkpoint — overlapped producer h2d stalls nothing),
+        falling back to the longest-open span of any name, or None when no
+        span is open (the hang is in the loop body itself or on device)."""
+        opens = self.open_spans()
+        if not opens:
+            return None
+        stalling = {
+            n: a for n, a in opens.items()
+            if n in INPUT_PHASES + COMPUTE_PHASES + ("checkpoint",)
+        }
+        pick = stalling or opens
+        return max(pick, key=lambda n: pick[n])
 
     # ----------------------------------------------------------- reporting
     def snapshot(self) -> Dict[str, Dict]:

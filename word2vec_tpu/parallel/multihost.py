@@ -72,6 +72,40 @@ class DistConfig:
         return cls(coord, n, int(pid))
 
 
+def _enable_cpu_collectives() -> None:
+    """Give the CPU backend a cross-process collectives implementation.
+
+    jaxlib's CPU default is 'none', under which EVERY multi-process
+    computation — shard_map psums, process_allgather, the whole distributed
+    trainer — fails with "Multiprocess computations aren't implemented on
+    the CPU backend". When the resolved platform includes cpu and the knob
+    exists (jaxlib >= 0.4.34), switch it to gloo (TCP, brokered through the
+    already-configured distributed client). Non-CPU platforms and older
+    jaxlibs: no-op. Must run before the first backend use, which is why
+    initialize_from_env calls it ahead of jax.distributed.initialize."""
+    try:
+        plats = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+    except AttributeError:
+        plats = os.environ.get("JAX_PLATFORMS", "")
+    names = [p.strip() for p in str(plats).split(",") if p.strip()]
+    if "cpu" not in names:
+        return
+    try:
+        # the flag is update()-able but not attribute-readable on this
+        # jax; read through the flag holder and fall back to "none"
+        from jax._src import xla_bridge as _xb
+
+        current = _xb.CPU_COLLECTIVES_IMPLEMENTATION.value
+    except Exception:
+        current = "none"
+    if current not in (None, "none"):
+        return  # operator already chose (e.g. mpi) — respect it
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass  # knob absent (old jaxlib) — single-process still works
+
+
 def initialize_from_env(env=os.environ) -> bool:
     """Call jax.distributed.initialize from the W2V_* environment contract.
 
@@ -85,6 +119,7 @@ def initialize_from_env(env=os.environ) -> bool:
     cfg = DistConfig.from_env(env)
     if cfg is None:
         return False
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=cfg.coordinator,
         num_processes=cfg.num_processes,
@@ -163,7 +198,17 @@ def _global_agree(value: int, reduce_fn) -> int:
     import numpy as np
     from jax.experimental import multihost_utils
 
-    return int(reduce_fn(multihost_utils.process_allgather(np.int64(value))))
+    from ..resilience.watchdog import bounded_call
+
+    # Deadline-bounded: a peer that died mid-run turns this allgather into
+    # an infinite hang for every survivor. With a sync deadline installed
+    # (resilience/watchdog.set_sync_deadline, CLI --sync-deadline) the hang
+    # becomes a SyncTimeout the driver converts into a coordinated
+    # abort-to-requeue; without one, behavior is the old unbounded block.
+    return int(reduce_fn(bounded_call(
+        lambda: multihost_utils.process_allgather(np.int64(value)),
+        what="global_agree allgather",
+    )))
 
 
 def global_agree_min(value: int) -> int:
@@ -197,3 +242,30 @@ def global_agree_max(value: int) -> int:
     import numpy as np
 
     return _global_agree(value, np.max)
+
+
+def global_heartbeat(values) -> "np.ndarray":
+    """Allgather one small float row per process -> [P, len(values)].
+
+    The liveness channel of resilience/watchdog.PeerAgreement: at the
+    agreement cadence every process contributes (process id, stop flag,
+    step, step-time p50 ms) in ONE collective — the stop vote and the
+    straggler/desync attribution ride the same allgather the old
+    global_agree_max used, so peer liveness costs no extra collective.
+    Deadline-bounded like _global_agree: a dead peer raises SyncTimeout
+    instead of hanging the fleet. Single-process: returns [[*values]]
+    without touching the collective machinery.
+    """
+    import numpy as np
+
+    row = np.asarray(values, dtype=np.float64)
+    if jax.process_count() == 1:
+        return row[None, :]
+    from jax.experimental import multihost_utils
+
+    from ..resilience.watchdog import bounded_call
+
+    return np.asarray(bounded_call(
+        lambda: multihost_utils.process_allgather(row),
+        what="peer-liveness heartbeat allgather",
+    ))

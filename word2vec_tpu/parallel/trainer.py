@@ -443,8 +443,34 @@ class ShardedTrainer(Trainer):
             params = self.sync_fn(params, self._sync_base)
             # distinct buffer: the step updates params in place (donation)
             self._sync_base = {k: v.copy() for k, v in params.items()}
-            return params
-        return self.sync_fn(params)
+        else:
+            params = self.sync_fn(params)
+        self._bound_sync_wait(params)
+        return params
+
+    def _bound_sync_wait(self, params: Params) -> None:
+        """Deadline-bound the replica-sync collective in MULTI-PROCESS mode.
+
+        The pmean/psum is dispatched async; with a dead peer it never
+        completes and the hang surfaces wherever the host next blocks on a
+        device value — possibly a full dp_sync_every later, inside an
+        unrelated fetch. When a sync deadline is installed (--sync-deadline)
+        and peers exist, block on the sync result in a bounded worker so the
+        hang is attributed HERE and raises SyncTimeout for the coordinated
+        abort. Single-process or no deadline: no wait, no extra sync point
+        (the step watchdog still bounds single-host device hangs)."""
+        if self.procs <= 1:
+            return
+        from ..resilience.watchdog import bounded_call, sync_deadline
+
+        deadline = sync_deadline()
+        if not deadline:
+            return
+        bounded_call(
+            lambda: jax.block_until_ready(params),
+            what="replica-sync collective",
+            deadline=deadline,
+        )
 
     def _batches(
         self, batcher: BatchIterator, epoch_index: int, skip: int = 0
@@ -507,7 +533,11 @@ class ShardedTrainer(Trainer):
         spe = self._agreed_steps_per_epoch(batcher, local_dp)
         skip = state.step - state.epoch * spe
         # skip == spe: boundary checkpoint -> empty epoch, roll to the next
-        return skip if 0 <= skip <= spe else 0
+        if 0 <= skip <= spe:
+            return skip
+        # every process derives the same skip from the replicated counter,
+        # so the fallback verdict is identical fleet-wide (no desync)
+        return self._note_resume_fallback(state, skip, spe)
 
     # ------------------------------------------------------ chunked hooks
     def _resolve_chunk_len(self, batcher: BatchIterator) -> int:
@@ -652,18 +682,33 @@ class ShardedTrainer(Trainer):
         """Multihost-aware cooperative stop: a preemption notice usually
         hits ONE host, but every process must leave the collective step
         loop at the same global step or the survivors hang in a collective
-        the stopped host never joins. The stop check therefore resolves
-        the local flag through multihost.global_agree_max at a fixed step
-        cadence (default: the replica-sync dispatch cadence, so a stop
-        lands where replicas reconcile anyway). Single-process meshes get
-        the plain flag read — no collective."""
+        the stopped host never joins. Multi-process, the stop check is a
+        resilience/watchdog.PeerAgreement: the same agreed-stop vote as
+        PR 4's global_agree_max, but the allgather row now carries a
+        liveness heartbeat (process id, step, step-time p50) — stragglers
+        get logged with host attribution, and under --sync-deadline a dead
+        peer raises SyncTimeout out of the collective instead of hanging
+        the fleet. Cadence default: the replica-sync dispatch cadence, so
+        a stop lands where replicas reconcile anyway. Single-process
+        meshes get the plain flag read — no collective."""
         if agree_every <= 0:
             agree_every = max(
                 1, self.config.dp_sync_every // self.config.micro_steps
             )
-        self.stop_check = handler.make_stop_check(
-            process_count=self.procs, agree_every=agree_every
-        )
+        if self.procs > 1:
+            from ..resilience.watchdog import PeerAgreement
+
+            self.stop_check = PeerAgreement(
+                handler,
+                agree_every=agree_every,
+                step_time_fn=lambda: (
+                    self.watchdog.step_stats().get("p50_ms", 0.0)
+                    if self.watchdog is not None else 0.0
+                ),
+                log_fn=self.log_fn,
+            ).check
+        else:
+            self.stop_check = handler.make_stop_check(process_count=1)
 
     # ------------------------------------------------------------- planning
     def plan_constraints(self):

@@ -18,9 +18,17 @@ chip time until a human notices, and (c) torn/corrupt checkpoints that turn
                   optionally rescales alpha and advances the shuffle seed,
                   and retries a bounded number of times.
   faults.py     — a declarative FaultPlan (NaN at step k, checkpoint-write
-                  OSError, slow-batcher stall, SIGTERM at step k) used by
-                  tests, the CI chaos job, and `bench.py --faults` so
-                  recovery overhead is a measured number, not a hope.
+                  OSError, slow-batcher stall, main-loop hang, SIGTERM or
+                  SIGKILL at step k) used by tests, the CI chaos job, and
+                  `bench.py --faults` so recovery overhead is a measured
+                  number, not a hope.
+  watchdog.py   — the HANG side of the fault model: a step-deadline
+                  watchdog (stack dump + wedged phase + EXIT_STALLED when
+                  no step boundary lands in time), deadline-bounded
+                  cross-process collectives (SyncTimeout instead of an
+                  infinite hang when a peer dies), and the heartbeat-
+                  carrying multi-process stop check (PeerAgreement:
+                  straggler/desync attribution on the agree channel).
 
 Checkpoint integrity (sha256 per-file manifests, quarantine of corrupt
 checkpoints, backup-chain fallback) lives in io/checkpoint.py — the loader
@@ -36,9 +44,13 @@ from __future__ import annotations
 __all__ = [
     "Fault",
     "FaultPlan",
+    "PeerAgreement",
     "ShutdownHandler",
+    "StepWatchdog",
     "Supervisor",
+    "SyncTimeout",
     "EXIT_PREEMPTED",
+    "EXIT_STALLED",
 ]
 
 _LAZY = {
@@ -47,6 +59,10 @@ _LAZY = {
     "ShutdownHandler": ("word2vec_tpu.resilience.shutdown", "ShutdownHandler"),
     "EXIT_PREEMPTED": ("word2vec_tpu.resilience.shutdown", "EXIT_PREEMPTED"),
     "Supervisor": ("word2vec_tpu.resilience.supervisor", "Supervisor"),
+    "StepWatchdog": ("word2vec_tpu.resilience.watchdog", "StepWatchdog"),
+    "PeerAgreement": ("word2vec_tpu.resilience.watchdog", "PeerAgreement"),
+    "SyncTimeout": ("word2vec_tpu.resilience.watchdog", "SyncTimeout"),
+    "EXIT_STALLED": ("word2vec_tpu.resilience.watchdog", "EXIT_STALLED"),
 }
 
 

@@ -6,7 +6,14 @@ WHEN, parsed from a compact spec string:
 
     nan@40                  poison the params with NaN at step-boundary 40
     stall@10:secs=0.5       sleep 0.5s at step-boundary 10 (slow batcher)
+    hang@10:secs=300        wedge the main loop for 300s (default 3600) at
+                            boundary 10 — the step watchdog's prey: past
+                            --step-deadline the run is shot EXIT_STALLED
     sigterm@25              deliver SIGTERM to this process at boundary 25
+    peer_dead@25            SIGKILL this process at boundary 25 (a lost
+                            host: uncatchable, no cleanup — survivors of a
+                            multi-process run must abort via the bounded
+                            collectives / watchdog instead of hanging)
     ckpt_oserror:times=2    the next 2 checkpoint writes raise OSError
 
 Tokens are comma-separated; `@k` pins the optimizer-step boundary at (or
@@ -40,19 +47,24 @@ import time
 from typing import Dict, List, Optional
 
 #: fault kinds delivered at optimizer-step boundaries by the trainers
-STEP_KINDS = ("nan", "stall", "sigterm")
+STEP_KINDS = ("nan", "stall", "hang", "sigterm", "peer_dead")
 #: fault kinds delivered at named injection points via raise_if_active()
 EVENT_KINDS = ("ckpt_oserror",)
 KINDS = STEP_KINDS + EVENT_KINDS
+
+#: default `secs` per kind: a stall is a measured slow-batcher blip, a hang
+#: is meant to OUTLIVE any sane step deadline (the watchdog shoots the
+#: process long before the sleep returns)
+_DEFAULT_SECS = {"hang": 3600.0}
 
 
 @dataclasses.dataclass
 class Fault:
     kind: str
-    step: int = 0          # boundary at/after which a step fault fires
-    times: int = 1         # firings before the fault is spent
-    secs: float = 0.25     # stall duration (kind == "stall")
-    fired: int = 0         # firings so far (mutable state)
+    step: int = 0                    # boundary at/after which a step fault fires
+    times: int = 1                   # firings before the fault is spent
+    secs: Optional[float] = None     # stall/hang duration (kind default)
+    fired: int = 0                   # firings so far (mutable state)
 
     def __post_init__(self) -> None:
         if self.kind not in KINDS:
@@ -63,6 +75,10 @@ class Fault:
             raise ValueError(f"fault step must be >= 0, got {self.step}")
         if self.times < 1:
             raise ValueError(f"fault times must be >= 1, got {self.times}")
+        if self.secs is None:
+            self.secs = _DEFAULT_SECS.get(self.kind, 0.25)
+        if self.secs < 0:
+            raise ValueError(f"fault secs must be >= 0, got {self.secs}")
 
     @property
     def spent(self) -> bool:
@@ -76,7 +92,8 @@ class Fault:
 
 
 def _parse_token(tok: str) -> Fault:
-    """One spec token: kind[@step][:key=val]..."""
+    """One spec clause: kind[@step][:key=val]... (error messages omit the
+    clause text — FaultPlan.parse wraps them with clause + offset context)."""
     parts = tok.strip().split(":")
     head, extras = parts[0], parts[1:]
     if "@" in head:
@@ -84,23 +101,30 @@ def _parse_token(tok: str) -> Fault:
         try:
             step = int(step_s)
         except ValueError:
-            raise ValueError(
-                f"bad fault token {tok!r}: step {step_s!r} is not an integer"
-            ) from None
+            raise ValueError(f"step {step_s!r} is not an integer") from None
     else:
         kind, step = head, 0
     kwargs: Dict = {"kind": kind.strip(), "step": step}
     for ex in extras:
         key, sep, val = ex.partition("=")
         if not sep:
-            raise ValueError(f"bad fault token {tok!r}: expected key=val, got {ex!r}")
+            raise ValueError(f"expected key=val, got {ex!r}")
         key = key.strip()
-        if key == "times":
-            kwargs["times"] = int(val)
-        elif key == "secs":
-            kwargs["secs"] = float(val)
-        else:
-            raise ValueError(f"bad fault token {tok!r}: unknown key {key!r}")
+        try:
+            if key == "times":
+                kwargs["times"] = int(val)
+            elif key == "secs":
+                kwargs["secs"] = float(val)
+            else:
+                raise ValueError(
+                    f"unknown key {key!r} (known: times, secs)"
+                )
+        except ValueError as e:
+            if "unknown key" in str(e):
+                raise
+            raise ValueError(
+                f"bad value {val!r} for key {key!r}"
+            ) from None
     return Fault(**kwargs)
 
 
@@ -115,18 +139,48 @@ class FaultPlan:
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
-        """Parse a comma-separated spec string, or a path to a JSON file."""
+        """Parse a comma-separated spec string, or a path to a JSON file.
+
+        Parse errors name the offending CLAUSE and its character offset in
+        the spec (`nan@40,bogus@x` -> "clause 2 ('bogus@x') at offset 7:
+        unknown fault kind 'bogus'"), so a typo'd chaos plan fails with a
+        pointer, not a generic ValueError.
+        """
         spec = (spec or "").strip()
         if not spec:
             return cls()
         if spec.endswith(".json") or os.path.isfile(spec):
             with open(spec) as f:
                 raw = json.load(f)
-            return cls([
-                Fault(**{k: v for k, v in d.items() if k != "fired"})
-                for d in raw
-            ])
-        return cls([_parse_token(t) for t in spec.split(",") if t.strip()])
+            faults = []
+            for i, d in enumerate(raw):
+                try:
+                    if not isinstance(d, dict):
+                        raise ValueError(
+                            f"expected an object, got {type(d).__name__}"
+                        )
+                    faults.append(
+                        Fault(**{k: v for k, v in d.items() if k != "fired"})
+                    )
+                except (TypeError, ValueError) as e:
+                    raise ValueError(
+                        f"bad fault plan {spec!r} entry {i}: {e}"
+                    ) from None
+            return cls(faults)
+        faults = []
+        pos = 0
+        for i, tok in enumerate(spec.split(",")):
+            clause = tok.strip()
+            if clause:
+                offset = pos + (len(tok) - len(tok.lstrip()))
+                try:
+                    faults.append(_parse_token(clause))
+                except ValueError as e:
+                    raise ValueError(
+                        f"clause {i + 1} ({clause!r}) at offset {offset}: {e}"
+                    ) from None
+            pos += len(tok) + 1  # +1 for the comma
+        return cls(faults)
 
     def __bool__(self) -> bool:
         return bool(self.faults)
@@ -157,10 +211,20 @@ class FaultPlan:
                 state.params = jax.tree.map(
                     lambda v: (v * float("nan")).astype(v.dtype), state.params
                 )
-            elif f.kind == "stall":
+            elif f.kind in ("stall", "hang"):
+                # same mechanism, different intent: a stall is a short blip
+                # the run absorbs (bench measures it as overhead); a hang's
+                # default 3600s sleep wedges the main loop past any sane
+                # --step-deadline so the watchdog's EXIT_STALLED path runs
                 time.sleep(f.secs)
             elif f.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
+            elif f.kind == "peer_dead":
+                # a LOST host, not an evicted one: SIGKILL is uncatchable,
+                # so no cooperative stop, no final checkpoint, no collective
+                # farewell — exactly what the survivors' bounded collectives
+                # and step watchdog must turn into a bounded abort
+                os.kill(os.getpid(), signal.SIGKILL)
 
     # ---------------------------------------------------- event delivery
     def fire_event(self, kind: str, where: str = "") -> bool:

@@ -1,0 +1,454 @@
+"""Distributed watchdog: bounded detection of the failure mode PR 4 left
+open — the HANG.
+
+PR 4's resilience subsystem closes the crash modes (SIGTERM, divergence,
+torn checkpoints), but every hang mode was still unbounded: a wedged device
+or stuck host phase stalls the step loop silently, and a dead peer strands
+the survivors of a multi-host job in a collective that never completes.
+From a scheduler's point of view a hung run is indistinguishable from a
+healthy one — it just stops producing steps while burning chip time. This
+module turns every hang into a bounded, requeue-able abort:
+
+  StepWatchdog   — a monitor thread armed per step boundary. The trainers
+                   call `beat(step)` at every optimizer-step / chunk
+                   boundary (one clock read + a lock: no device sync, no
+                   extra dispatch — pinned by tests/test_watchdog.py). If no
+                   boundary lands within `max(deadline, factor x rolling-p90
+                   boundary time)` — with a one-off grace window covering
+                   the first compile — the monitor fires: it dumps ALL
+                   thread stacks to the metrics dir, names the wedged phase
+                   from obs/phases.PhaseRecorder's open spans (batcher_wait
+                   vs device_wait vs checkpoint vs dispatch), marks the run
+                   manifest `shutdown: stalled`, and exits EXIT_STALLED so
+                   an external scheduler requeues with `--resume` (PR 4's
+                   byte-for-byte resume guarantee makes the retry lossless).
+
+  bounded_call   — deadline-bounded execution of host-side collectives.
+                   `parallel/multihost._global_agree` / `global_heartbeat`
+                   route through it, so a dead peer turns an infinite
+                   `process_allgather` hang into a `SyncTimeout` the CLI
+                   converts into checkpoint-where-safe + EXIT_PREEMPTED.
+                   The deadline is process-wide (`set_sync_deadline`),
+                   default None = unbounded (exactly the old behavior).
+
+  PeerAgreement  — the multi-process cooperative-stop check, upgraded to a
+                   heartbeat: at the agreement cadence every process
+                   allgathers (process id, stop flag, step, step-time p50),
+                   so a lagging peer is logged as a straggler WITH host
+                   attribution and the stop verdict stays the PR 4
+                   global-max vote. Rides the existing agree channel — one
+                   collective per cadence, same as before, just a wider row.
+
+`os._exit` is deliberate in the fire path: a wedged main thread cannot run
+`sys.exit` cleanup, and the artifacts (stacks, stall record, manifest) are
+written by the monitor thread *before* the exit. atexit hooks are skipped —
+acceptable for a process being shot for unresponsiveness; the JSONL sink
+flushes per record, so at most the buffered tail is lost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils.profiling import lap_stats
+
+#: exit code of a stalled-and-shot run. Distinct from EXIT_PREEMPTED (75):
+#: both mean "requeue me with --resume", but a stall says the HARDWARE or
+#: input pipeline wedged (worth counting separately in scheduler metrics),
+#: not that the fleet evicted us. 76 = EX_PROTOCOL in sysexits terms — the
+#: step protocol ("a boundary lands every deadline") was violated.
+EXIT_STALLED = 76
+
+
+class SyncTimeout(RuntimeError):
+    """A deadline-bounded collective did not complete: a peer is dead or
+    wedged. Carries `.what` (which collective) and `.deadline` (seconds)."""
+
+    def __init__(self, what: str, deadline: float):
+        self.what = what
+        self.deadline = float(deadline)
+        super().__init__(
+            f"{what} did not complete within the {deadline:g}s sync "
+            "deadline: a peer process is dead or wedged; aborting for "
+            "requeue instead of hanging"
+        )
+
+
+# ------------------------------------------------------ process-wide deadline
+# Host-side collectives (multihost.global_agree_* / global_heartbeat) consult
+# this instead of threading a deadline through every call chain — the same
+# module-level pattern as faults.activate(). None = unbounded (old behavior).
+_SYNC_DEADLINE: Optional[float] = None
+
+
+def set_sync_deadline(secs: Optional[float]) -> Optional[float]:
+    """Install the process-wide collective deadline (None/0 disables);
+    returns the previous value (restore it in a finally when scoping)."""
+    global _SYNC_DEADLINE
+    prev = _SYNC_DEADLINE
+    _SYNC_DEADLINE = float(secs) if secs else None
+    return prev
+
+
+def sync_deadline() -> Optional[float]:
+    return _SYNC_DEADLINE
+
+
+def bounded_call(fn: Callable, what: str = "collective",
+                 deadline: Optional[float] = None):
+    """Run `fn()` under a deadline; raise SyncTimeout if it doesn't return.
+
+    `deadline` defaults to the process-wide sync deadline; with neither set
+    this is a plain call (zero overhead, no thread). The bounded path runs
+    `fn` in a daemon thread and joins with a timeout — the collective itself
+    cannot be cancelled, so on expiry the thread is ABANDONED (still
+    blocked inside the runtime) and the caller must treat the process as
+    lost: checkpoint what is safe and exit. That is exactly the CLI's
+    SyncTimeout handling; never catch-and-continue past one.
+    """
+    if deadline is None:
+        deadline = _SYNC_DEADLINE
+    if not deadline:
+        return fn()
+    out: Dict = {}
+
+    def run():
+        try:
+            out["value"] = fn()
+        except BaseException as e:  # surface runtime errors to the caller
+            out["error"] = e
+
+    t = threading.Thread(target=run, name=f"bounded:{what}", daemon=True)
+    t.start()
+    t.join(deadline)
+    if t.is_alive():
+        raise SyncTimeout(what, deadline)
+    if "error" in out:
+        raise out["error"]
+    return out.get("value")
+
+
+# ---------------------------------------------------------------- watchdog
+class StepWatchdog:
+    """Step-deadline monitor: fire when no step boundary lands in time.
+
+    Usage (the trainers do this via `Trainer.watchdog`):
+
+        wd = StepWatchdog(deadline=30, phases=trainer.phases,
+                          metrics_dir=..., manifest_path=...)
+        wd.arm()                 # at train() entry (starts the monitor)
+        wd.beat(step)            # at every step/chunk boundary
+        wd.disarm()              # at train() exit (any path)
+
+    The effective deadline is `max(deadline, factor x p90(recent boundary
+    intervals))`, so a configured 5 s deadline does not false-fire on a run
+    whose chunks legitimately take 8 s — the rolling p90 raises the bar as
+    steady-state data accumulates. Until `min_beats` boundaries have landed
+    the GRACE deadline applies instead (default max(60 s, 6 x deadline)),
+    covering the first compile. Set `deadline` above your worst
+    checkpoint-write + mid-run-compile wall; the adaptive term handles
+    drift, not cliffs.
+
+    On fire (monitor thread): write `stall_stacks.txt` (faulthandler dump of
+    every thread) and `stall.json` (step, elapsed, effective deadline, the
+    wedged phase from the PhaseRecorder's open spans, boundary-time stats)
+    into `metrics_dir`, merge `shutdown: stalled` + the stall record into
+    the manifest, then `os._exit(EXIT_STALLED)` — unless `on_fire` is set
+    (tests), which receives the record instead of the exit.
+    """
+
+    #: boundary-interval samples kept for the rolling p90
+    MAX_SAMPLES = 256
+
+    def __init__(
+        self,
+        deadline: float,
+        factor: float = 4.0,
+        grace_secs: Optional[float] = None,
+        min_beats: int = 2,
+        phases=None,
+        metrics_dir: Optional[str] = None,
+        manifest_path: Optional[str] = None,
+        on_fire: Optional[Callable[[Dict], None]] = None,
+    ):
+        if deadline <= 0:
+            raise ValueError(f"deadline must be > 0, got {deadline}")
+        self.deadline = float(deadline)
+        self.factor = float(factor)
+        self.grace_secs = (
+            max(60.0, 6.0 * self.deadline) if grace_secs is None
+            else float(grace_secs)
+        )
+        self.min_beats = int(min_beats)
+        self.phases = phases
+        self.metrics_dir = metrics_dir
+        self.manifest_path = manifest_path
+        self.on_fire = on_fire
+        #: set once the watchdog has fired (observable by tests / harnesses)
+        self.fired = threading.Event()
+        self._lock = threading.Lock()
+        self._laps: List[float] = []
+        self._beats = 0
+        self._last_beat = 0.0
+        self._last_step = -1
+        self._armed = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- control
+    def arm(self) -> "StepWatchdog":
+        """(Re)start monitoring; the deadline clock starts now. Idempotent
+        per train() run — a supervisor retry re-arms after its rollback, so
+        checkpoint-load time never counts against the step deadline."""
+        with self._lock:
+            self._armed = True
+            self._last_beat = time.monotonic()
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._monitor, name="step-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def disarm(self) -> None:
+        """Stop monitoring (idempotent; safe from any thread)."""
+        with self._lock:
+            self._armed = False
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def beat(self, step: int) -> None:
+        """One step/chunk boundary: re-arm the deadline. One clock read and
+        a lock — no device interaction whatsoever (the <1% overhead
+        contract, tests/test_watchdog.py)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._beats > 0:
+                # interval between BOUNDARIES only: the arm->first-beat gap
+                # is compile time and would poison the rolling p90 (the
+                # grace window covers that stretch instead)
+                lap = now - self._last_beat
+                if len(self._laps) < self.MAX_SAMPLES:
+                    self._laps.append(lap)
+                else:
+                    self._laps[(self._beats - 1) % self.MAX_SAMPLES] = lap
+            self._beats += 1
+            self._last_beat = now
+            self._last_step = int(step)
+
+    # ----------------------------------------------------------- deadlines
+    def step_stats(self) -> Dict:
+        """lap_stats over the recent boundary intervals (p50/p90 in ms) —
+        also the step-time source of the PeerAgreement heartbeat."""
+        with self._lock:
+            laps = list(self._laps)
+        return lap_stats(laps)
+
+    def effective_deadline(self) -> float:
+        with self._lock:
+            beats, laps = self._beats, list(self._laps)
+        if beats < self.min_beats:
+            return max(self.deadline, self.grace_secs)
+        s = lap_stats(laps)
+        return max(self.deadline, self.factor * s.get("p90_ms", 0.0) / 1e3)
+
+    # ------------------------------------------------------------- monitor
+    def _interval(self) -> float:
+        return min(1.0, max(0.02, self.deadline / 5.0))
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self._interval()):
+            with self._lock:
+                if not self._armed:
+                    continue
+                last, step = self._last_beat, self._last_step
+            elapsed = time.monotonic() - last
+            eff = self.effective_deadline()
+            if elapsed > eff:
+                self._fire(step, elapsed, eff)
+                return  # one fire per arm (on_fire path keeps the process)
+
+    def _fire(self, step: int, elapsed: float, effective: float) -> None:
+        record = {
+            "event": "stalled",
+            "step": step,
+            "elapsed_s": round(elapsed, 3),
+            "effective_deadline_s": round(effective, 3),
+            "configured_deadline_s": self.deadline,
+            "phase": self._wedged_phase(),
+            "open_spans": self._open_spans(),
+            "boundary_stats": self.step_stats(),
+        }
+        stacks_path = None
+        if self.metrics_dir:
+            try:
+                os.makedirs(self.metrics_dir, exist_ok=True)
+                stacks_path = os.path.join(self.metrics_dir, "stall_stacks.txt")
+                self._dump_stacks(stacks_path)
+                record["stacks"] = stacks_path
+                with open(os.path.join(self.metrics_dir, "stall.json"), "w") as f:
+                    json.dump(record, f, indent=2, default=str)
+                    f.write("\n")
+            except OSError:
+                pass  # the exit code still tells the scheduler what happened
+        else:
+            self._dump_stacks(None)  # stderr
+        if self.manifest_path:
+            from ..obs.manifest import update_manifest
+
+            update_manifest(
+                self.manifest_path, {"shutdown": "stalled", "stall": record}
+            )
+        print(
+            f"watchdog: no step boundary for {elapsed:.1f}s "
+            f"(effective deadline {effective:.1f}s) after step {step}; "
+            f"wedged phase: {record['phase']}"
+            + (f"; stacks: {stacks_path}" if stacks_path else "")
+            + f"; exiting {EXIT_STALLED} for requeue with --resume",
+            file=sys.stderr, flush=True,
+        )
+        self.fired.set()
+        if self.on_fire is not None:
+            self.on_fire(record)
+            return
+        os._exit(EXIT_STALLED)
+
+    def _dump_stacks(self, path: Optional[str]) -> None:
+        """All-thread stack dump via faulthandler — signal-safe C-level
+        formatting that works even when a wedged thread holds arbitrary
+        Python-level locks (a traceback.format_stack walk could block on
+        the very lock the hang is about)."""
+        import faulthandler
+
+        try:
+            if path is None:
+                faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+            else:
+                with open(path, "w") as f:
+                    faulthandler.dump_traceback(file=f, all_threads=True)
+        except Exception:
+            pass
+
+    def _wedged_phase(self) -> str:
+        if self.phases is not None:
+            wedged = self.phases.wedged_phase()
+            if wedged:
+                return wedged
+        # no open host-side span: the main loop itself is wedged (a stuck
+        # fault/stop hook, a hang between spans) or the stall is inside
+        # dispatched device compute
+        return "main-loop (no open phase span)"
+
+    def _open_spans(self) -> Dict[str, float]:
+        if self.phases is None:
+            return {}
+        return {
+            k: round(v, 3) for k, v in self.phases.open_spans().items()
+        }
+
+
+# ----------------------------------------------------------- peer liveness
+class PeerAgreement:
+    """Multi-process cooperative-stop check with a liveness heartbeat.
+
+    Replaces the bare `global_agree_max(stop_flag)` of PR 4's stop protocol:
+    at each agreement boundary every process contributes
+    (process id, stop flag, step, step-time p50 ms) through ONE allgather on
+    the existing agree channel. The stop verdict is unchanged (any process's
+    flag stops everyone at the same boundary); the extra columns buy
+    attribution — a peer whose p50 is `straggler_factor` x the fleet median
+    is logged as a straggler BY PROCESS ID, and a desynchronized step
+    counter (which would eventually hang a collective) is reported the
+    moment it is visible instead of when it deadlocks.
+
+    A DEAD peer never reaches the allgather: with a sync deadline set
+    (`set_sync_deadline` / `--sync-deadline`) the collective raises
+    SyncTimeout out of `check`, which the trainer lets propagate — the CLI
+    converts it into checkpoint-where-safe + EXIT_PREEMPTED on every
+    surviving host. Without a deadline the behavior is PR 4's (block).
+    """
+
+    def __init__(
+        self,
+        handler,
+        agree_every: int = 16,
+        step_time_fn: Optional[Callable[[], float]] = None,
+        straggler_factor: float = 4.0,
+        straggler_min_ms: float = 50.0,
+        log_fn=None,
+    ):
+        self.handler = handler
+        self.every = max(1, int(agree_every))
+        self.step_time_fn = step_time_fn
+        self.straggler_factor = float(straggler_factor)
+        self.straggler_min_ms = float(straggler_min_ms)
+        self.log_fn = log_fn
+        self._warned: set = set()
+
+    def check(self, step: int) -> bool:
+        """The trainers' stop_check: heartbeat + agreed stop verdict at the
+        cadence, False (no collective) off it."""
+        if step % self.every != 0:
+            return False
+        import jax
+
+        from ..parallel import multihost
+
+        p50 = 0.0
+        if self.step_time_fn is not None:
+            p50 = float(self.step_time_fn() or 0.0)
+        rows = multihost.global_heartbeat([
+            float(jax.process_index()),
+            1.0 if self.handler.requested else 0.0,
+            float(step),
+            p50,
+        ])
+        self.inspect(rows, step)
+        return bool(rows[:, 1].max() > 0)
+
+    def inspect(self, rows, step: int) -> None:
+        """Straggler / desync detection over one heartbeat's [P, 4] rows
+        (public so tests can feed synthetic fleets)."""
+        import numpy as np
+
+        p50s = rows[:, 3]
+        med = float(np.median(p50s))
+        bar = max(self.straggler_min_ms, self.straggler_factor * med)
+        for pid_f, _flag, peer_step, p50 in rows:
+            pid = int(pid_f)
+            if med > 0 and p50 > bar and ("straggler", pid) not in self._warned:
+                self._warned.add(("straggler", pid))
+                self._note({
+                    "event": "straggler",
+                    "process": pid,
+                    "p50_ms": round(float(p50), 3),
+                    "fleet_median_ms": round(med, 3),
+                    "at_step": step,
+                }, f"process {pid} is a straggler: p50 step time "
+                   f"{p50:.1f}ms vs fleet median {med:.1f}ms")
+            if int(peer_step) != int(step) and ("desync", pid) not in self._warned:
+                self._warned.add(("desync", pid))
+                self._note({
+                    "event": "peer_desync",
+                    "process": pid,
+                    "peer_step": int(peer_step),
+                    "at_step": step,
+                }, f"process {pid} reports step {int(peer_step)} at the "
+                   f"step-{step} agreement boundary — step counters have "
+                   "desynchronized and the next collective may deadlock")
+
+    def _note(self, record: Dict, msg: str) -> None:
+        import warnings
+
+        warnings.warn(msg, stacklevel=3)
+        if self.log_fn:
+            self.log_fn(dict(record))

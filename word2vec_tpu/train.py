@@ -102,6 +102,20 @@ class Trainer:
     #: production; chaos tests and `--faults` set it. Duck-typed: anything
     #: with .on_step(state, trainer) works.
     fault_plan = None
+    #: step-deadline watchdog (resilience/watchdog.StepWatchdog) — None in
+    #: production unless --step-deadline is set. train() arms/disarms it;
+    #: _check_stop beats it at every step/chunk boundary (one clock read,
+    #: no device sync). Duck-typed: anything with .arm/.beat/.disarm works.
+    watchdog = None
+    #: the TrainState of the CURRENT/most recent train() run — the same
+    #: mutable object the loop advances, so a driver aborting on
+    #: SyncTimeout (resilience/watchdog.py) can checkpoint where safe even
+    #: though train() raised instead of returning
+    last_state = None
+    #: set to "epoch_restart" when _resume_skip had to discard an
+    #: out-of-range checkpointed step counter (the CLI records it in the
+    #: run manifest); None on a clean resume or fresh run
+    resume_fallback: Optional[str] = None
 
     def __init__(
         self,
@@ -269,13 +283,47 @@ class Trainer:
         counter. Valid because epoch permutations are pure functions of
         (seed, epoch) — see BatchIterator.epoch. Out-of-range values (a
         checkpoint from different batch geometry; the CLI prevents this by
-        restoring the checkpoint's config) fall back to epoch restart.
+        restoring the checkpoint's config) fall back to epoch restart —
+        LOUDLY (_note_resume_fallback): the restart re-trains data the
+        checkpoint already saw, which changes the trajectory.
         skip == steps_per_epoch is valid: a checkpoint on the epoch boundary
         (taken before the epoch counter advanced) resumes into an empty
         epoch iterator and rolls straight into the next epoch."""
         spe = batcher.steps_per_epoch()
         skip = state.step - state.epoch * spe
-        return skip if 0 <= skip <= spe else 0
+        if 0 <= skip <= spe:
+            return skip
+        return self._note_resume_fallback(state, skip, spe)
+
+    def _note_resume_fallback(self, state: TrainState, skip: int,
+                              steps_per_epoch: int) -> int:
+        """An out-of-range checkpointed step counter means the checkpoint
+        came from a different batch geometry than this config resolves to;
+        silently restarting the epoch (the old behavior) re-trains data the
+        run already consumed. Keep the fallback — it is the only consistent
+        recovery — but warn structurally and flag it for the manifest."""
+        import warnings
+
+        self.resume_fallback = "epoch_restart"
+        warnings.warn(
+            f"checkpointed step counter {state.step} (epoch {state.epoch}) "
+            f"is out of range for this config's {steps_per_epoch} "
+            f"steps/epoch (derived skip {skip}): the checkpoint was taken "
+            "under different batch geometry. Restarting the epoch from its "
+            "first batch — already-trained data will be re-trained "
+            "(recorded as resume_fallback: epoch_restart in the manifest).",
+            stacklevel=3,
+        )
+        if self.log_fn:
+            self.log_fn({
+                "event": "resume_fallback",
+                "mode": "epoch_restart",
+                "step": state.step,
+                "epoch": state.epoch,
+                "steps_per_epoch": steps_per_epoch,
+                "derived_skip": skip,
+            })
+        return 0
 
     def _post_step(self, state: TrainState) -> None:
         """Called after every optimizer step (sharded: periodic sync)."""
@@ -288,9 +336,14 @@ class Trainer:
         self.stop_check = handler.make_stop_check(process_count=1)
 
     def _check_stop(self, state: TrainState) -> bool:
-        """One step/chunk-boundary poll of the resilience hooks: deliver any
-        due injected faults, then ask the cooperative-stop check. Shared by
-        the per-step and chunked drivers so the two can't drift."""
+        """One step/chunk-boundary poll of the resilience hooks: beat the
+        step watchdog (the boundary landed — re-arm its deadline), deliver
+        any due injected faults, then ask the cooperative-stop check.
+        Shared by the per-step and chunked drivers so the two can't drift.
+        Beat BEFORE fault delivery, so an injected hang is measured from
+        the boundary it wedges — exactly like a real mid-loop stall."""
+        if self.watchdog is not None:
+            self.watchdog.beat(state.step)
         if self.fault_plan is not None:
             self.fault_plan.on_step(state, self)
         return self.stop_check is not None and self.stop_check(state.step)
@@ -318,6 +371,29 @@ class Trainer:
         checkpoint_cb: Optional[Callable[[TrainState], None]] = None,
         checkpoint_every: int = 0,
     ) -> Tuple[TrainState, TrainReport]:
+        """Run the training loop (see _train_impl for the body). This
+        wrapper scopes the step watchdog: armed for exactly the stretch
+        where step boundaries are expected, disarmed on every exit path —
+        including DivergenceError into a supervisor, whose rollback load
+        must not count against the step deadline (the retry re-arms)."""
+        if self.watchdog is not None:
+            self.watchdog.arm()
+        try:
+            return self._train_impl(
+                state=state, log_every=log_every,
+                checkpoint_cb=checkpoint_cb, checkpoint_every=checkpoint_every,
+            )
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.disarm()
+
+    def _train_impl(
+        self,
+        state: Optional[TrainState],
+        log_every: int,
+        checkpoint_cb: Optional[Callable[[TrainState], None]],
+        checkpoint_every: int,
+    ) -> Tuple[TrainState, TrainReport]:
         cfg = self.config
         if state is not None:
             # Donation hygiene for externally-supplied state (checkpoint
@@ -337,6 +413,8 @@ class Trainer:
             }
             jax.block_until_ready(state.params)
         state = state or self.init_state()
+        # the abort paths' checkpoint-where-safe source (class attr note)
+        self.last_state = state
         if self.fault_plan is not None:
             # entry boundary: a fault pinned at/before the entry step
             # (nan@0, or nan@s on a resumed run) applies before the first

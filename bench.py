@@ -241,13 +241,18 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     batcher = BatchIterator(corpus, cfg.batch_rows, cfg.max_sentence_len, seed=1)
     base_key = jax.random.key(7, impl=cfg.jax_prng_impl)
 
-    # Phase-timing breakdown (obs/phases.py): where the measured epoch's
-    # wall time went (input wait vs dispatch vs device backpressure), banked
-    # alongside predicted-vs-measured cost so a slow record is attributable
-    # without rerunning under xprof. Span overhead is two clock reads.
+    # Phase-timing breakdown (obs/phases.py) feeding a flight-recorder ring
+    # (obs/flight.py): where the measured epoch's wall time went (input wait
+    # vs dispatch vs device backpressure), banked both as aggregate p50/p90
+    # AND as a span timeline — `trace_summary` (per-span p50 + the top
+    # step-time contributors) in every record, with --trace DIR exporting
+    # the full Chrome-trace artifact for Perfetto / tracediff. Span
+    # overhead is two clock reads + one ring append.
+    from word2vec_tpu.obs.flight import FlightRecorder
     from word2vec_tpu.obs.phases import PhaseRecorder
 
-    phases = PhaseRecorder()
+    flight = FlightRecorder()
+    phases = PhaseRecorder(tracer=flight.ring)
 
     # Chunked dispatch (ops/train_step.make_chunk_runner): S optimizer steps
     # per device program, so per-dispatch overhead — which through the remote
@@ -317,6 +322,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     # lets the artifact distinguish contention from regression)
     load_start = os.getloadavg()[0] if hasattr(os, "getloadavg") else None
     t0 = time.perf_counter()
+    t_chunk = t0
     for chunk_words, dispatch in phases.timed_iter(dispatches(), "batcher_wait"):
         with phases.span("dispatch"):
             params, m = dispatch(params, steps)
@@ -328,6 +334,9 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         )
         words += chunk_words
         steps += S
+        now = time.perf_counter()
+        flight.note_step(steps, t_chunk, now - t_chunk, kind="chunk", steps=S)
+        t_chunk = now
         if args.measure_steps and steps >= args.measure_steps:
             break
     with phases.span("device_wait"):
@@ -381,13 +390,27 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     # side so the model's error stays observable round over round.
     from word2vec_tpu.tune import cost_model as _cm
 
-    predicted = _cm.predict(
-        cfg, len(vocab), dev.device_kind, dev.platform
-    ).to_json()
+    predicted_est = _cm.predict(cfg, len(vocab), dev.device_kind, dev.platform)
+    predicted = predicted_est.to_json()
     measured = {
         "step_ms": round(1e3 * dt / max(1, steps), 4),
         "words_per_sec": round(wps, 1),
     }
+    # Trace summary (obs/tracediff.summarize over the flight ring): per-span
+    # p50 + the top step-time contributors, and the measured-vs-predicted
+    # cost rows it feeds (tune/cost_model.attribution_rows) — the record
+    # attributes its own step time without an xprof rerun.
+    from word2vec_tpu.obs import tracediff as _tracediff
+
+    trace_summary = _tracediff.summarize(flight.ring.events())
+    cost_attribution = _cm.attribution_rows(predicted_est, trace_summary)
+    if args.trace:
+        from word2vec_tpu.obs.trace import chrome_trace_doc, write_trace
+
+        write_trace(
+            os.path.join(args.trace, "trace.json"),
+            chrome_trace_doc(flight.ring.events()),
+        )
     # Telemetry (obs/): the phase breakdown + health counters make the
     # predicted-vs-measured audit self-contained — an off-model number can
     # be attributed (input-bound? divergence?) from the record alone — and
@@ -421,6 +444,8 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "predicted_cost": predicted,
         "measured_cost": measured,
         "phases": phases.report(),
+        "trace_summary": trace_summary,
+        "cost_attribution": cost_attribution,
         "health": health,
         "manifest": obs_manifest.manifest_dict(
             cfg, vocab_size=len(vocab), plan_resolution=plan_res,
@@ -437,6 +462,12 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         ]
     if platform_note:
         record["tpu_fallback_reason"] = platform_note
+    if args.smoke:
+        # smoke contract: the banked record must carry a non-empty span
+        # timeline (CI's trace job additionally schema-validates the export)
+        assert trace_summary["spans"] and trace_summary["steps"] > 0, (
+            f"--smoke: empty trace_summary {trace_summary!r}"
+        )
     if tables.hs_msig is not None:
         # two-tier hs observability: the banked record shows what share of
         # token-weighted path entries the measured dense tier covered, and
@@ -665,6 +696,12 @@ def build_parser() -> argparse.ArgumentParser:
                     "one NaN divergence past the mid-epoch checkpoint; the "
                     "idle-watchdog cost itself is banked by "
                     "benchmarks/watchdog_overhead.py)")
+    ap.add_argument("--trace", default="", metavar="DIR",
+                    help="export the measured epoch's span timeline as "
+                    "Chrome-trace JSON to DIR/trace.json (obs/trace.py; "
+                    "diff two plans with python -m "
+                    "word2vec_tpu.obs.tracediff). The in-record "
+                    "trace_summary is banked regardless")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke preset: shrink the synthetic corpus to "
                     "~60s of CPU wall time (still the real pipeline at the "
@@ -835,6 +872,8 @@ def main() -> None:
         child_cmd += [flag, str(val)]
     if args.faults:
         child_cmd += ["--faults", args.faults]
+    if args.trace:
+        child_cmd += ["--trace", args.trace]
     try:
         out = subprocess.run(
             child_cmd, capture_output=True, text=True, timeout=args.run_timeout

@@ -161,6 +161,15 @@ def _run_chaos(args, result, tmp, procs, logs, victim, t0) -> None:
         except (OSError, ValueError):
             shutdowns[str(r)] = None
     result["survivor_shutdowns"] = shutdowns
+    # every failure artifact carries its own timeline (PR 6): both survivor
+    # abort paths — watchdog stall and SyncTimeout peer loss — dump the
+    # flight recorder into the rank's metrics dir (primary-gated like every
+    # metrics artifact, so rank 0's presence is the contract; the rest is
+    # informational)
+    result["survivor_flights"] = {
+        str(r): os.path.exists(os.path.join(tmp, f"m{r}", "flight.json"))
+        for r in survivors
+    }
     result["ok"] = True
     print(json.dumps(result))
 

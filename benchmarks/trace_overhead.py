@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Measure the always-on flight recorder / tracing overhead on the CPU
+drill shape.
+
+The tracing contract (obs/trace.py, obs/flight.py) is that recording is
+free at step granularity: one span event is a dict build + a deque append
+under a lock, there is no I/O and no device interaction, and the flight
+recorder rides every run without a flag. This harness pins that as a banked
+number instead of a hope — the same A/B discipline as
+benchmarks/watchdog_overhead.py: train the same synthetic shape with the
+recorder attached (the default) and detached (trainer.flight = None,
+phases.tracer = None), alternating reps, median wall; then time one trace
+event against the run's own p50 step time.
+
+One JSON line to stdout (bank as benchmarks/TRACE_OVERHEAD_cpu.json):
+    python benchmarks/trace_overhead.py [--tokens 200000] [--reps 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=200_000)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--batch-rows", type=int, default=64)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.obs.flight import FlightRecorder
+    from word2vec_tpu.train import Trainer
+    from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=5, word_dim=args.dim,
+        window=5, batch_rows=args.batch_rows, max_sentence_len=192,
+        min_count=1, iters=1, seed=0,
+        chunk_steps=1,  # per-step boundaries: the worst case for event count
+    )
+    vocab = zipf_vocab(71000, 17_000_000)
+    flat = np.concatenate(zipf_corpus_ids(vocab, args.tokens, seed=0))
+    ids = [flat[i:i + 1000] for i in range(0, len(flat), 1000)]
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    trainer = Trainer(cfg, vocab, corpus)
+    traced_flight = trainer.flight  # re-attached per traced rep
+
+    def timed_run(traced: bool):
+        if traced:
+            trainer.flight = traced_flight
+            trainer.phases.tracer = traced_flight.ring
+        else:
+            trainer.flight = None
+            trainer.phases.tracer = None
+        t0 = time.perf_counter()
+        _, rep = trainer.train(state=trainer.init_state(), log_every=0)
+        return time.perf_counter() - t0, rep
+
+    timed_run(True)  # warmup: compile out of the measurement
+    base_walls, traced_walls, steps = [], [], 0
+    for _ in range(args.reps):  # alternate to decorrelate host drift
+        w, rep = timed_run(False)
+        base_walls.append(w)
+        steps = rep.steps
+        w, rep = timed_run(True)
+        traced_walls.append(w)
+
+    # per-event microcost against the run's own step time: the per-step
+    # loop emits ~6 events per step (4 phase spans + step parent + counter)
+    trainer.flight = traced_flight
+    trainer.phases.tracer = traced_flight.ring
+    _, rep = trainer.train(state=trainer.init_state(), log_every=0)
+    step_durs_ms = sorted(
+        e["dur"] / 1e3
+        for e in traced_flight.ring.events()
+        if e.get("ph") == "X" and e["name"] == "step"
+    )
+    p50_step_ms = step_durs_ms[len(step_durs_ms) // 2]
+    ring = FlightRecorder().ring
+    n = 100_000
+    t0 = time.perf_counter()
+    tref = time.perf_counter()
+    for i in range(n):
+        ring.complete("dispatch", tref, 0.001)
+    per_event_us = 1e6 * (time.perf_counter() - t0) / n
+
+    base = statistics.median(base_walls)
+    traced = statistics.median(traced_walls)
+    overhead_pct = 100.0 * (traced - base) / base
+    events_per_step = 6.0
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": f"always-on trace/flight-recorder overhead "
+                  f"({args.tokens // 1000}k zipf, {dev.platform})",
+        "value": round(overhead_pct, 2),
+        "unit": "% wall",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "steps_per_run": steps,
+        "reps": args.reps,
+        "base_wall_s": [round(w, 3) for w in base_walls],
+        "traced_wall_s": [round(w, 3) for w in traced_walls],
+        "median_base_s": round(base, 3),
+        "median_traced_s": round(traced, 3),
+        "p50_step_ms": round(p50_step_ms, 3),
+        "event_cost_us": round(per_event_us, 3),
+        "events_per_step": events_per_step,
+        "event_cost_pct_of_step": round(
+            100.0 * events_per_step * per_event_us / (1e3 * p50_step_ms), 4
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -86,3 +86,8 @@ def test_kill_one_of_n_survivors_exit_within_deadline():
         assert rc in (EXIT_STALLED, EXIT_PREEMPTED), result
     for r, dt in result["survivor_exit_after_victim_s"].items():
         assert dt <= result["exit_budget_s"], result
+    # peer-loss leg of the flight-dump acceptance: the primary survivor's
+    # abort path (stall or SyncTimeout) left its timeline in the metrics
+    # dir (metrics artifacts are primary-gated, so rank 0 is the one with
+    # a guaranteed dump; the drill reports the rest informationally)
+    assert result["survivor_flights"].get("0"), result

@@ -1,0 +1,639 @@
+"""Flight recorder + trace timeline (obs/trace.py, obs/flight.py,
+obs/tracediff.py): step-level span tracing, Chrome-trace export, auto-dump
+on every failure path, and trace-diff attribution.
+
+The load-bearing guarantees pinned here:
+  * recording is always on and FREE at step granularity (<1% of a step —
+    the same contract shape as the watchdog overhead test; the wall A/B is
+    banked by benchmarks/trace_overhead.py), and adds no device sync;
+  * every exported artifact is a schema-valid Chrome-trace document, and
+    the cross-host merge is deterministic and aligns tracks by step index;
+  * every failure path (divergence, stall, SIGTERM preemption — peer loss
+    runs in the multiproc drill) leaves a flight.json whose last step event
+    precedes the failure step;
+  * tracediff attributes an injected, known per-span delta to the
+    responsible span, with the right sign.
+"""
+
+import json
+import os
+import signal
+import statistics
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.obs import flight as flight_mod
+from word2vec_tpu.obs import tracediff
+from word2vec_tpu.obs.flight import FlightRecorder
+from word2vec_tpu.obs.phases import PhaseRecorder
+from word2vec_tpu.obs.trace import (
+    TraceRing,
+    chrome_trace_doc,
+    load_trace,
+    merge_traces,
+    validate_trace_doc,
+    write_trace,
+)
+from word2vec_tpu.train import Trainer
+from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup(**kw):
+    kw.setdefault("iters", 2)
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        batch_rows=4, max_sentence_len=16, min_count=1, seed=9, **kw,
+    )
+    vocab = zipf_vocab(40, 4000)
+    ids = zipf_corpus_ids(vocab, 3000, seed=5)
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    return cfg, vocab, corpus
+
+
+def synthetic_doc(pid: int, clock0_us: float, n_steps: int = 5,
+                  dispatch_us: float = 400.0, batcher_us: float = 100.0,
+                  step_us: float = 1000.0):
+    """A hand-built per-process trace: n steps of known span composition."""
+    evs = []
+    for k in range(n_steps):
+        ts = clock0_us + k * step_us
+        evs.append({"name": "step", "ph": "X", "ts": ts,
+                    "dur": step_us, "tid": 0, "args": {"step": k + 1}})
+        evs.append({"name": "dispatch", "ph": "X", "ts": ts,
+                    "dur": dispatch_us, "tid": 0})
+        evs.append({"name": "batcher_wait", "ph": "X",
+                    "ts": ts + dispatch_us, "dur": batcher_us, "tid": 0})
+    return chrome_trace_doc(evs, process_index=pid)
+
+
+# ---------------------------------------------------------------- TraceRing
+class TestTraceRing:
+    def test_complete_counter_instant_events(self):
+        ring = TraceRing()
+        t0 = time.perf_counter()
+        ring.complete("dispatch", t0, 0.002, args={"step": 3})
+        ring.counter("health", {"loss": 0.5, "grad_norm": 1.25})
+        ring.instant("heartbeat", args={"rows": [[0.0, 0.0, 3.0, 1.0]]})
+        evs = ring.events()
+        assert [e["ph"] for e in evs] == ["X", "C", "i"]
+        assert evs[0]["dur"] == pytest.approx(2000.0, rel=0.01)
+        assert evs[0]["args"]["step"] == 3
+        assert evs[1]["args"] == {"loss": 0.5, "grad_norm": 1.25}
+        assert all(e["ts"] >= 0 for e in evs)
+
+    def test_bounded_capacity_keeps_latest_and_counts_drops(self):
+        ring = TraceRing(capacity=4)
+        t0 = time.perf_counter()
+        for i in range(10):
+            ring.complete("s", t0, 0.001, args={"step": i})
+        assert len(ring) == 4
+        assert ring.dropped == 6
+        kept = [e["args"]["step"] for e in ring.events()]
+        assert kept == [6, 7, 8, 9]  # the LAST events, not the first
+
+    def test_chrome_doc_schema_and_roundtrip(self, tmp_path):
+        ring = TraceRing()
+        t0 = time.perf_counter()
+        ring.complete("dispatch", t0, 0.001)
+        ring.counter("health", {"loss": 1.0})
+        doc = chrome_trace_doc(ring.events(), process_index=2,
+                               process_name="host 2")
+        counts = validate_trace_doc(doc)
+        assert counts["X"] == 1 and counts["C"] == 1 and counts["M"] >= 1
+        assert doc["metadata"]["process_index"] == 2
+        path = str(tmp_path / "t" / "trace.json")
+        write_trace(path, doc)
+        assert load_trace(path) == json.loads(json.dumps(doc))
+
+    def test_validate_rejects_malformed(self):
+        with pytest.raises(ValueError, match="traceEvents"):
+            validate_trace_doc({"nope": 1})
+        bad = {"traceEvents": [
+            {"name": "x", "ph": "X", "ts": 1.0, "pid": 0, "tid": 0},
+        ]}
+        with pytest.raises(ValueError, match="dur"):
+            validate_trace_doc(bad)  # X event without dur
+
+
+# --------------------------------------------------- PhaseRecorder -> tracer
+def test_phase_spans_feed_tracer():
+    ring = TraceRing()
+    rec = PhaseRecorder(tracer=ring)
+    with rec.span("dispatch"):
+        pass
+    assert list(rec.timed_iter(iter([1, 2]), "batcher_wait")) == [1, 2]
+    names = [e["name"] for e in ring.events()]
+    assert names.count("dispatch") == 1
+    assert names.count("batcher_wait") == 2
+    # reset() keeps the tracer attached (flight survives per-run resets)
+    rec.reset()
+    assert rec.tracer is ring
+
+
+# ------------------------------------------------- trainer always-on flight
+@pytest.mark.parametrize("chunk_steps", [1, 0], ids=["per-step", "chunked"])
+def test_trainer_flight_records_steps_spans_counters(chunk_steps):
+    cfg, vocab, corpus = _setup(chunk_steps=chunk_steps)
+    t = Trainer(cfg, vocab, corpus)
+    state, rep = t.train(log_every=0)
+    evs = t.flight.ring.events()
+    names = {e["name"] for e in evs}
+    parent = "step" if chunk_steps == 1 else "chunk"
+    assert parent in names and "epoch" in names
+    assert "dispatch" in names and "batcher_wait" in names
+    # the parents carry the step index, ending at the run's last step
+    steps = [e["args"]["step"] for e in evs
+             if e.get("ph") == "X" and e["name"] == parent]
+    assert max(steps) == rep.steps == t.flight.last_step
+    # counter timeline via the lagged drain, loss present on every row
+    assert t.flight.counters
+    assert all("loss" in c and "step" in c for c in t.flight.counters)
+    # summarize sees the optimizer-step count on BOTH dispatch paths
+    s = tracediff.summarize(evs)
+    assert s["steps"] == rep.steps
+    assert s["spans"]["dispatch"]["count"] >= 1
+
+
+def test_trainer_flight_opt_out_is_safe():
+    cfg, vocab, corpus = _setup(chunk_steps=1, iters=1)
+    t = Trainer(cfg, vocab, corpus)
+    t.flight = None
+    t.phases.tracer = None
+    state, rep = t.train(log_every=0)  # no crash, no recording
+    assert rep.steps > 0
+
+
+def test_trace_overhead_contract():
+    """Satellite acceptance: the always-on recorder costs <1% of a step.
+    Same shape as the watchdog overhead test — the run's own p50 step time
+    vs the measured microcost of the ~6 events one step emits. The wall
+    A/B is banked by benchmarks/trace_overhead.py
+    (benchmarks/TRACE_OVERHEAD_cpu.json)."""
+    cfg, vocab, corpus = _setup(chunk_steps=1)
+    t = Trainer(cfg, vocab, corpus)
+    state, rep = t.train(log_every=0)
+    step_ms = sorted(
+        e["dur"] / 1e3 for e in t.flight.ring.events()
+        if e.get("ph") == "X" and e["name"] == "step"
+    )
+    p50_s = statistics.median(step_ms) / 1e3
+    ring = TraceRing()
+    n = 10_000
+    tref = time.perf_counter()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ring.complete("dispatch", tref, 0.001)
+    per_event = (time.perf_counter() - t0) / n
+    events_per_step = 6  # 4 phase spans + step parent + counter
+    assert events_per_step * per_event < 0.01 * p50_s, (
+        f"{events_per_step} events cost "
+        f"{events_per_step * per_event * 1e6:.1f}us vs p50 step "
+        f"{p50_s * 1e3:.2f}ms"
+    )
+
+
+def test_flight_adds_no_device_get(monkeypatch):
+    """The counter timeline rides the existing lagged drain: same fetch
+    bound as tests/test_obs.py pins without the recorder."""
+    cfg, vocab, corpus = _setup(chunk_steps=1)
+    t = Trainer(cfg, vocab, corpus)
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counted(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counted)
+    state, rep = t.train(log_every=0)
+    assert calls["n"] <= rep.steps + 2
+    assert len(t.flight.counters) == rep.steps
+
+
+# ------------------------------------------------------- cross-host merge
+class TestMerge:
+    def test_three_proc_merge_is_deterministic_and_step_aligned(self):
+        """Satellite acceptance: the 3-proc merge drill. Hosts with wildly
+        different clock origins merge into one doc, tracks keep their
+        process identity, step k starts at the same merged ts on every
+        track, and input order never changes the output."""
+        docs = [synthetic_doc(p, clock0_us=1e6 * (p + 1) * 7)
+                for p in (2, 0, 1)]
+        m1 = merge_traces(docs)
+        m2 = merge_traces(list(reversed(docs)))
+        assert m1 == m2  # deterministic regardless of input order
+        validate_trace_doc(m1)
+        assert m1["metadata"]["processes"] == [0, 1, 2]
+        assert m1["metadata"]["anchor_step"] == 1
+        starts = {}
+        for e in m1["traceEvents"]:
+            if e.get("ph") == "X" and e["name"] == "step" \
+                    and e["args"]["step"] == 3:
+                starts[e["pid"]] = e["ts"]
+        assert set(starts) == {0, 1, 2}
+        assert len(set(starts.values())) == 1  # aligned by step index
+        assert all(
+            e.get("ts", 0) >= 0 for e in m1["traceEvents"]
+            if e.get("ph") != "M"
+        )
+
+    def test_merge_without_common_steps_falls_back(self):
+        a = synthetic_doc(0, clock0_us=0.0)
+        b = chrome_trace_doc(
+            [{"name": "dispatch", "ph": "X", "ts": 5e6, "dur": 10.0,
+              "tid": 0}],
+            process_index=1,
+        )
+        m = merge_traces([a, b])
+        validate_trace_doc(m)
+        assert m["metadata"]["anchor_step"] is None
+        assert {e["pid"] for e in m["traceEvents"]} == {0, 1}
+
+    def test_merge_empty(self):
+        assert merge_traces([])["traceEvents"] == []
+
+
+# ------------------------------------------------------------- tracediff
+class TestTraceDiff:
+    def test_summarize_per_step_math(self):
+        doc = synthetic_doc(0, 0.0, n_steps=4, dispatch_us=400.0,
+                            batcher_us=100.0, step_us=1000.0)
+        s = tracediff.summarize(doc)
+        assert s["steps"] == 4
+        assert s["step_ms"] == pytest.approx(1.0)
+        assert s["spans"]["dispatch"]["ms_per_step"] == pytest.approx(0.4)
+        assert s["spans"]["dispatch"]["p50_ms"] == pytest.approx(0.4)
+        assert s["top_contributors"][0]["span"] == "dispatch"
+        assert s["top_contributors"][0]["share_of_step"] == pytest.approx(
+            0.4, abs=0.01
+        )
+
+    def test_chunk_parents_normalize_per_optimizer_step(self):
+        # one chunk parent spanning 8 optimizer steps == 8 per-step parents
+        evs = [
+            {"name": "chunk", "ph": "X", "ts": 0.0, "dur": 8000.0, "tid": 0,
+             "args": {"step": 8, "steps": 8}},
+            {"name": "dispatch", "ph": "X", "ts": 0.0, "dur": 4000.0,
+             "tid": 0},
+        ]
+        s = tracediff.summarize(chrome_trace_doc(evs))
+        assert s["steps"] == 8
+        assert s["step_ms"] == pytest.approx(1.0)
+        assert s["spans"]["dispatch"]["ms_per_step"] == pytest.approx(0.5)
+
+    def test_diff_attributes_injected_delta_with_sign(self, tmp_path):
+        """Tentpole acceptance: a known +2ms/step batcher_wait delta is
+        attributed to batcher_wait, positive B-minus-A; the reverse order
+        flips the sign."""
+        a = synthetic_doc(0, 0.0, dispatch_us=400.0, batcher_us=100.0,
+                          step_us=1000.0)
+        b = synthetic_doc(0, 0.0, dispatch_us=400.0, batcher_us=2100.0,
+                          step_us=3000.0)
+        d = tracediff.diff(a, b)
+        assert d["top_attribution"] == "batcher_wait"
+        top = d["spans"][0]
+        assert top["span"] == "batcher_wait"
+        assert top["delta_ms_per_step"] == pytest.approx(2.0)
+        assert d["step_delta_ms"] == pytest.approx(2.0)
+        assert top["share_of_step_delta"] == pytest.approx(1.0)
+        # dispatch unchanged: a ~zero row, ranked below
+        disp = next(r for r in d["spans"] if r["span"] == "dispatch")
+        assert disp["delta_ms_per_step"] == pytest.approx(0.0)
+        assert tracediff.diff(b, a)["spans"][0][
+            "delta_ms_per_step"
+        ] == pytest.approx(-2.0)
+        # the module CLI form, --json
+        pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        write_trace(pa, a)
+        write_trace(pb, b)
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert tracediff.main([pa, pb, "--json"]) == 0
+        out = json.loads(buf.getvalue())
+        assert out["top_attribution"] == "batcher_wait"
+        assert out["step_delta_ms"] == pytest.approx(2.0)
+
+    def test_main_rejects_unreadable(self, tmp_path, capsys):
+        assert tracediff.main([str(tmp_path / "no.json"),
+                               str(tmp_path / "no2.json")]) == 1
+        assert "error" in capsys.readouterr().err
+
+
+# --------------------------------------------------------- flight recorder
+class TestFlightRecorder:
+    def test_dump_snapshot_schema(self, tmp_path):
+        fr = FlightRecorder()
+        fr.note_step(3, time.perf_counter(), 0.01, epoch=0)
+        fr.note_counters(3, {"loss": 0.5, "skipme": "str"})
+        fr.log_record({"step": 3, "loss": 0.5})
+        path = fr.dump(str(tmp_path / "m"), reason="sigusr1",
+                       extra={"failure_step": 3})
+        fl = json.loads(open(path).read())
+        assert fl["reason"] == "sigusr1" and fl["failure_step"] == 3
+        assert fl["last_step"] == 3
+        assert fl["counters"] == [{"step": 3, "loss": 0.5}]
+        assert fl["log_records"] == [{"step": 3, "loss": 0.5}]
+        validate_trace_doc(fl["trace"])
+
+    def test_activate_scoping_through_train(self):
+        cfg, vocab, corpus = _setup(chunk_steps=1, iters=1)
+        t = Trainer(cfg, vocab, corpus)
+        seen = {}
+        orig_check = t._check_stop
+
+        def spy(state):
+            seen["active"] = flight_mod.active()
+            return orig_check(state)
+
+        t._check_stop = spy
+        assert flight_mod.active() is None
+        t.train(log_every=0)
+        assert seen["active"] is t.flight  # installed for the run's stretch
+        assert flight_mod.active() is None  # restored after
+
+    def test_heartbeat_rows_land_on_timeline(self):
+        fr = FlightRecorder()
+        fr.note_heartbeat([[0.0, 0.0, 8.0, 1.5], [1.0, 0.0, 8.0, 2.0]], 8)
+        evs = fr.ring.events()
+        assert evs[0]["name"] == "heartbeat" and evs[0]["ph"] == "i"
+        assert evs[0]["args"]["rows"][1][0] == 1.0  # pid column intact
+
+
+# -------------------------------------------------- failure-path dumps
+def test_watchdog_fire_dumps_flight_and_flushes(tmp_path):
+    """The stall path: fire -> flight.json (reason stalled, failure step)
+    next to stall.json, and flush_fn receives the record BEFORE the exit
+    (the MetricsHub close point on the os._exit path)."""
+    from word2vec_tpu.resilience.watchdog import StepWatchdog
+
+    mdir = str(tmp_path / "mdir")
+    fr = FlightRecorder()
+    fr.note_step(7, time.perf_counter(), 0.01)
+    flushed = []
+    done = threading.Event()
+
+    def on_fire(r):
+        done.set()
+
+    wd = StepWatchdog(deadline=0.15, grace_secs=0.15, metrics_dir=mdir,
+                      flight=fr, flush_fn=flushed.append, on_fire=on_fire)
+    wd.arm()
+    wd.beat(7)
+    try:
+        assert done.wait(3.0)
+    finally:
+        wd.disarm()
+    fl = json.loads(open(os.path.join(mdir, "flight.json")).read())
+    assert fl["reason"] == "stalled" and fl["failure_step"] == 7
+    assert fl["last_step"] == 7
+    stall = json.loads(open(os.path.join(mdir, "stall.json")).read())
+    assert stall["flight"].endswith("flight.json")
+    assert flushed and flushed[0]["event"] == "stalled"
+
+
+def test_watchdog_falls_back_to_active_recorder(tmp_path):
+    from word2vec_tpu.resilience.watchdog import StepWatchdog
+
+    mdir = str(tmp_path / "mdir")
+    fr = FlightRecorder()
+    fr.note_step(4, time.perf_counter(), 0.01)
+    done = threading.Event()
+    wd = StepWatchdog(deadline=0.15, grace_secs=0.15, metrics_dir=mdir,
+                      on_fire=lambda r: done.set())
+    prev = flight_mod.activate(fr)
+    wd.arm()
+    wd.beat(4)
+    try:
+        assert done.wait(3.0)
+    finally:
+        wd.disarm()
+        flight_mod.activate(prev)
+    assert json.loads(
+        open(os.path.join(mdir, "flight.json")).read()
+    )["failure_step"] == 4
+
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR1"),
+                    reason="platform has no SIGUSR1")
+def test_sigusr1_dumps_without_stopping(tmp_path):
+    """Satellite acceptance: SIGUSR1 dumps flight + all-thread stacks on
+    demand and the process carries on."""
+    from word2vec_tpu.resilience.shutdown import install_usr1_dump
+
+    mdir = str(tmp_path / "m")
+    fr = FlightRecorder()
+    fr.note_step(5, time.perf_counter(), 0.01)
+    uninstall = install_usr1_dump(mdir, fr)
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        # the handler runs on the main thread at the next bytecode boundary
+        deadline = time.time() + 5.0
+        while not os.path.exists(os.path.join(mdir, "flight_usr1.json")):
+            assert time.time() < deadline, "USR1 dump never landed"
+            time.sleep(0.02)
+    finally:
+        uninstall()
+    fl = json.loads(open(os.path.join(mdir, "flight_usr1.json")).read())
+    assert fl["reason"] == "sigusr1" and fl["last_step"] == 5
+    stacks = open(os.path.join(mdir, "stacks_usr1.txt")).read()
+    assert "Thread" in stacks or "Current thread" in stacks
+    # still alive and signal disposition restored
+    assert signal.getsignal(signal.SIGUSR1) in (
+        signal.SIG_DFL, signal.Handlers.SIG_DFL, None,
+    ) or callable(signal.getsignal(signal.SIGUSR1))
+
+
+# --------------------------------------------------- CLI failure-path e2e
+@pytest.fixture
+def corpus_file(tmp_path):
+    rng = np.random.default_rng(0)
+    toks = []
+    for _ in range(400):
+        toks += ["x", str(rng.choice(["a", "b"])), "y",
+                 "p", str(rng.choice(["c", "d"])), "q"]
+    p = tmp_path / "corpus.txt"
+    p.write_text(" ".join(toks))
+    return str(p)
+
+
+def _common(corpus_file):
+    return [
+        "-train", corpus_file, "-size", "8", "-negative", "2",
+        "-min-count", "1", "--backend", "cpu", "--batch-rows", "4",
+        "--max-sentence-len", "32", "--chunk-steps", "1", "--quiet",
+    ]
+
+
+def _flight_steps(fl):
+    return [
+        e["args"]["step"] for e in fl["trace"]["traceEvents"]
+        if e.get("ph") == "X" and e["name"] in ("step", "chunk")
+    ]
+
+
+def test_cli_nan_fault_leaves_flight_dump(tmp_path, corpus_file):
+    """Tentpole acceptance (divergence leg): injected nan@k exits rc=2 AND
+    leaves flight.json whose last step event precedes the failure step."""
+    from word2vec_tpu.cli import main
+
+    mdir = str(tmp_path / "mdir")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = main(_common(corpus_file) + [
+            "-output", str(tmp_path / "v.txt"), "-iter", "1",
+            "--divergence-budget", "3", "--faults", "nan@5",
+            "--metrics-dir", mdir,
+        ])
+    assert rc == 2
+    fl = json.loads(open(os.path.join(mdir, "flight.json")).read())
+    assert fl["reason"] == "diverged"
+    steps = _flight_steps(fl)
+    assert steps and max(steps) <= fl["failure_step"]
+    # the poisoned observations are on the counter timeline
+    assert any(c.get("nonfinite_loss_steps", 0) > 0 for c in fl["counters"])
+    validate_trace_doc(fl["trace"])
+
+
+def test_cli_sigterm_fault_leaves_flight_dump_and_trace(tmp_path, corpus_file):
+    """Tentpole acceptance (preemption leg) + --trace export on the
+    preempted path."""
+    from word2vec_tpu.cli import main
+
+    mdir = str(tmp_path / "mdir")
+    tdir = str(tmp_path / "tdir")
+    ck = str(tmp_path / "ck")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = main(_common(corpus_file) + [
+            "-output", str(tmp_path / "v.txt"), "-iter", "3",
+            "--checkpoint-dir", ck, "--checkpoint-every", "5",
+            "--faults", "sigterm@8", "--metrics-dir", mdir,
+            "--trace", tdir,
+        ])
+    assert rc == 75  # EXIT_PREEMPTED
+    fl = json.loads(open(os.path.join(mdir, "flight.json")).read())
+    assert fl["reason"] == "preempted"
+    steps = _flight_steps(fl)
+    assert steps and max(steps) <= fl["failure_step"]
+    doc = load_trace(os.path.join(tdir, "trace.json"))
+    counts = validate_trace_doc(doc)
+    assert counts.get("X", 0) > 0
+
+
+def test_cli_trace_export_clean_run(tmp_path, corpus_file):
+    from word2vec_tpu.cli import main
+
+    tdir = str(tmp_path / "tdir")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = main(_common(corpus_file) + [
+            "-output", str(tmp_path / "v.txt"), "-iter", "1",
+            "--trace", tdir,
+        ])
+    assert rc == 0
+    per_proc = load_trace(os.path.join(tdir, "trace_p0.json"))
+    merged = load_trace(os.path.join(tdir, "trace.json"))
+    validate_trace_doc(per_proc)
+    validate_trace_doc(merged)
+    s = tracediff.summarize(merged)
+    assert s["steps"] > 0 and "dispatch" in s["spans"]
+
+
+# ------------------------------------------------ supervisor + prom counters
+def test_supervisor_recovery_lands_on_flight_timeline():
+    from word2vec_tpu.resilience.faults import FaultPlan
+    from word2vec_tpu.resilience.supervisor import Supervisor
+
+    cfg, vocab, corpus = _setup(chunk_steps=1, iters=1,
+                                divergence_budget=2)
+    t = Trainer(cfg, vocab, corpus)
+    t.fault_plan = FaultPlan.parse("nan@2")
+    sup = Supervisor(t, checkpoint_dir=None, max_retries=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state, rep = sup.run(log_every=0)
+    assert rep.recoveries and rep.recoveries[0]["event"] == "auto_recover"
+    assert any(
+        r.get("event") == "auto_recover" for r in t.flight.records
+    )
+
+
+def test_prometheus_resilience_counters_and_timestamp(tmp_path):
+    """Satellite acceptance: the four resilience counters are present from
+    zero, count their events monotonically, and every exposition carries a
+    write timestamp — all in valid exposition format."""
+    import re
+
+    from word2vec_tpu.obs.export import prometheus_textfile
+
+    PROM_LINE = re.compile(
+        r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+        r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+        r"(NaN|[+-]?Inf|[-+0-9.eE]+))$"
+    )
+    path = str(tmp_path / "metrics.prom")
+    sink = prometheus_textfile(path)
+    sink({"step": 1, "loss": 0.5})
+    text = open(path).read()
+    for name in ("w2v_recoveries_total", "w2v_stalls_total",
+                 "w2v_peer_lost_total", "w2v_resume_fallbacks_total"):
+        assert f"{name} 0.0" in text, text  # present from zero
+    assert "w2v_exposition_timestamp_seconds" in text
+    before = float([
+        l for l in text.splitlines()
+        if l.startswith("w2v_exposition_timestamp_seconds")
+    ][0].split()[-1])
+    assert abs(time.time() - before) < 60.0
+    sink({"event": "auto_recover", "attempt": 1})
+    sink({"event": "auto_recover", "attempt": 2})
+    sink({"event": "stalled", "step": 9})
+    sink({"event": "resume_fallback", "mode": "epoch_restart"})
+    sink({"event": "resident_path", "resolved": "streaming"})  # not counted
+    text = open(path).read()
+    assert "w2v_recoveries_total 2.0" in text
+    assert "w2v_stalls_total 1.0" in text
+    assert "w2v_resume_fallbacks_total 1.0" in text
+    assert "w2v_peer_lost_total 0.0" in text
+    for line in text.strip().splitlines():
+        assert PROM_LINE.match(line), line
+    assert "# TYPE w2v_recoveries_total counter" in text
+    sink.close()
+
+
+# ------------------------------------------------------- cost attribution
+def test_cost_attribution_rows_from_trace_summary():
+    from word2vec_tpu.tune import cost_model
+
+    cfg = Word2VecConfig(word_dim=16, window=2, negative=3, min_count=1)
+    est = cost_model.predict(cfg, 100, "cpu", "cpu")
+    ts = {"spans": {
+        "dispatch": {"ms_per_step": 5.0},
+        "device_wait": {"ms_per_step": 1.0},
+        "batcher_wait": {"ms_per_step": 0.5},
+    }}
+    rows = cost_model.attribution_rows(est, ts)
+    dev = next(r for r in rows if r["term"] == "device_step")
+    assert dev["measured_ms"] == pytest.approx(6.0)
+    assert dev["predicted_ms"] == pytest.approx(
+        est.step_ms + est.dispatch_ms, rel=1e-4
+    )
+    assert dev["delta_ms"] == pytest.approx(
+        6.0 - dev["predicted_ms"], abs=1e-3
+    )
+    inp = next(r for r in rows if r["term"] == "input_wait")
+    assert inp["measured_ms"] == pytest.approx(0.5)
+    # tolerant of an empty summary (a run with no steps)
+    assert cost_model.attribution_rows(est, {})[0]["measured_ms"] == 0.0

@@ -407,6 +407,15 @@ def test_cli_stall_exits_stalled_then_resume_parity(tmp_path, corpus_file):
     assert stall["elapsed_s"] <= 2 * deadline + 1.0
     assert "phase" in stall and "boundary_stats" in stall
     assert os.path.getsize(os.path.join(mdir, "stall_stacks.txt")) > 0
+    # the stall's flight dump (PR 6): the run's last-steps timeline rides
+    # the failure artifact, last step event preceding the wedged boundary
+    fl = json.loads(open(os.path.join(mdir, "flight.json")).read())
+    assert fl["reason"] == "stalled"
+    fl_steps = [
+        e["args"]["step"] for e in fl["trace"]["traceEvents"]
+        if e.get("ph") == "X" and e["name"] in ("step", "chunk")
+    ]
+    assert fl_steps and max(fl_steps) <= stall["step"]
     man = json.load(open(os.path.join(mdir, "manifest.json")))
     assert man["shutdown"] == "stalled"
     assert not os.path.exists(tmp_path / "v_stall.txt")  # no export

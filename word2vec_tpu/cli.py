@@ -257,6 +257,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "metrics.jsonl, and metrics.prom there, and enables "
                         "the full on-device health counters unless "
                         "--health-metrics 0")
+    p.add_argument("--trace", metavar="DIR",
+                   help="export this run's step-scoped span timeline as "
+                        "Chrome-trace/Perfetto JSON into DIR (obs/trace.py: "
+                        "one trace_p<i>.json per process, merged into "
+                        "trace.json on process 0 by step index). Open in "
+                        "ui.perfetto.dev or chrome://tracing; diff two runs "
+                        "with python -m word2vec_tpu.obs.tracediff. The "
+                        "flight recorder itself is always on — this flag "
+                        "only controls the export")
     p.add_argument("--prom-textfile", metavar="FILE",
                    help="maintain a Prometheus-format textfile of the "
                         "latest metrics at FILE (node-exporter textfile "
@@ -778,16 +787,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     handler = ShutdownHandler().install()
 
     # Step-deadline watchdog: a run that stops reaching step boundaries is
-    # shot (EXIT_STALLED) with stacks + the wedged phase in the metrics dir
-    # instead of burning chip time invisibly. Installed BEFORE
-    # install_shutdown so the multihost stop check's heartbeat can read the
-    # watchdog's step-time p50.
+    # shot (EXIT_STALLED) with stacks + the wedged phase + the flight
+    # recorder's timeline in the metrics dir instead of burning chip time
+    # invisibly. Installed BEFORE install_shutdown so the multihost stop
+    # check's heartbeat can read the watchdog's step-time p50. flush_fn
+    # counts the stall in the Prometheus sinks and closes them — the fire
+    # path os._exits, skipping every atexit hook.
     if args.step_deadline:
+        def _stall_flush(rec):
+            hub({"event": "stalled", "step": rec.get("step")})
+            hub.close()
+
         trainer.watchdog = _watchdog.StepWatchdog(
             deadline=args.step_deadline,
             phases=trainer.phases,
             metrics_dir=metrics_dir,
             manifest_path=manifest_path,
+            flight=trainer.flight,
+            flush_fn=_stall_flush,
         )
     # Deadline-bounded collectives: process-wide, consumed by
     # parallel/multihost's agree/heartbeat allgathers and the sharded
@@ -798,6 +815,60 @@ def main(argv: Optional[List[str]] = None) -> int:
         args.sync_deadline or None
     )
     trainer.install_shutdown(handler)
+
+    # On-demand diagnostics: SIGUSR1 dumps the flight recorder + all-thread
+    # stacks into the metrics dir without stopping the run.
+    from .resilience.shutdown import install_usr1_dump
+
+    uninstall_usr1 = (
+        install_usr1_dump(metrics_dir, trainer.flight)
+        if metrics_dir else (lambda: None)
+    )
+
+    def dump_flight(reason: str, failure_step=None) -> None:
+        """Flight-recorder dump into the metrics dir (every failure path —
+        the stall path dumps from the watchdog's own fire thread instead)."""
+        if metrics_dir and trainer.flight is not None:
+            trainer.flight.dump(
+                metrics_dir, reason=reason,
+                extra={"failure_step": failure_step},
+            )
+
+    def export_trace() -> None:
+        """--trace DIR: Chrome-trace export of the run's span timeline.
+        Best-effort on every exit path — a failed export must not change
+        the run's exit code or eat its artifacts."""
+        if not args.trace or trainer.flight is None:
+            return
+        try:
+            import glob
+
+            from .obs.trace import (
+                chrome_trace_doc, load_trace, merge_traces, write_trace,
+            )
+
+            os.makedirs(args.trace, exist_ok=True)
+            idx = jax.process_index()
+            write_trace(
+                os.path.join(args.trace, f"trace_p{idx}.json"),
+                chrome_trace_doc(
+                    trainer.flight.ring.events(), process_index=idx
+                ),
+            )
+            if is_primary:
+                # merge whatever per-process tracks share this directory
+                # (single-process: just our own) into the canonical file
+                docs = [
+                    load_trace(p) for p in sorted(
+                        glob.glob(os.path.join(args.trace, "trace_p*.json"))
+                    )
+                ]
+                write_trace(
+                    os.path.join(args.trace, "trace.json"),
+                    merge_traces(docs),
+                )
+        except Exception as e:  # noqa: BLE001 — best-effort export
+            print(f"warning: trace export failed: {e}", file=sys.stderr)
 
     # Supervised auto-recovery: DivergenceError rolls back to the last-good
     # checkpoint and retries instead of killing the run.
@@ -838,8 +909,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
     except DivergenceError as e:
         # structured abort: the step/counters/checkpoint hint are in the
-        # message; the metrics sinks are flushed so the JSONL/prom tail
-        # shows the run's last healthy records
+        # message; the flight dump carries the timeline of the steps that
+        # led here, and the metrics sinks are flushed so the JSONL/prom
+        # tail shows the run's last healthy records
         print(f"error: DivergenceError: {e}", file=sys.stderr)
         if manifest_path:
             update_manifest(manifest_path, {
@@ -847,6 +919,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "divergence": e.record(),
                 "recoveries": supervisor.recoveries if supervisor else [],
             })
+        # failure_step = where the loop ABORTED (the lagged drain detects
+        # the poisoned observation one boundary later; e.step names the
+        # observation itself and is in the manifest's divergence record)
+        dump_flight(
+            "diverged",
+            failure_step=getattr(trainer.last_state, "step", None) or e.step,
+        )
+        export_trace()
         hub.close()
         return 2
     except SyncTimeout as e:
@@ -889,6 +969,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "sync_timeout": {"what": e.what, "deadline_s": e.deadline},
                 "final_checkpoint": saved,
             })
+        dump_flight("peer_lost", failure_step=getattr(last, "step", None))
+        export_trace()
+        # counted by the Prometheus sink's peer_lost_total before the close
+        hub({"event": "peer_lost", "what": e.what})
         print(
             f"peer lost: aborting at step "
             f"{getattr(last, 'step', '?')} for requeue"
@@ -903,11 +987,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         hub.close()
         return EXIT_PREEMPTED
     finally:
-        # restore signal dispositions, the process-wide fault plan, and the
-        # process-wide sync deadline on every exit path — main() runs
-        # in-process under tests, and a leaked SIGTERM handler or deadline
-        # would outlive the run it protects
+        # restore signal dispositions (incl. the SIGUSR1 dump), the
+        # process-wide fault plan, and the process-wide sync deadline on
+        # every exit path — main() runs in-process under tests, and a
+        # leaked SIGTERM handler or deadline would outlive the run it
+        # protects
         handler.uninstall()
+        uninstall_usr1()
         _watchdog.set_sync_deadline(prev_sync_deadline)
         if fault_plan:
             _faults.activate(prev_plan)
@@ -966,6 +1052,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     keep=args.checkpoint_keep,
                 )
         sig = handler.signum
+        dump_flight("preempted", failure_step=state.step)
+        export_trace()
         print(
             f"preempted (signal {sig}): stopped at step {state.step}; "
             + (
@@ -1012,6 +1100,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if not args.quiet:
             print(f"saved {'binary' if args.binary else 'text'} vectors to "
                   f"{args.output}")
+
+    export_trace()
 
     if (args.eval_ws353 or args.eval_analogy) and is_primary:
         from .eval.similarity import evaluate_ws353

@@ -1,31 +1,49 @@
-"""Telemetry subsystem (PR 3): health counters, phase timing, manifests,
-exporters.
+"""Telemetry subsystem (PR 3 + PR 6): health counters, phase timing,
+manifests, exporters, tracing, flight recorder.
 
-    obs.health   — on-device health counters inside the existing jit/scan
-                   (instrument_step), the lagged-drain HealthMonitor, and
-                   the structured DivergenceError tripwire
-    obs.phases   — host-side phase-timing breakdown (PhaseRecorder) with an
-                   input-bound-vs-compute-bound verdict
-    obs.manifest — run manifests: realized plan/backend, device, versions,
-                   git sha
-    obs.export   — MetricsHub sink fan-out + the Prometheus textfile sink
+    obs.health    — on-device health counters inside the existing jit/scan
+                    (instrument_step), the lagged-drain HealthMonitor, and
+                    the structured DivergenceError tripwire
+    obs.phases    — host-side phase-timing breakdown (PhaseRecorder) with an
+                    input-bound-vs-compute-bound verdict
+    obs.manifest  — run manifests: realized plan/backend, device, versions,
+                    git sha
+    obs.export    — MetricsHub sink fan-out + the Prometheus textfile sink
+                    (gauges, resilience counters, exposition timestamp)
+    obs.trace     — step-scoped span tracing: bounded event ring,
+                    Chrome-trace/Perfetto export, deterministic cross-host
+                    merge by step index
+    obs.flight    — always-on flight recorder: the last N steps of spans +
+                    counters + log records, dumped as flight.json on every
+                    failure path (divergence / stall / preemption / peer
+                    loss) and on demand via SIGUSR1
+    obs.tracediff — `python -m word2vec_tpu.obs.tracediff A.json B.json`:
+                    attribute a step-time delta between two traces to named
+                    spans; also the trace_summary bench.py banks
 
 Drivers (train.Trainer, parallel.ShardedTrainer, cli.py, bench.py) all
 route through here; utils/logging.py keeps the individual log sinks.
 """
 
 from .export import MetricsHub, prometheus_textfile
+from .flight import FlightRecorder
 from .health import DivergenceError, HealthMonitor, health_record
 from .manifest import manifest_dict, write_manifest
 from .phases import PhaseRecorder
+from .trace import TraceRing, chrome_trace_doc, merge_traces, write_trace
 
 __all__ = [
     "MetricsHub",
     "prometheus_textfile",
+    "FlightRecorder",
     "DivergenceError",
     "HealthMonitor",
     "health_record",
     "manifest_dict",
     "write_manifest",
     "PhaseRecorder",
+    "TraceRing",
+    "chrome_trace_doc",
+    "merge_traces",
+    "write_trace",
 ]

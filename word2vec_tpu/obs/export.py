@@ -12,17 +12,35 @@ record updates a gauge set, and the whole exposition is atomically
 rewritten (tmp + rename, so a scraping collector never reads a torn file).
 Numeric top-level record keys become `w2v_<key>` gauges; the nested
 per-phase stats dict (obs/phases.PhaseRecorder.snapshot) flattens to
-`w2v_phase_<stat>{phase="..."}`. Event records (one-off resolution notices)
-and non-numeric values are skipped — gauges are for continuous signals.
+`w2v_phase_<stat>{phase="..."}`. Non-numeric values are skipped — gauges
+are for continuous signals — but RESILIENCE EVENT records increment
+monotonic counters (EVENT_COUNTERS below: recoveries / stalls / peer losses
+/ resume fallbacks), always present in the exposition from zero so a
+dashboard can alert on `increase()` without waiting for the first incident.
+Every rewrite stamps `w2v_exposition_timestamp_seconds` so a scraper can
+tell a live file from a dead run's last exposition.
 """
 
 from __future__ import annotations
 
 import os
 import re
+import time
 from typing import Callable, Dict, List, Optional
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: event-record kinds counted as monotonic resilience counters. The events
+#: arrive on the same hub the JSONL sees: the supervisor logs auto_recover,
+#: the trainers log resume_fallback, and cli.py feeds stalled / peer_lost
+#: on the corresponding abort paths (the stall path via the watchdog's
+#: flush_fn, since os._exit skips every atexit hook).
+EVENT_COUNTERS = {
+    "auto_recover": "w2v_recoveries_total",
+    "stalled": "w2v_stalls_total",
+    "peer_lost": "w2v_peer_lost_total",
+    "resume_fallback": "w2v_resume_fallbacks_total",
+}
 
 
 class MetricsHub:
@@ -84,10 +102,19 @@ class PrometheusTextfile:
         os.makedirs(parent, exist_ok=True)
         # (name, labels-tuple) -> float; insertion order = exposition order
         self._gauges: Dict = {}
+        # resilience counters, present from zero (see EVENT_COUNTERS)
+        self._counters: Dict[str, float] = {
+            name: 0.0 for name in EVENT_COUNTERS.values()
+        }
 
     def __call__(self, record: Dict) -> None:
         if "event" in record:
-            return  # one-off notices are not gauges
+            # one-off notices are not gauges — but resilience events count
+            name = EVENT_COUNTERS.get(record["event"])
+            if name is not None:
+                self._counters[name] += 1.0
+                self._write()
+            return
         for key, val in record.items():
             if key == "phases" and isinstance(val, dict):
                 for phase, stats in val.items():
@@ -137,13 +164,22 @@ class PrometheusTextfile:
                     lines.append(f"{name}{{{lbl}}} {self._fmt(value)}")
                 else:
                     lines.append(f"{name} {self._fmt(value)}")
+        for name, value in self._counters.items():
+            lines.append(f"# HELP {name} word2vec_tpu resilience counter")
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {self._fmt(value)}")
+        # when this exposition was last rewritten (a scraper's liveness check)
+        ts_name = "w2v_exposition_timestamp_seconds"
+        lines.append(f"# HELP {ts_name} unix time of the last exposition write")
+        lines.append(f"# TYPE {ts_name} gauge")
+        lines.append(f"{ts_name} {self._fmt(time.time())}")
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             f.write("\n".join(lines) + "\n")
         os.replace(tmp, self.path)
 
     def close(self) -> None:
-        if self._gauges:
+        if self._gauges or any(self._counters.values()):
             self._write()
 
 

@@ -1,0 +1,191 @@
+"""Always-on flight recorder: every failure artifact carries its own timeline.
+
+The watchdog's `stall.json` names the wedged phase and the DivergenceError
+names the failing step — but neither shows what the run was DOING in the
+steps before it died, and by the time a failure is being debugged the run is
+gone. The flight recorder closes that gap the way avionics do: a bounded
+in-memory ring records the last N steps of span events (via the
+PhaseRecorder's tracer hook), health counters (via the trainers' lagged
+metrics drain), and log records, ALWAYS — no flag, no I/O, no device
+interaction (recording is a deque append under a lock; the <1% overhead
+contract is pinned in tests/test_trace.py and banked by
+benchmarks/trace_overhead.py). On any failure path the ring is dumped as
+`flight.json` into `--metrics-dir`:
+
+    divergence   — cli.py's DivergenceError handler (reason "diverged")
+    stall        — resilience/watchdog.StepWatchdog._fire, BEFORE the
+                   os._exit(EXIT_STALLED) (reason "stalled")
+    preemption   — cli.py's SIGTERM/preempted exit (reason "preempted")
+    peer loss    — cli.py's SyncTimeout handler (reason "peer_lost")
+    on demand    — SIGUSR1 (resilience/shutdown.install_usr1_dump) dumps
+                   `flight_usr1.json` + all-thread stacks without stopping
+
+The dump embeds a full Chrome-trace document (obs/trace.py), so a failure
+artifact opens directly in Perfetto and feeds
+`python -m word2vec_tpu.obs.tracediff` like any exported trace.
+
+The module-level `activate()`/`active()` pair mirrors faults.activate():
+`Trainer.train()` installs its recorder for the duration of the run so
+signal handlers and the watchdog's monitor thread can find the live ring
+without threading it through every call chain.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _process_index() -> int:
+    """This process's fleet rank for the trace's process track — the same
+    pid the heartbeat rows carry. Never imports-or-dies: a dump must work
+    even when jax is mid-teardown."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 — best-effort identity
+        return 0
+
+
+class FlightRecorder:
+    """Bounded ring of the last N steps of spans + counters + log records."""
+
+    #: steps of history kept (counters / log records ring depth; the event
+    #: ring holds EVENTS_PER_STEP times as many entries)
+    STEPS = 256
+    EVENTS_PER_STEP = 16
+
+    def __init__(self, steps: int = STEPS,
+                 events_per_step: int = EVENTS_PER_STEP):
+        from .trace import TraceRing
+
+        self.steps = max(1, int(steps))
+        self.ring = TraceRing(capacity=self.steps * max(1, events_per_step))
+        self._lock = threading.Lock()
+        self.counters: collections.deque = collections.deque(maxlen=self.steps)
+        self.records: collections.deque = collections.deque(maxlen=self.steps)
+        #: the last step boundary observed (None before any)
+        self.last_step: Optional[int] = None
+
+    # ------------------------------------------------------------ recording
+    def note_step(self, step: int, t0: float, dur_s: float,
+                  kind: str = "step", **args) -> None:
+        """One step/chunk/epoch parent span ('X' with the step index in
+        args) — the trainers call this at every boundary."""
+        if kind in ("step", "chunk"):
+            self.last_step = int(step)
+        self.ring.complete(kind, t0, dur_s, args={"step": int(step), **args})
+
+    def note_counters(self, step: int, counters: Dict[str, float]) -> None:
+        """One drained health-counter observation (the lagged metrics drain
+        — obs/health.py): a counter trace event plus a ring row."""
+        row: Dict[str, float] = {"step": int(step)}
+        for k, v in counters.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            row[k] = float(v)
+        with self._lock:
+            self.counters.append(row)
+        self.ring.counter(
+            "health", {k: v for k, v in row.items() if k != "step"}
+        )
+
+    def note_heartbeat(self, rows, step: int) -> None:
+        """One multi-process heartbeat's (pid, stop, step, p50) rows —
+        recorded so a peer-loss dump shows the fleet's last known state,
+        and so the merged trace can attribute tracks to hosts."""
+        try:
+            clean = [[float(x) for x in r] for r in rows]
+        except (TypeError, ValueError):
+            return
+        self.ring.instant(
+            "heartbeat", args={"at_step": int(step), "rows": clean}
+        )
+
+    def log_record(self, rec: Dict) -> None:
+        """One log record (sink-compatible: the trainers' _log feeds this
+        alongside the run's MetricsHub)."""
+        with self._lock:
+            self.records.append(dict(rec))
+
+    # ------------------------------------------------------------- dumping
+    def snapshot(self, reason: str, extra: Optional[Dict] = None) -> Dict:
+        """The flight.json payload: an embedded Chrome-trace doc plus the
+        counter and log-record tails."""
+        from .trace import chrome_trace_doc
+
+        with self._lock:
+            counters = list(self.counters)
+            records = list(self.records)
+        snap: Dict = {
+            "event": "flight",
+            "reason": reason,
+            "created_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "last_step": self.last_step,
+            "dropped_events": self.ring.dropped,
+            "trace": chrome_trace_doc(
+                self.ring.events(), process_index=_process_index()
+            ),
+            "counters": counters,
+            "log_records": records,
+        }
+        if extra:
+            snap.update(extra)
+        return snap
+
+    def dump(self, metrics_dir: str, reason: str,
+             extra: Optional[Dict] = None,
+             filename: str = "flight.json") -> Optional[str]:
+        """Write the snapshot into `metrics_dir` (atomic tmp+rename).
+        Best-effort by contract: returns the path, or None on any failure —
+        a dump must never mask the failure it documents."""
+        try:
+            os.makedirs(metrics_dir, exist_ok=True)
+            path = os.path.join(metrics_dir, filename)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.snapshot(reason, extra), f, indent=2,
+                          default=str)
+                f.write("\n")
+            os.replace(tmp, path)
+            return path
+        except Exception:  # noqa: BLE001 — see docstring
+            return None
+
+
+# ---------------------------------------------------- process-wide recorder
+# The watchdog's monitor thread and the SIGUSR1 handler need the LIVE
+# recorder without a reference being threaded to them; Trainer.train()
+# scopes its recorder here (same pattern as faults.activate()).
+_ACTIVE: Optional[FlightRecorder] = None
+
+
+def activate(recorder: Optional[FlightRecorder]) -> Optional[FlightRecorder]:
+    """Install the process-wide flight recorder; returns the previous one
+    (restore it in a finally when scoping)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = recorder
+    return prev
+
+
+def active() -> Optional[FlightRecorder]:
+    return _ACTIVE
+
+
+def dump_active(metrics_dir: str, reason: str,
+                extra: Optional[Dict] = None,
+                filename: str = "flight.json") -> Optional[str]:
+    """Dump the process-wide recorder, if any (the watchdog's fallback when
+    it was constructed without an explicit recorder)."""
+    fr = _ACTIVE
+    if fr is None:
+        return None
+    return fr.dump(metrics_dir, reason, extra=extra, filename=filename)

@@ -48,7 +48,12 @@ class PhaseRecorder:
     #: (ring overwrite), totals/counts from every span ever recorded
     MAX_SAMPLES = 4096
 
-    def __init__(self):
+    def __init__(self, tracer=None):
+        #: optional span sink (obs/trace.TraceRing, duck-typed: anything
+        #: with .complete(name, t0, dur_s)): every closed span also becomes
+        #: one timeline event — the flight recorder's feed. reset() leaves
+        #: it alone; set to None to detach.
+        self.tracer = tracer
         self._lock = threading.Lock()
         self._laps: Dict[str, list] = {}
         self._counts: Dict[str, int] = {}
@@ -103,7 +108,10 @@ class PhaseRecorder:
                 yield
             finally:
                 self._exit()
-                self.note(name, time.perf_counter() - t0)
+                dur = time.perf_counter() - t0
+                self.note(name, dur)
+                if self.tracer is not None:
+                    self.tracer.complete(name, t0, dur)
 
     def timed_iter(self, iterable: Iterable, name: str) -> Iterator:
         """Yield from `iterable`, recording each next() as one `name` span
@@ -118,7 +126,10 @@ class PhaseRecorder:
                 return
             finally:
                 self._exit()
-            self.note(name, time.perf_counter() - t0)
+            dur = time.perf_counter() - t0
+            self.note(name, dur)
+            if self.tracer is not None:
+                self.tracer.complete(name, t0, dur)
             yield item
 
     # ------------------------------------------------------- liveness view

@@ -706,6 +706,10 @@ class ShardedTrainer(Trainer):
                     if self.watchdog is not None else 0.0
                 ),
                 log_fn=self.log_fn,
+                # heartbeat pid rows on the flight timeline: a peer-loss
+                # dump shows the fleet's last agreed state, and the merged
+                # cross-host trace names its tracks (obs/trace.merge_traces)
+                flight=self.flight,
             ).check
         else:
             self.stop_check = handler.make_stop_check(process_count=1)

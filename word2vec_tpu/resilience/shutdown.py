@@ -30,6 +30,7 @@ plain flag read — no collective, no overhead.
 
 from __future__ import annotations
 
+import os
 import signal
 from typing import Callable, List, Optional
 
@@ -126,3 +127,49 @@ class ShutdownHandler:
             return global_agree_max(int(self.requested)) > 0
 
         return check
+
+
+def install_usr1_dump(metrics_dir: str, flight=None) -> Callable[[], None]:
+    """On-demand diagnostics WITHOUT stopping the run: SIGUSR1 dumps the
+    flight recorder (`flight_usr1.json`) and an all-thread stack dump
+    (`stacks_usr1.txt`) into `metrics_dir`, then returns to the interrupted
+    code. The stack dump reuses the step watchdog's faulthandler path
+    (resilience/watchdog.dump_all_stacks) — the same signal-safe formatting
+    the stall artifacts use, now available while the run is still healthy
+    (is it input-bound RIGHT NOW? what did the last 200 steps look like?).
+
+    `flight` defaults to the process-wide active recorder (the one
+    Trainer.train installs — obs/flight.activate). Returns an uninstall
+    callable; a no-op on platforms without SIGUSR1 or off the main thread
+    (Python's signal rule), mirroring ShutdownHandler.install's degrade.
+    """
+    usr1 = getattr(signal, "SIGUSR1", None)
+    if usr1 is None:
+        return lambda: None
+
+    def _handle(signum, frame) -> None:
+        try:
+            from ..obs import flight as flight_mod
+            from .watchdog import dump_all_stacks
+
+            os.makedirs(metrics_dir, exist_ok=True)
+            dump_all_stacks(os.path.join(metrics_dir, "stacks_usr1.txt"))
+            fl = flight if flight is not None else flight_mod.active()
+            if fl is not None:
+                fl.dump(metrics_dir, reason="sigusr1",
+                        filename="flight_usr1.json")
+        except Exception:  # noqa: BLE001 — an on-demand dump must never
+            pass           # kill the run it observes
+
+    try:
+        prev = signal.signal(usr1, _handle)
+    except ValueError:  # not the main thread
+        return lambda: None
+
+    def uninstall() -> None:
+        try:
+            signal.signal(usr1, prev)
+        except (ValueError, OSError):
+            pass
+
+    return uninstall

@@ -156,4 +156,9 @@ class Supervisor:
         self.recoveries.append(rec)
         if self.log_fn:
             self.log_fn(dict(rec))
+        fl = getattr(self.trainer, "flight", None)
+        if fl is not None:
+            # the recovery lands on the flight timeline too: a later dump
+            # shows the run healed (and how) without the JSONL file
+            fl.log_record(rec)
         return state

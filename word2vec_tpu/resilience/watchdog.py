@@ -99,6 +99,25 @@ def sync_deadline() -> Optional[float]:
     return _SYNC_DEADLINE
 
 
+def dump_all_stacks(path: Optional[str]) -> None:
+    """All-thread stack dump via faulthandler — signal-safe C-level
+    formatting that works even when a wedged thread holds arbitrary
+    Python-level locks (a traceback.format_stack walk could block on the
+    very lock the hang is about). Module-level so the SIGUSR1 on-demand
+    dump (resilience/shutdown.install_usr1_dump) reuses the exact path the
+    watchdog fires through. None writes to stderr."""
+    import faulthandler
+
+    try:
+        if path is None:
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        else:
+            with open(path, "w") as f:
+                faulthandler.dump_traceback(file=f, all_threads=True)
+    except Exception:
+        pass
+
+
 def bounded_call(fn: Callable, what: str = "collective",
                  deadline: Optional[float] = None):
     """Run `fn()` under a deadline; raise SyncTimeout if it doesn't return.
@@ -175,6 +194,8 @@ class StepWatchdog:
         metrics_dir: Optional[str] = None,
         manifest_path: Optional[str] = None,
         on_fire: Optional[Callable[[Dict], None]] = None,
+        flight=None,
+        flush_fn: Optional[Callable[[Dict], None]] = None,
     ):
         if deadline <= 0:
             raise ValueError(f"deadline must be > 0, got {deadline}")
@@ -189,6 +210,15 @@ class StepWatchdog:
         self.metrics_dir = metrics_dir
         self.manifest_path = manifest_path
         self.on_fire = on_fire
+        #: flight recorder (obs/flight.FlightRecorder) dumped as flight.json
+        #: next to stall.json on fire; falls back to the process-wide active
+        #: recorder (the one train() installs) when None
+        self.flight = flight
+        #: called with the stall record on the fire path BEFORE os._exit —
+        #: the CLI uses it to flush the MetricsHub sinks (a per-record JSONL
+        #: sink loses nothing, but the Prometheus textfile and any buffered
+        #: sink would otherwise miss the run's last word)
+        self.flush_fn = flush_fn
         #: set once the watchdog has fired (observable by tests / harnesses)
         self.fired = threading.Event()
         self._lock = threading.Lock()
@@ -289,19 +319,33 @@ class StepWatchdog:
             "boundary_stats": self.step_stats(),
         }
         stacks_path = None
+        flight = self.flight
+        if flight is None:
+            from ..obs import flight as _flight_mod
+
+            flight = _flight_mod.active()
         if self.metrics_dir:
             try:
                 os.makedirs(self.metrics_dir, exist_ok=True)
                 stacks_path = os.path.join(self.metrics_dir, "stall_stacks.txt")
-                self._dump_stacks(stacks_path)
+                dump_all_stacks(stacks_path)
                 record["stacks"] = stacks_path
+                if flight is not None:
+                    # the stall's timeline: what the run was doing in the
+                    # steps before the boundary stopped landing
+                    fpath = flight.dump(
+                        self.metrics_dir, reason="stalled",
+                        extra={"failure_step": step},
+                    )
+                    if fpath:
+                        record["flight"] = fpath
                 with open(os.path.join(self.metrics_dir, "stall.json"), "w") as f:
                     json.dump(record, f, indent=2, default=str)
                     f.write("\n")
             except OSError:
                 pass  # the exit code still tells the scheduler what happened
         else:
-            self._dump_stacks(None)  # stderr
+            dump_all_stacks(None)  # stderr
         if self.manifest_path:
             from ..obs.manifest import update_manifest
 
@@ -317,26 +361,18 @@ class StepWatchdog:
             file=sys.stderr, flush=True,
         )
         self.fired.set()
+        if self.flush_fn is not None:
+            # the os._exit below skips atexit: flush the metrics sinks NOW
+            # (per-record JSONL already landed; this covers buffered sinks
+            # and lets the Prometheus textfile count the stall)
+            try:
+                self.flush_fn(record)
+            except Exception:  # noqa: BLE001 — flushing must not block the exit
+                pass
         if self.on_fire is not None:
             self.on_fire(record)
             return
         os._exit(EXIT_STALLED)
-
-    def _dump_stacks(self, path: Optional[str]) -> None:
-        """All-thread stack dump via faulthandler — signal-safe C-level
-        formatting that works even when a wedged thread holds arbitrary
-        Python-level locks (a traceback.format_stack walk could block on
-        the very lock the hang is about)."""
-        import faulthandler
-
-        try:
-            if path is None:
-                faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
-            else:
-                with open(path, "w") as f:
-                    faulthandler.dump_traceback(file=f, all_threads=True)
-        except Exception:
-            pass
 
     def _wedged_phase(self) -> str:
         if self.phases is not None:
@@ -385,6 +421,7 @@ class PeerAgreement:
         straggler_factor: float = 4.0,
         straggler_min_ms: float = 50.0,
         log_fn=None,
+        flight=None,
     ):
         self.handler = handler
         self.every = max(1, int(agree_every))
@@ -392,6 +429,11 @@ class PeerAgreement:
         self.straggler_factor = float(straggler_factor)
         self.straggler_min_ms = float(straggler_min_ms)
         self.log_fn = log_fn
+        #: flight recorder (obs/flight.py): every heartbeat's (pid, stop,
+        #: step, p50) rows land on the timeline, so a peer-loss dump shows
+        #: the fleet's last agreed state and the cross-host trace merge can
+        #: attribute tracks to hosts
+        self.flight = flight
         self._warned: set = set()
 
     def check(self, step: int) -> bool:
@@ -400,6 +442,7 @@ class PeerAgreement:
         if step % self.every != 0:
             return False
         import jax
+        import numpy as np
 
         from ..parallel import multihost
 
@@ -412,6 +455,8 @@ class PeerAgreement:
             float(step),
             p50,
         ])
+        if self.flight is not None:
+            self.flight.note_heartbeat(np.asarray(rows).tolist(), step)
         self.inspect(rows, step)
         return bool(rows[:, 1].max() > 0)
 

@@ -31,6 +31,8 @@ from .data.batcher import (
 )
 from .data.vocab import Vocab
 from .models.params import Params, init_params
+from .obs import flight as flight_mod
+from .obs.flight import FlightRecorder
 from .obs.health import HealthMonitor, health_record
 from .obs.phases import PhaseRecorder
 from .ops.tables import DeviceTables
@@ -128,10 +130,18 @@ class Trainer:
         self.vocab = vocab
         self.corpus = corpus
         self.log_fn = log_fn
+        # Always-on flight recorder (obs/flight.py): a bounded ring of the
+        # last N steps of span events + health counters + log records,
+        # dumped as flight.json on every failure path. Recording is a deque
+        # append — cheap enough to leave on unconditionally (the <1%
+        # contract, tests/test_trace.py); set trainer.flight = None AND
+        # trainer.phases.tracer = None to opt out.
+        self.flight: Optional[FlightRecorder] = FlightRecorder()
         # phase-timing spans (obs/phases.py); reset per train() run. Created
         # before anything else because the batch-placement hooks record into
-        # it from the prefetch producer thread.
-        self.phases = PhaseRecorder()
+        # it from the prefetch producer thread; closed spans also land on
+        # the flight recorder's timeline through the tracer hook.
+        self.phases = PhaseRecorder(tracer=self.flight.ring)
         self._health: Optional[HealthMonitor] = None
         if config.autotune != "off":
             # Resolve the execution plan BEFORE anything shape-dependent is
@@ -314,15 +324,14 @@ class Trainer:
             "(recorded as resume_fallback: epoch_restart in the manifest).",
             stacklevel=3,
         )
-        if self.log_fn:
-            self.log_fn({
-                "event": "resume_fallback",
-                "mode": "epoch_restart",
-                "step": state.step,
-                "epoch": state.epoch,
-                "steps_per_epoch": steps_per_epoch,
-                "derived_skip": skip,
-            })
+        self._log({
+            "event": "resume_fallback",
+            "mode": "epoch_restart",
+            "step": state.step,
+            "epoch": state.epoch,
+            "steps_per_epoch": steps_per_epoch,
+            "derived_skip": skip,
+        })
         return 0
 
     def _post_step(self, state: TrainState) -> None:
@@ -375,7 +384,11 @@ class Trainer:
         wrapper scopes the step watchdog: armed for exactly the stretch
         where step boundaries are expected, disarmed on every exit path —
         including DivergenceError into a supervisor, whose rollback load
-        must not count against the step deadline (the retry re-arms)."""
+        must not count against the step deadline (the retry re-arms). The
+        flight recorder is installed process-wide for the same stretch so
+        the watchdog's monitor thread and the SIGUSR1 on-demand dump can
+        find the live ring (obs/flight.activate)."""
+        prev_flight = flight_mod.activate(self.flight)
         if self.watchdog is not None:
             self.watchdog.arm()
         try:
@@ -386,6 +399,7 @@ class Trainer:
         finally:
             if self.watchdog is not None:
                 self.watchdog.disarm()
+            flight_mod.activate(prev_flight)
 
     def _train_impl(
         self,
@@ -470,6 +484,7 @@ class Trainer:
         # per-step host sync, pinned by tests/test_obs.py.
         pending_obs: Optional[Tuple[Dict, int]] = None
         interrupted: Optional[str] = None
+        t_bound = time.perf_counter()
 
         def drain_obs() -> None:
             nonlocal pending_obs
@@ -483,6 +498,7 @@ class Trainer:
 
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
+            t_epoch = time.perf_counter()
             for tokens, words in self.phases.timed_iter(
                 prefetch(self._batches(batcher, epoch, skip)), "batcher_wait"
             ):
@@ -496,6 +512,14 @@ class Trainer:
                 state.step += 1
                 state.words_done += words
                 self._post_step(state)
+                if self.flight is not None:
+                    # step parent span on the flight timeline: boundary to
+                    # boundary, carrying the step index (the merge/diff key)
+                    now = time.perf_counter()
+                    self.flight.note_step(
+                        state.step, t_bound, now - t_bound, epoch=epoch
+                    )
+                    t_bound = now
                 drain_obs()
                 pending_obs = (metrics, state.step)
                 if log_every and state.step % log_every == 0:
@@ -515,7 +539,7 @@ class Trainer:
                             stacklevel=2,
                         )
 
-                    if self.log_fn:
+                    if self.log_fn or self.flight is not None:
                         dt = time.perf_counter() - t0
                         rec = {
                             "step": state.step,
@@ -534,7 +558,7 @@ class Trainer:
                         ph = self.phases.snapshot()
                         if ph:
                             rec["phases"] = ph
-                        self.log_fn(rec)
+                        self._log(rec)
                 if checkpoint_every and checkpoint_cb and state.step % checkpoint_every == 0:
                     self._run_checkpoint(checkpoint_cb, state)
                 if self._check_stop(state):
@@ -544,6 +568,11 @@ class Trainer:
                     # (_resume_skip), so requeue-and---resume loses nothing
                     interrupted = "preempted"
                     break
+            if self.flight is not None:
+                self.flight.note_step(
+                    state.step, t_epoch, time.perf_counter() - t_epoch,
+                    kind="epoch", epoch=epoch,
+                )
             if interrupted:
                 break
             state.epoch = epoch + 1  # epoch completed
@@ -629,8 +658,10 @@ class Trainer:
             )
 
         skip = self._resume_skip(state, batcher)
+        t_bound = time.perf_counter()
         for epoch in range(state.epoch, cfg.iters):
             state.epoch = epoch
+            t_epoch = time.perf_counter()
             for words_list, dispatch in self.phases.timed_iter(
                 self._chunk_dispatches(
                     state, batcher, base_key, epoch, skip, chunk_len
@@ -648,6 +679,15 @@ class Trainer:
                 state.step += len(words_list)
                 state.words_done = wd
                 self._post_step(state)
+                if self.flight is not None:
+                    # chunk parent span: the chunk is the dispatch atom, so
+                    # args.steps carries its width for per-step math
+                    now = time.perf_counter()
+                    self.flight.note_step(
+                        state.step, t_bound, now - t_bound, kind="chunk",
+                        steps=len(words_list), epoch=epoch,
+                    )
+                    t_bound = now
                 drain()
                 # per-step contract: history/logs only at log_every boundaries
                 # (here: once per chunk that crosses one); log_every=0 disables
@@ -673,6 +713,11 @@ class Trainer:
                     # the dispatch atom)
                     interrupted = "preempted"
                     break
+            if self.flight is not None:
+                self.flight.note_step(
+                    state.step, t_epoch, time.perf_counter() - t_epoch,
+                    kind="epoch", epoch=epoch,
+                )
             if interrupted:
                 break
             state.epoch = epoch + 1
@@ -749,8 +794,7 @@ class Trainer:
                 self.corpus.flat.nbytes + 8 * self.corpus.num_rows
             ),
         }
-        if self.log_fn:
-            self.log_fn(dict(self.resident_resolution))
+        self._log(dict(self.resident_resolution))
         if not fits:
             if cfg.resident == "on":
                 # the live budget (memory_stats-derived) is what failed, not
@@ -849,13 +893,31 @@ class Trainer:
         with self.phases.span("h2d"):
             return jnp.asarray(np_chunk)
 
+    def _log(self, rec: Dict) -> None:
+        """One log record, routed to the run's sink AND the flight
+        recorder's bounded record ring — a failure dump shows what the run
+        last said without needing the sink's file."""
+        if self.flight is not None:
+            self.flight.log_record(rec)
+        if self.log_fn:
+            self.log_fn(rec)
+
     def _observe_step(self, m: Dict, at_step: int) -> None:
         """One fetched per-step metrics dict, observed through the lagged
-        drain — the shared funnel for the hs tail warning and the health
-        monitor's divergence tripwire (obs/health.py). Raises
-        DivergenceError when the non-finite streak exceeds the budget."""
+        drain — the shared funnel for the hs tail warning, the flight
+        recorder's counter timeline, and the health monitor's divergence
+        tripwire (obs/health.py). Raises DivergenceError when the
+        non-finite streak exceeds the budget — AFTER the counters are
+        recorded, so the dump carries the poisoned observation."""
         if "hs_tail_dropped" in m:
             self._note_tail_dropped(float(np.sum(m["hs_tail_dropped"])), at_step)
+        if self.flight is not None:
+            c = {
+                "loss": float(np.sum(m["loss_sum"]))
+                / max(1.0, float(np.sum(m["pairs"])))
+            }
+            c.update(health_record(m, self.config.micro_steps))
+            self.flight.note_counters(at_step, c)
         if self._health is not None:
             self._health.observe(m, at_step)
 
@@ -936,6 +998,13 @@ class Trainer:
             self._note_tail_dropped(
                 float(np.sum(m["hs_tail_dropped"])), at_step
             )
+        if self.flight is not None:
+            # counter timeline: one observation per drained chunk, recorded
+            # BEFORE the tripwire below can raise (the dump must carry the
+            # poisoned observation)
+            c = {"loss": loss}
+            c.update(health_record(m, self.config.micro_steps))
+            self.flight.note_counters(at_step, c)
         if self._health is not None:
             # per-scan-step divergence tracking (same drain, no extra sync);
             # raises DivergenceError past the consecutive-non-finite budget
@@ -943,7 +1012,7 @@ class Trainer:
         if not do_log:
             return
         loss_hist.append(loss)
-        if self.log_fn:
+        if self.log_fn or self.flight is not None:
             dt = time.perf_counter() - t0
             rec = {
                 "step": at_step,
@@ -969,4 +1038,4 @@ class Trainer:
             ph = self.phases.snapshot()
             if ph:
                 rec["phases"] = ph
-            self.log_fn(rec)
+            self._log(rec)

@@ -142,3 +142,43 @@ def geometry(config, vocab_size: int) -> Dict:
     """Re-export of the shared shape resolution (utils/profiling) so planner
     callers need one import."""
     return step_geometry(config, vocab_size)
+
+
+def attribution_rows(est: CostEstimate, trace_summary: Dict) -> list:
+    """Measured-vs-predicted cost rows from a run's trace summary.
+
+    `trace_summary` is obs/tracediff.summarize over the flight ring (the
+    per-span ms/step bench.py banks). The mapping onto the model's terms:
+    the device-side prediction (step_ms + the amortized dispatch_ms) is
+    measured by the loop-stalling dispatch + device_wait spans; batcher_wait
+    is input wait the model deliberately prices at zero (the planner assumes
+    the input pipeline keeps up — a large measured value there is an
+    input-bound verdict, not model error, which is why it gets its own row
+    instead of polluting the device term). Banked by bench.py as
+    `cost_attribution` so the model's per-term error stays observable from
+    the record alone, round over round.
+    """
+    spans = (trace_summary or {}).get("spans", {})
+
+    def per_step(name: str) -> float:
+        return float(spans.get(name, {}).get("ms_per_step") or 0.0)
+
+    rows = [
+        {
+            "term": "device_step",
+            "spans": ["dispatch", "device_wait"],
+            "predicted_ms": round(est.step_ms + est.dispatch_ms, 4),
+            "measured_ms": round(
+                per_step("dispatch") + per_step("device_wait"), 4
+            ),
+        },
+        {
+            "term": "input_wait",
+            "spans": ["batcher_wait"],
+            "predicted_ms": 0.0,
+            "measured_ms": round(per_step("batcher_wait"), 4),
+        },
+    ]
+    for r in rows:
+        r["delta_ms"] = round(r["measured_ms"] - r["predicted_ms"], 4)
+    return rows

@@ -170,6 +170,9 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         chunk_cap=args.chunk_cap,
         slab_scatter=bool(args.slab_scatter),
         fused_tables=bool(args.fused) and args.train_method == "ns",
+        table_layout=args.table_layout,  # config raises on hs+unified: a
+                                         # misconfigured item must fail
+                                         # loudly, not bank mislabeled
         shared_negatives=args.kp,
         negative_scope=args.neg_scope,
         band_chunk=args.band_chunk,
@@ -518,6 +521,7 @@ def run_fault_drill(args: argparse.Namespace, platform_note: str | None) -> dict
         max_sentence_len=args.max_len,
         chunk_cap=args.chunk_cap,
         band_backend=args.band_backend,
+        table_layout=args.table_layout,
         prng_impl=args.prng,
         divergence_budget=4,
         seed=0,
@@ -673,6 +677,14 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fused", type=int, default=0, choices=[0, 1],
                     help="fused-table scatter inside chunks "
                     "(config.fused_tables; band ns only)")
+    ap.add_argument("--table-layout", choices=["split", "unified"],
+                    default="split",
+                    help="table storage layout (config.table_layout): "
+                    "unified = one persistent [V, 2, d] slab, ONE sorted "
+                    "scatter per step at doubled width (~half the "
+                    "table-update tail; trajectory bitwise identical). The "
+                    "banked record's plan carries the realized layout — "
+                    "queue items grep it (forwarding audit)")
     ap.add_argument("--resident", type=int, default=1, choices=[0, 1],
                     help="device-resident corpus (ops/resident.py); falls "
                     "back to host streaming when the corpus exceeds HBM "
@@ -864,6 +876,7 @@ def main() -> None:
         ("--hs-dense-top", args.hs_dense_top),
         ("--hs-tail-slots", args.hs_tail_slots),
         ("--resident", args.resident), ("--fused", args.fused),
+        ("--table-layout", args.table_layout),
         ("--prng", args.prng), ("--table-dtype", args.table_dtype),
         ("--sr", args.sr), ("--health", args.health),
         ("--autotune", args.autotune), ("--plan-cache", args.plan_cache),

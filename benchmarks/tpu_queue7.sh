@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-7 TPU measurement queue — the unified-table-scatter round (ISSUE 7).
+#
+# The tunnel has been dead since round 5, so queues 5/7 coexist: this one is
+# ordered so a SHORT window banks the decisions this round actually made.
+#
+#   Tier 1 — the A/B pair that decides the tentpole: default (split) vs
+#            --table-layout unified at the banked 30.4× config. The cost
+#            model predicts −1.03 ms of the ~8 ms step for unified (the
+#            per-layout scatter-row term, tune/cost_model.SCATTER_SEC_PER_ROW
+#            — two 49k-row sorted scatters collapse to one at doubled
+#            width); CPU A/B evidence is in benchmarks/COST_ATTRIB_r7.
+#   Tier 2 — the fresh trace of the REAL default path (resident chunked
+#            runner) the ROADMAP says must bank before any projection is
+#            trusted, PLUS --trace step-span exports of both layouts so
+#            `python -m word2vec_tpu.obs.tracediff` attributes the
+#            scatter-term delta from banked artifacts (PERF.md worked
+#            example).
+#   Tier 3 — the planner-candidate stacks this PR added: unified ×
+#            {kp32, kp16, bf16sr}, unified × pallas_oa, and an --autotune
+#            probe that must be free to pick any of them.
+#
+# Forwarding-audit markers (the r4 lesson, tpu_queue5.sh): an item banks
+# ONLY a record whose realized plan carries the requested layout/width —
+# bench.py's outer->inner re-exec once dropped a flag and banked the XLA
+# path under a pallas label. The plan JSON now carries table_layout /
+# shared_negatives / table_dtype / stochastic_rounding (TunePlan schema 2),
+# so the banked JSON itself proves what ran. JSON key order within "plan"
+# is the TunePlan field declaration order (dataclasses.asdict:
+# ... shared_negatives, negative_scope, band_backend, table_layout,
+# table_dtype, stochastic_rounding), and "platform" precedes "plan" in
+# bench.py's record, so one basic-regex grep covers each marker.
+#
+# Usage: nohup bash benchmarks/tpu_queue7.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/TPU_R7
+. benchmarks/tpu_queue_lib.sh
+
+B='python bench.py --probe-retries 1'
+TPU='"platform": "tpu"'
+# realized-layout markers: "table_layout" rides inside the record's "plan"
+UNI='"platform": "tpu".*"table_layout": "unified"'
+UNI_KP32='"platform": "tpu".*"shared_negatives": 32.*"table_layout": "unified"'
+UNI_KP16='"platform": "tpu".*"shared_negatives": 16.*"table_layout": "unified"'
+UNI_BF16SR='"platform": "tpu".*"table_layout": "unified".*"table_dtype": "bfloat16".*"stochastic_rounding": true'
+UNI_OA='"platform": "tpu".*"band_backend": "pallas_oa".*"table_layout": "unified"'
+
+# --- tier 1: the layout A/B that decides the tentpole -------------------------
+run_item default              900 "$TPU" $B
+run_item unified              900 "$UNI" $B --table-layout unified
+
+# --- tier 2: the real-default-path trace + layout tracediff artifacts ---------
+# run_trace banks the xprof decomposition of the resident chunked runner at
+# the banked 30.4x config (ROADMAP open item 2a: no projection is
+# trustworthy until this banks).
+run_trace /tmp/tr_r7
+# step-span exports for tracediff (obs/trace.py; diffing these attributes
+# the scatter-term delta between layouts — PERF.md worked example):
+run_item default_tracedump    900 "$TPU" $B --trace benchmarks/TPU_R7/trace_split
+run_item unified_tracedump    900 "$UNI" $B --table-layout unified --trace benchmarks/TPU_R7/trace_unified
+
+# --- tier 3: the new planner-candidate stacks ---------------------------------
+# unified x KP width (ROADMAP lever c: KP=64->32/16 halves the negative
+# einsum width each step; accuracy fence measured holding to KP=8):
+run_item unified_kp32         900 "$UNI_KP32" $B --table-layout unified --kp 32
+run_item unified_kp16         900 "$UNI_KP16" $B --table-layout unified --kp 16
+# unified x bf16+SR (ROADMAP lever d: halves table bytes; SR keeps updates
+# unbiased on the destination ulp grid; margin-neutral PARITY_MATRIX_r3):
+run_item unified_bf16sr       900 "$UNI_BF16SR" $B --table-layout unified --table-dtype bfloat16 --sr 1
+# unified x the overlap-add kernel (the only Pallas backend that composes
+# with fused/unified tables — ops/pallas_overlap.py):
+run_item unified_pallas_oa    900 "$UNI_OA" $B --table-layout unified --band-backend pallas_oa
+# split-side KP singles for like-for-like attribution of the stacks above:
+run_item kp16                 900 "$TPU" $B --kp 16
+# the full stack the cost model ranks best at this shape:
+run_item unified_kp32_bf16sr  900 "$UNI_KP32" $B --table-layout unified --kp 32 --table-dtype bfloat16 --sr 1
+# the planner's own verdict (probe mode persists the winner in the plan
+# cache; the banked record carries plan_probes for the audit trail):
+run_item autotune_probe      1800 "$TPU" $B --autotune probe
+
+echo "$(date -u +%FT%TZ) QUEUE7 COMPLETE after $FAILED_PROBES failed probes total" >> "$LOG"

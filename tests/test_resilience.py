@@ -264,11 +264,15 @@ class TestCheckpointDurability:
         save_checkpoint(ck, TrainState(params=params, step=1), cfg, vocab)
         meta = read_integrity_meta(ck)
         assert meta["vocab_hash"] == vocab.content_hash()
+        assert meta["table_layout"] == "split"  # ISSUE 7: layout pinned too
         verify_checkpoint(ck)  # meta doesn't perturb the file hashes
-        # no vocab -> no hash, and the reader degrades to {}
+        # no vocab -> no hash (the table layout is always pinned; a MISSING
+        # meta block still degrades to {} via the reader's exception path)
         ck2 = str(tmp_path / "ck2")
         save_checkpoint(ck2, TrainState(params=params, step=1), cfg)
-        assert read_integrity_meta(ck2) == {}
+        meta2 = read_integrity_meta(ck2)
+        assert "vocab_hash" not in meta2
+        assert meta2["table_layout"] == "split"
 
     def test_vocab_content_hash_sensitivity(self):
         from word2vec_tpu.data.vocab import Vocab

@@ -150,11 +150,77 @@ def test_dispatch_overhead_amortizes_with_chunk_cap():
     assert a.step_ms == b.step_ms  # cap changes dispatch economics only
 
 
+def test_scatter_term_matches_measured_r2_anchor():
+    """The per-layout scatter term at the traced flagship shape must
+    reproduce the r2 trace's row-machinery numbers (PERF.md): 2.08 ms for
+    the two 49,152-row table scatters + 0.41 ms for the 16,384 negative
+    rows = 2.49 ms split; unified collapses the token-id pair to one
+    scatter, predicting the ROADMAP's ~1 ms saving."""
+    split = cost_model.predict(_cfg(), 71000, *V5E)
+    uni = cost_model.predict(_cfg(table_layout="unified"), 71000, *V5E)
+    assert split.scatter_rows == 2 * 256 * 192 + 256 * 64
+    assert uni.scatter_rows == 256 * 192 + 256 * 64
+    assert abs(split.scatter_ms - 2.49) / 2.49 < 0.05, split.scatter_ms
+    saved = split.scatter_ms - uni.scatter_ms
+    assert abs(saved - 1.0) < 0.1, saved  # the ROADMAP's ~1 ms prediction
+
+
+def test_planner_ranks_unified_above_split_iff_scatter_term_counts():
+    """ISSUE 7 counterfactual flip: the unified layout outranks split at
+    the flagship shape BECAUSE of the per-row scatter machinery term — with
+    SCATTER_SEC_PER_ROW counterfactually zeroed (scatters priced as pure
+    bytes), the two layouts tie and the preference must disappear. The
+    model may not hardcode a unified preference."""
+    s_cfg, u_cfg = _cfg(), _cfg(table_layout="unified")
+    assert cost_model.predicted_words_per_sec(
+        u_cfg, 71000, *V5E
+    ) > cost_model.predicted_words_per_sec(s_cfg, 71000, *V5E)
+    orig = cost_model.SCATTER_SEC_PER_ROW
+    try:
+        cost_model.SCATTER_SEC_PER_ROW = 0.0
+        assert cost_model.predicted_words_per_sec(
+            s_cfg, 71000, *V5E
+        ) >= cost_model.predicted_words_per_sec(u_cfg, 71000, *V5E)
+    finally:
+        cost_model.SCATTER_SEC_PER_ROW = orig
+
+
+def test_attribution_rows_carry_the_per_layout_scatter_term():
+    """bench.py's cost_attribution must name the scatter sub-term (with
+    its row count) so a banked record shows how much of its predicted step
+    the table layout is carrying — the split-vs-unified tracediff A/B then
+    measures it differentially (PERF.md worked example)."""
+    for layout in ("split", "unified"):
+        est = cost_model.predict(_cfg(table_layout=layout), 71000, *V5E)
+        rows = {
+            r["term"]: r
+            for r in cost_model.attribution_rows(est, {"spans": {}})
+        }
+        assert "table_scatter" in rows
+        assert rows["table_scatter"]["predicted_ms"] == round(
+            est.scatter_ms, 4
+        )
+        assert rows["table_scatter"]["scatter_rows"] == est.scatter_rows
+    # the device_step row still reconciles: scatter_ms is INSIDE step_ms
+    assert est.step_ms > est.scatter_ms
+
+
 # -------------------------------------------------------------- plan cache
+def _key(cfg, device="cpu", platform="cpu", vocab=71000, dim=None):
+    """plan_key from a config, the way resolve_plan derives it (the key
+    carries the CONFIGURED table layout + KP width since schema 2)."""
+    return plan_cache.plan_key(
+        device, platform, kernel_route(cfg), vocab,
+        dim if dim is not None else cfg.word_dim,
+        table_layout=cfg.table_layout,
+        shared_negatives=cfg.shared_negatives,
+    )
+
+
 def test_plan_cache_round_trip(tmp_path):
     path = str(tmp_path / "plans.json")
     cfg = _cfg()
-    key = plan_cache.plan_key("cpu", "cpu", kernel_route(cfg), 71000, 300)
+    key = _key(cfg)
     fp = config_fingerprint(cfg)
     entry = {
         "plan": TunePlan(batch_rows=128, chunk_cap=96).to_json(),
@@ -172,18 +238,44 @@ def test_plan_cache_round_trip(tmp_path):
 def test_plan_cache_invalidates_on_key_and_fingerprint_change(tmp_path):
     path = str(tmp_path / "plans.json")
     cfg = _cfg()
-    key = plan_cache.plan_key("cpu", "cpu", kernel_route(cfg), 71000, 300)
+    key = _key(cfg)
     fp = config_fingerprint(cfg)
     plan_cache.store(
         key, {"plan": TunePlan().to_json(), "fingerprint": fp}, path
     )
     # a different (vocab, dim) key misses
-    other = plan_cache.plan_key("cpu", "cpu", kernel_route(cfg), 71000, 200)
+    other = _key(cfg, dim=200)
     assert plan_cache.lookup(other, fp, path) is None
     # same key, changed problem (window) -> fingerprint miss
     fp2 = config_fingerprint(_cfg(window=10))
     assert plan_cache.lookup(key, fp2, path) is None
     assert plan_cache.lookup(key, fp, path) is not None
+
+
+def test_plan_cache_key_separates_table_layout_and_kp(tmp_path):
+    """ISSUE 7 satellite (the schema-1 bug): a plan probed under the split
+    layout must NEVER be served to a unified-configured run, and a pinned
+    KP width (e.g. a KP=8 quality run) must not inherit another width's
+    plan — both are key dimensions now, not silent collisions."""
+    path = str(tmp_path / "plans.json")
+    cfg_split = _cfg()
+    fp = config_fingerprint(cfg_split)
+    plan_cache.store(
+        _key(cfg_split),
+        {"plan": cfg_split.current_plan().to_json(), "fingerprint": fp},
+        path,
+    )
+    cfg_uni = _cfg(table_layout="unified")
+    # the fingerprint is layout-independent (layout lives in the KEY), so
+    # only the key separation protects this lookup — it must miss
+    assert config_fingerprint(cfg_uni) == fp
+    assert plan_cache.lookup(_key(cfg_uni), fp, path) is None
+    cfg_kp8 = _cfg(shared_negatives=8)
+    assert plan_cache.lookup(
+        _key(cfg_kp8), config_fingerprint(cfg_kp8), path
+    ) is None
+    # the original problem still hits
+    assert plan_cache.lookup(_key(cfg_split), fp, path) is not None
 
 
 def test_plan_cache_corrupt_file_reads_as_empty(tmp_path):
@@ -202,7 +294,7 @@ def test_plan_cache_round_trips_the_backend_field(tmp_path):
     silently re-run the XLA chain under a pallas_oa label."""
     path = str(tmp_path / "plans.json")
     cfg = _cfg(band_backend="pallas_oa")
-    key = plan_cache.plan_key("TPU v5 lite", "tpu", kernel_route(cfg), 71000, 300)
+    key = _key(cfg, "TPU v5 lite", "tpu")
     fp = config_fingerprint(cfg)
     plan = TunePlan(band_backend="pallas_oa", band_chunk=96, chunk_cap=96)
     plan_cache.store(key, {"plan": plan.to_json(), "fingerprint": fp}, path)
@@ -214,11 +306,18 @@ def test_plan_cache_round_trips_the_backend_field(tmp_path):
 
 
 def test_vocab_size_bucketing_makes_near_vocabs_share_plans():
-    k1 = plan_cache.plan_key("TPU v5 lite", "tpu", "band-ns", 71290, 300)
-    k2 = plan_cache.plan_key("TPU v5 lite", "tpu", "band-ns", 71000, 300)
+    k1 = plan_cache.plan_key(
+        "TPU v5 lite", "tpu", "band-ns", 71290, 300,
+        table_layout="split", shared_negatives=64,
+    )
+    k2 = plan_cache.plan_key(
+        "TPU v5 lite", "tpu", "band-ns", 71000, 300,
+        table_layout="split", shared_negatives=64,
+    )
     assert k1 == k2
     assert plan_cache.plan_key(
-        "TPU v5 lite", "tpu", "band-ns", 50000, 300
+        "TPU v5 lite", "tpu", "band-ns", 50000, 300,
+        table_layout="split", shared_negatives=64,
     ) != k1
 
 
@@ -231,9 +330,7 @@ def test_seed_plans_cover_the_banked_tpu_default():
         model="sg", train_method="ns", negative=5, word_dim=300, window=5,
         subsample_threshold=1e-4, batch_rows=256, max_sentence_len=192,
     )
-    key = plan_cache.plan_key(
-        "TPU v5 lite", "tpu", kernel_route(cfg), 71000, 300
-    )
+    key = _key(cfg, "TPU v5 lite", "tpu")
     entry = plan_cache.lookup(
         key, config_fingerprint(cfg), path=os.devnull
     )
@@ -288,6 +385,32 @@ def test_candidate_grid_offers_pallas_oa_on_tpu():
         cfg, 71000, {"platform": "tpu", "allow_pallas": False}
     )
     assert {p.band_backend for p in sharded} == {"xla"}
+
+
+def test_candidate_grid_offers_layout_kp_and_bf16sr_candidates():
+    """ISSUE 7: the grid carries the three new sibling levers — both table
+    layouts, KP down to 16 (fence measured to KP=8), and bf16+SR-by-default
+    — and never pairs unified with the fully-fused pallas kernel (which
+    gathers the two tables separately)."""
+    cfg = _cfg(chunk_steps=0)
+    grid = candidate_grid(cfg, 71000, {"platform": "tpu"})
+    assert {p.table_layout for p in grid} == {"split", "unified"}
+    assert {16, 32, 64} <= {p.shared_negatives for p in grid}
+    assert any(
+        p.table_dtype == "bfloat16" and p.stochastic_rounding for p in grid
+    )
+    for plan in grid:
+        cfg.apply_plan(plan)  # every candidate must be a valid config
+        assert not (
+            plan.table_layout == "unified" and plan.band_backend == "pallas"
+        ), plan
+    # hs routes offer no ns-only levers
+    hs_grid = candidate_grid(
+        _cfg(train_method="hs", negative=0, word_dim=200, chunk_steps=0),
+        71000, {"platform": "tpu"},
+    )
+    assert {p.table_layout for p in hs_grid} == {"split"}
+    assert all(p.table_dtype == "float32" for p in hs_grid)
 
 
 def test_candidate_grid_respects_hot_row_block_guard():

@@ -1,0 +1,507 @@
+"""Unified table layout (config.table_layout="unified"; ISSUE 7 tentpole).
+
+The two ns tables are STORED as one [V, 2, d] slab end to end — init,
+every kernel dispatch granularity, checkpoint, mesh PartitionSpecs, export
+— and the step's one shared sorted token-id set is scattered once at
+doubled width. Claims pinned here:
+
+  1. trajectory equivalence: unified vs split training is BITWISE identical
+     — f32 across sg/cbow x negative scope x clip, and bf16 ± stochastic
+     rounding too (the fused scatter quantizes per PLANE on the split
+     step's exact SR streams, ops/band_step.py);
+  2. checkpoint/resume round-trips ACROSS layouts convert losslessly in
+     both directions (and the sharded-at-sync-boundary SIGTERM-parity pin
+     from PR 4 holds under the unified layout);
+  3. conversion that cannot be lossless fails loudly naming both layouts;
+  4. exporters emit the two logical tables from the slab without a full
+     host-side [V, 2, d] copy (slice-and-stream: the host-array path is a
+     zero-copy view — the memory-bound regression pin);
+  5. the config guards reject the unsupported combinations.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import BatchIterator, PackedCorpus
+from word2vec_tpu.io.checkpoint import (
+    load_checkpoint, read_integrity_meta, save_checkpoint,
+)
+from word2vec_tpu.models.params import (
+    FUSED_KEY, FUSED_SUBTABLES, convert_params_layout, export_matrix,
+    fuse_tables, init_params, logical_table, params_layout, unfuse_tables,
+)
+from word2vec_tpu.train import Trainer, TrainState
+from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+TABLES = ("emb_in", "emb_out_ns")
+
+
+def _toy(n_tokens=4000, vocab_size=60, seed=5):
+    vocab = zipf_vocab(vocab_size=vocab_size, total_words=n_tokens * 10)
+    sents = zipf_corpus_ids(vocab, num_tokens=n_tokens, seed=seed,
+                            sentence_len=41)
+    return vocab, PackedCorpus.pack(sents, 16)
+
+
+def _kw(**over):
+    kw = dict(
+        model="sg", train_method="ns", negative=4, word_dim=16, window=2,
+        min_count=1, subsample_threshold=1e-3, iters=2, batch_rows=4,
+        max_sentence_len=16, chunk_steps=8, seed=3,
+    )
+    kw.update(over)
+    return kw
+
+
+def _run(layout, vocab, corpus, **over):
+    cfg = Word2VecConfig(table_layout=layout, **_kw(**over))
+    state, _ = Trainer(cfg, vocab, corpus).train(log_every=0)
+    return state
+
+
+def _logical_equal(p_a, p_b, **np_kw):
+    for k in TABLES:
+        np.testing.assert_array_equal(
+            np.asarray(logical_table(p_a, k)).astype(np.float32),
+            np.asarray(logical_table(p_b, k)).astype(np.float32),
+            err_msg=k, **np_kw,
+        )
+
+
+# ----------------------------------------------------- layout machinery
+def test_fuse_roundtrip_any_rank():
+    """fuse/unfuse stack at axis -2, so unreplicated [V, d] and mesh-
+    replicated [R, V, d] params restack identically (parallel/trainer)."""
+    rng = np.random.default_rng(0)
+    for shape in [(10, 4), (3, 10, 4)]:
+        params = {
+            "emb_in": rng.normal(size=shape).astype(np.float32),
+            "emb_out_ns": rng.normal(size=shape).astype(np.float32),
+        }
+        fused = fuse_tables(params)
+        assert fused[FUSED_KEY].shape == (*shape[:-1], 2, shape[-1])
+        back = unfuse_tables(fused)
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(back[k]), params[k])
+
+
+def test_init_params_unified_stacks_the_split_init():
+    for model in ("sg", "cbow"):
+        kw = _kw(model=model)
+        key = jax.random.key(7)
+        split = init_params(Word2VecConfig(**kw), 50, key)
+        uni = init_params(
+            Word2VecConfig(table_layout="unified", **kw), 50, key
+        )
+        assert params_layout(uni) == "unified"
+        assert set(uni) == {FUSED_KEY}
+        _logical_equal(uni, split)
+
+
+def test_convert_params_layout_round_trips_and_fails_loudly():
+    cfg = Word2VecConfig(**_kw())
+    params = init_params(cfg, 40, jax.random.key(1))
+    uni = convert_params_layout(params, "unified")
+    assert params_layout(uni) == "unified"
+    back = convert_params_layout(uni, "split")
+    _logical_equal(back, params)
+    assert convert_params_layout(params, "split") == dict(params)  # no-op
+    # hs params have no unified form: loud, names both layouts' vocabulary
+    hs = init_params(
+        Word2VecConfig(**_kw(train_method="hs", negative=0)), 40,
+        jax.random.key(1),
+    )
+    with pytest.raises(ValueError, match="split-layout.*unified"):
+        convert_params_layout(hs, "unified")
+    with pytest.raises(ValueError, match="unknown table layout"):
+        convert_params_layout(params, "stacked")
+
+
+def test_config_guards():
+    for bad in [
+        dict(train_method="hs", negative=0),
+        dict(kernel="pair"),
+        dict(slab_scatter=True),
+        dict(band_backend="pallas"),
+        dict(fused_tables=True),
+    ]:
+        with pytest.raises(ValueError):
+            Word2VecConfig(table_layout="unified", **_kw(**bad))
+    with pytest.raises(ValueError, match="table_layout"):
+        Word2VecConfig(**_kw(table_layout="stacked"))
+    # pallas_oa composes (the overlap-add kernel emits token-order grads)
+    Word2VecConfig(table_layout="unified", band_backend="pallas_oa", **_kw())
+
+
+# ------------------------------------------------- trajectory equivalence
+@pytest.mark.parametrize("chunk_steps", [1, 8])
+@pytest.mark.parametrize("model,neg_scope", [
+    ("sg", "row"), ("sg", "batch"), ("cbow", "row"), ("cbow", "batch"),
+])
+def test_unified_trajectory_bitwise_f32(model, neg_scope, chunk_steps):
+    """The ISSUE 7 equivalence bar: bitwise-identical f32 trajectory vs
+    the split layout across sg/cbow x negative scope, at BOTH dispatch
+    granularities (the unified layout takes the fused step on the per-step
+    path too — there is no restack to amortize)."""
+    vocab, corpus = _toy()
+    kw = dict(model=model, negative_scope=neg_scope, chunk_steps=chunk_steps)
+    s_u = _run("unified", vocab, corpus, **kw)
+    s_s = _run("split", vocab, corpus, **kw)
+    assert s_u.step == s_s.step
+    assert params_layout(s_u.params) == "unified"
+    assert params_layout(s_s.params) == "split"
+    _logical_equal(s_u.params, s_s.params)
+
+
+def test_unified_trajectory_bitwise_with_clip_engaged():
+    """The per-row trust region must see identical row sums in both
+    layouts — pinned at a tau small enough to actually engage."""
+    vocab, corpus = _toy()
+    s_u = _run("unified", vocab, corpus, clip_row_update=0.02)
+    s_s = _run("split", vocab, corpus, clip_row_update=0.02)
+    _logical_equal(s_u.params, s_s.params)
+
+
+def test_unified_trajectory_bitwise_with_scatter_mean():
+    vocab, corpus = _toy()
+    s_u = _run("unified", vocab, corpus, scatter_mean=True)
+    s_s = _run("split", vocab, corpus, scatter_mean=True)
+    _logical_equal(s_u.params, s_s.params)
+
+
+@pytest.mark.parametrize("sr", [False, True])
+def test_unified_trajectory_bitwise_bf16(sr):
+    """bf16 tables, with AND without stochastic rounding: the fused
+    scatter casts each plane separately on the split step's exact SR
+    streams (0=in, 1=out, 2=negatives — ops/band_step.py), so even the
+    random ulp draws match and the bf16±SR trajectories are bitwise."""
+    vocab, corpus = _toy()
+    kw = dict(dtype="bfloat16", stochastic_rounding=sr)
+    s_u = _run("unified", vocab, corpus, **kw)
+    s_s = _run("split", vocab, corpus, **kw)
+    assert s_u.params[FUSED_KEY].dtype == np.dtype(jax.numpy.bfloat16)
+    _logical_equal(s_u.params, s_s.params)
+
+
+def test_unified_trajectory_bitwise_pallas_oa_interpret():
+    """unified x pallas_oa (the one Pallas backend that composes): the
+    interpret-mode kernel on CPU must reproduce the split XLA trajectory
+    bitwise — chunked band representation required (band_chunk >= 2W)."""
+    vocab, corpus = _toy()
+    kw = dict(band_chunk=8, chunk_steps=4, iters=1)
+    s_u = _run("unified", vocab, corpus, band_backend="pallas_oa", **kw)
+    s_s = _run("split", vocab, corpus, **kw)
+    _logical_equal(s_u.params, s_s.params)
+
+
+@pytest.mark.parametrize("resident,mesh_shape", [
+    ("on", (4, 1, 1)), ("off", (2, 2, 2)),
+])
+def test_unified_sharded_trajectory_bitwise(resident, mesh_shape):
+    """Unified slab over the mesh: the [R, V, 2, d] replicated params keep
+    the dim sharding on the LAST axis (parallel/trainer.param_spec), and
+    the trajectory matches split on resident and streaming runners."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    from word2vec_tpu.parallel import ShardedTrainer, make_mesh
+
+    dp, sp, tp = mesh_shape
+    vocab, corpus = _toy(n_tokens=6000)
+    kw = _kw(negative=3, chunk_steps=4, seed=11, dp_sync_every=8,
+             resident=resident)
+
+    def run(layout):
+        cfg = Word2VecConfig(table_layout=layout, **kw)
+        tr = ShardedTrainer(cfg, vocab, corpus, mesh=make_mesh(dp, tp, sp))
+        state, _ = tr.train(log_every=0)
+        assert params_layout(state.params) == layout
+        return tr.export_params(state)
+
+    _logical_equal(run("unified"), run("split"))
+
+
+# ---------------------------------------------- checkpoints across layouts
+@pytest.mark.parametrize("first,second", [
+    ("split", "unified"), ("unified", "split"),
+])
+def test_checkpoint_cross_layout_resume_bitwise(tmp_path, first, second):
+    """A checkpoint written under one layout resumed into the other
+    converts losslessly (train._coerce_param_layout): the continued
+    trajectory is bitwise the single-layout run's."""
+    vocab, corpus = _toy()
+    full = _run(first, vocab, corpus)
+
+    t = Trainer(Word2VecConfig(table_layout=first, **_kw()), vocab, corpus)
+    t.stop_check = lambda step: step >= 13
+    st, rep = t.train(log_every=0)
+    assert rep.interrupted == "preempted"
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, st, t.config, vocab)
+    # the integrity meta names the realized layout for external tooling
+    assert read_integrity_meta(ck)["table_layout"] == first
+
+    st2, _, _ = load_checkpoint(ck)
+    cfg2 = Word2VecConfig(table_layout=second, **_kw())
+    st2, _ = Trainer(cfg2, vocab, corpus).train(state=st2, log_every=0)
+    assert params_layout(st2.params) == second
+    _logical_equal(st2.params, full.params)
+
+
+def test_checkpoint_unified_bf16_round_trip(tmp_path):
+    """The npz bfloat16 bit-pattern path (io/checkpoint) must survive the
+    3-D slab shape."""
+    cfg = Word2VecConfig(
+        table_layout="unified", **_kw(dtype="bfloat16")
+    )
+    params = init_params(cfg, 40, jax.random.key(2))
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, TrainState(params=params, step=3), cfg, None)
+    st, cfg2, _ = load_checkpoint(ck)
+    assert cfg2.table_layout == "unified"
+    got = st.params[FUSED_KEY]
+    assert got.dtype == np.dtype(jax.numpy.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(got).view(np.uint16),
+        np.asarray(params[FUSED_KEY]).view(np.uint16),
+    )
+
+
+def test_sharded_import_params_converts_cross_layout(tmp_path):
+    """ShardedTrainer.import_params: a split checkpoint loads into a
+    unified-config mesh (host-side lossless restack) and vice versa."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    vocab, corpus = _toy()
+    split_params = init_params(Word2VecConfig(**_kw()), len(vocab),
+                               jax.random.key(4))
+    cfg_u = Word2VecConfig(table_layout="unified", **_kw())
+    tr = ShardedTrainer(cfg_u, vocab, corpus, dp=2)
+    st = TrainState(params={})
+    tr.import_params(split_params, st)
+    assert params_layout(st.params) == "unified"
+    _logical_equal(tr.export_params(st), split_params)
+
+
+# --------------------------------------- SIGTERM -> resume parity (PR 4 pin)
+@pytest.mark.parametrize("chunk_steps", [1, 0])
+def test_preempt_resume_matches_uninterrupted_unified(tmp_path, chunk_steps):
+    """The PR 4 byte-for-byte preemption pin under the unified layout:
+    stop cooperatively mid-epoch, checkpoint (the slab goes to disk as
+    [V, 2, d]), resume in a fresh trainer — final tables identical to the
+    uninterrupted run."""
+    vocab, corpus = _toy()
+    cfg = Word2VecConfig(
+        table_layout="unified", **_kw(chunk_steps=chunk_steps)
+    )
+    full_state, _ = Trainer(cfg, vocab, corpus).train(log_every=0)
+
+    t = Trainer(cfg, vocab, corpus)
+    t.stop_check = lambda step: step >= 13
+    st, rep = t.train(log_every=0)
+    assert rep.interrupted == "preempted"
+    spe = BatchIterator(
+        corpus, cfg.batch_rows, cfg.max_sentence_len
+    ).steps_per_epoch()
+    assert st.step < cfg.iters * spe  # genuinely stopped early
+    ck = str(tmp_path / "ck")
+    save_checkpoint(ck, st, cfg, vocab)
+
+    st2, ck_cfg, _ = load_checkpoint(ck)
+    assert ck_cfg.table_layout == "unified"
+    st2, rep2 = Trainer(ck_cfg, vocab, corpus).train(state=st2, log_every=0)
+    assert rep2.interrupted is None
+    _logical_equal(st2.params, full_state.params)
+
+
+def test_sharded_preempt_resume_parity_unified(tmp_path):
+    """The sharded-at-sync-boundary case (ISSUE 7 acceptance): preemption
+    landing on a replica-sync boundary, checkpointed as the de-replicated
+    [V, 2, d] slab, resumed through import_params — byte parity with the
+    uninterrupted sharded run."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    vocab, corpus = _toy()
+    cfg = Word2VecConfig(
+        table_layout="unified", **_kw(dp_sync_every=4)
+    )
+    full = ShardedTrainer(cfg, vocab, corpus, dp=2)
+    full_state, _ = full.train(log_every=0)
+    full_params = full.export_params(full_state)
+
+    t = ShardedTrainer(cfg, vocab, corpus, dp=2)
+    t.stop_check = lambda step: step >= 8 and step % 4 == 0  # sync boundary
+    st, rep = t.train(log_every=0)
+    assert rep.interrupted == "preempted"
+    ck = str(tmp_path / "ck")
+    save_checkpoint(
+        ck,
+        TrainState(params=t.export_params(st), step=st.step,
+                   words_done=st.words_done, epoch=st.epoch),
+        cfg, vocab,
+    )
+    assert read_integrity_meta(ck)["table_layout"] == "unified"
+    st2, ck_cfg, _ = load_checkpoint(ck)
+    t2 = ShardedTrainer(ck_cfg, vocab, corpus, dp=2)
+    t2.import_params(st2.params, st2)
+    st2, _ = t2.train(state=st2, log_every=0)
+    _logical_equal(full_params, t2.export_params(st2))
+
+
+# ------------------------------------------------ export: slice-and-stream
+def test_export_matrix_unified_is_a_view_not_a_slab_copy():
+    """The memory-bound regression pin (ISSUE 7 satellite): exporting a
+    logical table from host-side unified params must be a zero-copy VIEW
+    of the slab — never a host materialization of the full [V, 2, d]."""
+    cfg = Word2VecConfig(table_layout="unified", **_kw())
+    slab = np.arange(40 * 2 * 16, dtype=np.float32).reshape(40, 2, 16)
+    params = {FUSED_KEY: slab}
+    for side, plane in [("input", 0), ("output", 1)]:
+        m = export_matrix(params, cfg, side=side)
+        assert m.shape == (40, 16)
+        assert np.shares_memory(m, slab), side  # view, not copy
+        np.testing.assert_array_equal(np.asarray(m), slab[:, plane])
+    # auto mirrors the reference's choice per model/objective
+    assert np.shares_memory(export_matrix(params, cfg, side="auto"), slab)
+
+
+def test_export_matrix_sides_match_split(tmp_path):
+    """Both logical tables round-trip through the text exporter from the
+    slab, identical to the split layout's files."""
+    from word2vec_tpu.io.embeddings import load_embeddings_text, \
+        save_embeddings_text
+
+    vocab, corpus = _toy()
+    s_u = _run("unified", vocab, corpus, iters=1)
+    s_s = _run("split", vocab, corpus, iters=1)
+    cfg_u = Word2VecConfig(table_layout="unified", **_kw())
+    cfg_s = Word2VecConfig(**_kw())
+    for side in ("input", "output", "auto"):
+        pu = str(tmp_path / f"u_{side}.txt")
+        ps = str(tmp_path / f"s_{side}.txt")
+        save_embeddings_text(
+            pu, vocab.words, np.asarray(export_matrix(s_u.params, cfg_u, side))
+        )
+        save_embeddings_text(
+            ps, vocab.words, np.asarray(export_matrix(s_s.params, cfg_s, side))
+        )
+        with open(pu) as fu, open(ps) as fs:
+            assert fu.read() == fs.read(), side
+        words, m = load_embeddings_text(pu)
+        assert m.shape == (len(vocab), 16)
+
+
+def test_binary_export_streams_strided_slab_view(tmp_path):
+    """The binary writer's contiguous f32 conversion is per ROW
+    (io/embeddings module docstring): handed a strided plane of the slab,
+    it writes bytes identical to a contiguous copy's — without a
+    table-sized ascontiguousarray of the input (pinned structurally by
+    the view assertions above; this pins the output contract)."""
+    from word2vec_tpu.io.embeddings import (
+        load_embeddings_binary, save_embeddings_binary,
+    )
+
+    slab = np.arange(30 * 2 * 8, dtype=np.float32).reshape(30, 2, 8)
+    view = slab[:, 1]           # strided [V, d] plane, NOT contiguous
+    assert not view.flags["C_CONTIGUOUS"]
+    words = [f"w{i}" for i in range(30)]
+    p_view = str(tmp_path / "view.bin")
+    p_copy = str(tmp_path / "copy.bin")
+    save_embeddings_binary(p_view, words, view)
+    save_embeddings_binary(p_copy, words, np.ascontiguousarray(view))
+    with open(p_view, "rb") as a, open(p_copy, "rb") as b:
+        assert a.read() == b.read()
+    got_words, m = load_embeddings_binary(p_view)
+    assert got_words == words
+    np.testing.assert_array_equal(m, view)
+
+
+def test_cli_unified_end_to_end_matches_split(tmp_path):
+    """CLI acceptance: --table-layout unified trains, exports, and the
+    saved vectors are byte-identical to the split run's."""
+    from word2vec_tpu.cli import main
+
+    rng = np.random.default_rng(0)
+    toks = []
+    for _ in range(400):
+        toks += ["x", str(rng.choice(["a", "b"])), "y",
+                 "p", str(rng.choice(["c", "d"])), "q"]
+    corpus_file = str(tmp_path / "corpus.txt")
+    with open(corpus_file, "w") as f:
+        f.write(" ".join(toks))
+
+    def run(layout, out):
+        rc = main([
+            "-train", corpus_file, "-output", out, "-size", "16",
+            "-window", "2", "-negative", "3", "-model", "sg",
+            "-train_method", "ns", "-iter", "2", "-min-count", "1",
+            "-subsample", "0", "--backend", "cpu", "--batch-rows", "8",
+            "--max-sentence-len", "32", "--table-layout", layout, "--quiet",
+        ])
+        assert rc == 0
+
+    out_u = str(tmp_path / "vec_u.txt")
+    out_s = str(tmp_path / "vec_s.txt")
+    run("unified", out_u)
+    run("split", out_s)
+    with open(out_u) as fu, open(out_s) as fs:
+        assert fu.read() == fs.read()
+
+
+# ------------------------------------------------------- planner plumbing
+def test_autotune_probe_arbitrates_layouts_end_to_end(tmp_path):
+    """ISSUE 7 acceptance: an --autotune probe on CPU searches a grid that
+    carries both layouts and the Trainer trains with whatever wins; the
+    persisted entry is keyed by the CONFIGURED layout so a unified-config
+    run can never inherit it silently (tune/cache schema 2)."""
+    from word2vec_tpu.tune import cache as plan_cache
+    from word2vec_tpu.tune.planner import (
+        candidate_grid, config_fingerprint, kernel_route, resolve_plan,
+    )
+
+    vocab, corpus = _toy(n_tokens=16000)
+    cfg = Word2VecConfig(**_kw(batch_rows=8, max_sentence_len=32,
+                               chunk_steps=0, iters=1))
+    grid = candidate_grid(cfg, len(vocab), {"platform": "cpu"})
+    assert {p.table_layout for p in grid} == {"split", "unified"}
+
+    cache = str(tmp_path / "plans.json")
+    res = resolve_plan(
+        cfg, vocab, corpus=corpus, mode="probe", cache_path=cache,
+        max_probes=2, probe_steps=1, probe_dispatches=1,
+    )
+    assert all("error" not in p for p in res.probes), res.probes
+    applied = cfg.apply_plan(res.plan)
+    assert applied.table_layout in ("split", "unified")
+
+    with open(cache) as f:
+        keys = list(json.load(f)["plans"])
+    assert len(keys) == 1 and "|split|kp" in keys[0]
+    # a unified-configured lookup misses the split-keyed entry
+    cfg_u = dataclasses.replace(cfg, table_layout="unified")
+    key_u = plan_cache.plan_key(
+        keys[0].split("|")[0], "cpu", kernel_route(cfg_u), len(vocab),
+        cfg_u.word_dim, table_layout="unified",
+        shared_negatives=cfg_u.shared_negatives,
+    )
+    assert plan_cache.lookup(key_u, config_fingerprint(cfg_u), cache) is None
+
+    tr = Trainer(
+        dataclasses.replace(cfg, autotune="cached", plan_cache=cache),
+        vocab, corpus,
+    )
+    assert tr.plan_resolution.source == "cache"
+    state, report = tr.train(log_every=0)
+    assert report.total_words > 0
+    assert params_layout(state.params) == tr.config.table_layout

@@ -132,6 +132,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="unbiased stochastic rounding of table updates "
                         "(bfloat16 tables, ns band route; "
                         "config.stochastic_rounding)")
+    p.add_argument("--table-layout", choices=["split", "unified"],
+                   default="split",
+                   help="how the two ns tables are stored "
+                        "(config.table_layout): split = two [V, d] arrays; "
+                        "unified = one [V, 2, d] slab, scattered ONCE per "
+                        "step at doubled width over the shared sorted "
+                        "token ids (~half the table-update tail; trajectory "
+                        "bitwise identical incl. bf16±SR). ns band kernel "
+                        "only; composes with pallas_oa but not pallas/"
+                        "slab-scatter. Also an --autotune candidate "
+                        "arbitrated per device")
     p.add_argument("--shared-negatives", type=int, default=64,
                    help="shared negative draws per batch row (band kernel)")
     p.add_argument("--negative-scope", choices=["row", "batch"], default="row",
@@ -426,6 +437,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         scatter_mean=bool(args.scatter_mean),
         slab_scatter=bool(args.slab_scatter),
         band_backend=args.band_backend,
+        table_layout=args.table_layout,
         hs_dense_top=args.hs_dense_top,
         hs_tail_slots=args.hs_tail_slots,
         resident=args.resident,

@@ -20,9 +20,12 @@ class TunePlan:
     OBJECTIVE fixed or quality-gated — batch geometry, band chunking, scan
     megastep length, host prefetch depth, the negative-pool scope/width
     (quality holds to KP=8 per PERF.md; 'batch' scope is the promoted
-    quality-positive lever), and the band compute backend. Everything else
-    (window, dim, objective, clip, dtypes) is the PROBLEM, not the plan,
-    and lives in the cache key/fingerprint instead.
+    quality-positive lever), the band compute backend, the table LAYOUT
+    (split vs the unified [V, 2, d] slab — bitwise-identical trajectory,
+    models/params.py), and the table storage dtype ± stochastic rounding
+    (bf16+SR measured margin-neutral, PARITY_MATRIX_r3/QUALITY_FULL_r3).
+    Everything else (window, dim, objective, clip) is the PROBLEM, not the
+    plan, and lives in the cache key/fingerprint instead.
     """
 
     batch_rows: int = 256
@@ -32,6 +35,9 @@ class TunePlan:
     shared_negatives: int = 64
     negative_scope: str = "row"
     band_backend: str = "xla"
+    table_layout: str = "split"      # "split" | "unified" ([V, 2, d] slab)
+    table_dtype: str = "float32"     # table storage dtype (config.dtype)
+    stochastic_rounding: bool = False
 
     def to_json(self) -> Dict:
         return dataclasses.asdict(self)
@@ -280,11 +286,34 @@ class Word2VecConfig:
     # [V, 2, d] array inside each dispatched chunk so the two sorted table
     # scatters (and gathers) become one indexed op each — the scatter cost
     # is per-row machinery, not bytes (PERF.md), so this halves it. Fusion
-    # happens at chunk boundaries (ops/band_step.fuse_tables); params keep
+    # happens at chunk boundaries (models/params.fuse_tables); params keep
     # their public {emb_in, emb_out_ns} layout everywhere else, and the
     # trajectory is bitwise identical (tests/test_fused.py). Incompatible
-    # with slab_scatter (different index set per table).
+    # with slab_scatter (different index set per table) and redundant under
+    # table_layout="unified" (the slab is already stored fused).
     fused_tables: bool = False
+
+    # How the two ns tables are STORED (models/params.py):
+    #   "split"   — two [V, d] arrays {emb_in, emb_out_ns} (historical
+    #               layout; the fused_tables flag can still restack them
+    #               transiently inside chunks).
+    #   "unified" — one [V, 2, d] slab, persistently: init, every kernel
+    #               dispatch granularity (per-step AND chunked), checkpoint,
+    #               mesh PartitionSpecs, and export all carry the slab, and
+    #               the step's one shared sorted token-id set is scattered
+    #               ONCE at doubled width (the sorted scatters are
+    #               row-machinery-bound, ~21 ns/row regardless of width —
+    #               PERF.md — so this halves the table-update tail, ~1 ms of
+    #               the ~8 ms flagship step). Trajectory is bitwise identical
+    #               to split in every dtype, including bf16 ± SR (per-plane
+    #               SR streams match the split step's; tests/test_unified.py).
+    #               ns band kernel only; composes with band_backend
+    #               "pallas_oa" but not "pallas" (the fully-fused kernel
+    #               gathers the two tables separately) nor slab_scatter
+    #               (different index set per table). A planner candidate:
+    #               the autotuner arbitrates split-vs-unified per device via
+    #               the cost model's per-layout scatter term (tune/).
+    table_layout: str = "split"
 
     # --- telemetry (obs/) ---
     # Full on-device health counters (obs/health.instrument_step): global
@@ -421,6 +450,38 @@ class Word2VecConfig:
                 raise ValueError(
                     "fused_tables applies to the ns band kernel only"
                 )
+        if self.table_layout not in ("split", "unified"):
+            raise ValueError(
+                f"table_layout must be 'split' or 'unified', "
+                f"got {self.table_layout!r}"
+            )
+        if self.table_layout == "unified":
+            if self.train_method == "hs" or self.kernel == "pair":
+                raise ValueError(
+                    "table_layout='unified' applies to the ns band kernel "
+                    "only (the [V, 2, d] slab holds {emb_in, emb_out_ns}; "
+                    "hs and kernel='pair' route elsewhere — "
+                    "models/params.py, ops/hs_step.py)"
+                )
+            if self.slab_scatter:
+                raise ValueError(
+                    "table_layout='unified' and slab_scatter are "
+                    "incompatible (the slab context scatter uses a "
+                    "different index set per table; see ops/band_step.py)"
+                )
+            if self.band_backend == "pallas":
+                raise ValueError(
+                    "table_layout='unified' is incompatible with "
+                    "band_backend='pallas' (the fully-fused kernel gathers "
+                    "the two tables separately; 'pallas_oa' composes — "
+                    "ops/pallas_band.py scope note)"
+                )
+            if self.fused_tables:
+                raise ValueError(
+                    "fused_tables is redundant under table_layout='unified' "
+                    "(the slab is stored fused; the chunk-boundary restack "
+                    "has nothing to fuse) — drop one of the two flags"
+                )
         if self.resident not in ("auto", "on", "off"):
             raise ValueError(
                 f"resident must be auto|on|off, got {self.resident!r}"
@@ -487,6 +548,9 @@ class Word2VecConfig:
             shared_negatives=plan.shared_negatives,
             negative_scope=plan.negative_scope,
             band_backend=plan.band_backend,
+            table_layout=plan.table_layout,
+            dtype=plan.table_dtype,
+            stochastic_rounding=plan.stochastic_rounding,
             micro_steps=micro,
             autotune="off",
         )
@@ -502,6 +566,9 @@ class Word2VecConfig:
             shared_negatives=self.shared_negatives,
             negative_scope=self.negative_scope,
             band_backend=self.band_backend,
+            table_layout=self.table_layout,
+            table_dtype=self.dtype,
+            stochastic_rounding=self.stochastic_rounding,
         )
 
     @property

@@ -239,9 +239,16 @@ def _save_once(path: str, state: TrainState, config: Word2VecConfig,
             json.dump(dataclasses.asdict(config), f, indent=2)
         if vocab is not None:
             vocab.save(os.path.join(tmp, "vocab.txt"))
-        meta = (
-            {"vocab_hash": vocab.content_hash()} if vocab is not None else None
-        )
+        from ..models.params import params_layout
+
+        # the realized table layout (split [V, d] pair vs unified [V, 2, d]
+        # slab, models/params.py) rides in the meta so external tooling can
+        # tell what the state.npz rows MEAN without parsing it; loaders
+        # convert cross-layout losslessly (convert_params_layout) or fail
+        # loudly naming both layouts
+        meta = {"table_layout": params_layout(state.params)}
+        if vocab is not None:
+            meta["vocab_hash"] = vocab.content_hash()
         # written last: its presence certifies a complete write; the meta
         # block carries the vocab fingerprint for the --resume corpus guard
         write_integrity(tmp, meta=meta)

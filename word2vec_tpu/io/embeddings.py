@@ -17,6 +17,14 @@ header is ASCII); both are supported via `layout=`.
 
 Rows are written in vocab-index order (the reference iterates `vocab` which is
 index-sorted, :417,:432).
+
+Slice-and-stream contract (unified table layout, models/params.py): the
+matrix argument may be a STRIDED VIEW — e.g. one plane of the host-side
+[V, 2, d] slab (`export_matrix` returns exactly that) — and both writers
+stream it row by row without materializing a table-sized contiguous copy:
+the text writer formats elementwise, the binary writer makes its
+contiguous f32 conversion PER ROW (d*4 bytes at a time). Pinned by the
+memory-bound regression test in tests/test_unified.py.
 """
 
 from __future__ import annotations
@@ -101,8 +109,12 @@ def load_embeddings_text(path: str) -> Tuple[List[str], np.ndarray]:
 def save_embeddings_binary(
     path: str, words: Sequence[str], matrix: np.ndarray, layout: str = "reference"
 ) -> None:
-    """Binary save. layout='reference' (Word2Vec.cpp:402-425) or 'google'."""
-    m = np.ascontiguousarray(matrix, dtype=np.float32)
+    """Binary save. layout='reference' (Word2Vec.cpp:402-425) or 'google'.
+
+    The f32-contiguous conversion happens per ROW (module docstring): a
+    strided view of the unified [V, 2, d] slab streams through d*4-byte
+    row buffers instead of one table-sized ascontiguousarray copy."""
+    m = np.asarray(matrix)
     if len(words) != m.shape[0]:
         raise ValueError(f"{len(words)} words vs {m.shape[0]} rows")
     with open(path, "wb") as f:
@@ -115,6 +127,7 @@ def save_embeddings_binary(
         else:
             raise ValueError(f"unknown layout {layout!r}")
         for w, row in zip(words, m):
+            row = np.ascontiguousarray(row, dtype=np.float32)
             f.write(w.encode("utf-8") + b" " + row.tobytes() + b"\n")
 
 

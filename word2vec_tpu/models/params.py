@@ -20,9 +20,22 @@ matrix C at all (the SURVEY §2 latent bug: Word2Vec.cpp:208-209 vs :300), and
 a zero-init input with a zero-init hs output can never leave the origin; here
 cbow+hs gives emb_in the uniform init so training is live.
 
+Table layouts (config.table_layout): the two ns tables can be STORED either
+as two separate [V, d] arrays ("split", the historical layout) or as one
+[V, 2, d] slab under FUSED_KEY ("unified") whose planes are FUSED_SUBTABLES
+in order. The unified layout lets every band step gather and scatter both
+tables' rows in ONE indexed op each — the sorted table scatters are
+row-machinery-bound (~21 ns/row regardless of width, PERF.md), so one
+[N, 2, d] scatter costs about half of two [N, d] scatters. The layout is
+part of the parameter identity end to end (init, checkpoint, mesh specs,
+export); `params_layout`/`convert_params_layout` translate losslessly
+between the two, and `logical_table` reads a public table from either.
+
 Export selection (`export_matrix`) mirrors main.cpp:196-202: hs+cbow saves C
 (= emb_in here); everything else saves W (= emb_in for sg, emb_out_ns for
-cbow+ns).
+cbow+ns). Under the unified layout the returned matrix is a PLANE of the
+slab — a [V, d] slice (a zero-copy view for host arrays), never a full
+[V, 2, d] host materialization.
 """
 
 from __future__ import annotations
@@ -35,6 +48,86 @@ import jax.numpy as jnp
 from ..config import Word2VecConfig
 
 Params = Dict[str, jnp.ndarray]
+
+FUSED_KEY = "emb_ns_fused"
+#: stack-axis order of the public tables inside the fused [V, 2, d] array;
+#: obs/health reports per-table update stats under these names whether the
+#: slab comes from the unified layout or a chunk runner's fused_tables
+#: restack, so telemetry keys are stable across layouts
+FUSED_SUBTABLES = ("emb_in", "emb_out_ns")
+
+
+def fuse_tables(params: Params) -> Params:
+    """{emb_in [V,d], emb_out_ns [V,d]} -> {emb_ns_fused [V,2,d]} (other keys
+    pass through). The stack axis is -2 so replicated mesh params
+    ([R, V, d] -> [R, V, 2, d]) restack the same way. Used persistently by
+    table_layout="unified" and transiently (at chunk boundaries,
+    ops/train_step.make_chunk_runner) by config.fused_tables."""
+    p = dict(params)
+    p[FUSED_KEY] = jnp.stack(
+        [p.pop("emb_in"), p.pop("emb_out_ns")], axis=-2
+    )
+    return p
+
+
+def unfuse_tables(params: Params) -> Params:
+    p = dict(params)
+    f = p.pop(FUSED_KEY)
+    p["emb_in"] = f[..., 0, :]
+    p["emb_out_ns"] = f[..., 1, :]
+    return p
+
+
+def params_layout(params: Params) -> str:
+    """The table layout these params realize: "unified" iff the fused slab
+    key is present (config.table_layout's vocabulary)."""
+    return "unified" if FUSED_KEY in params else "split"
+
+
+def convert_params_layout(params: Params, target: str) -> Params:
+    """Losslessly restack params into `target` layout ("split"|"unified").
+
+    The conversion is exact in any dtype (a stack/unstack moves values, it
+    never rounds), so a split-layout checkpoint resumes into a unified-layout
+    run — and vice versa — with a bitwise-unchanged trajectory. Params that
+    cannot represent the target (hs/pair runs have no {emb_in, emb_out_ns}
+    pair to fuse) raise a ValueError naming both layouts instead of
+    silently misreading rows.
+    """
+    if target not in ("split", "unified"):
+        raise ValueError(f"unknown table layout {target!r}")
+    src = params_layout(params)
+    if src == target:
+        return dict(params)
+    if target == "unified":
+        missing = [k for k in FUSED_SUBTABLES if k not in params]
+        if missing:
+            raise ValueError(
+                f"cannot convert split-layout params to the unified table "
+                f"layout: missing {missing} (present: {sorted(params)}). "
+                f"The unified [V, 2, d] slab holds exactly {FUSED_SUBTABLES} "
+                "— hs/pair parameter sets have no unified form"
+            )
+        return fuse_tables(params)
+    return unfuse_tables(params)
+
+
+def logical_table(params: Params, name: str) -> jnp.ndarray:
+    """The public [V, d] table `name` from either layout.
+
+    Unified params return a PLANE of the slab: for host (numpy) arrays
+    that is a zero-copy view, and for device arrays a [V, d] slice — the
+    full [V, 2, d] slab is never materialized host-side on the export
+    paths (io/embeddings slice-and-stream contract, tests/test_unified.py).
+    """
+    if name in params:
+        return params[name]
+    if FUSED_KEY in params and name in FUSED_SUBTABLES:
+        return params[FUSED_KEY][..., FUSED_SUBTABLES.index(name), :]
+    raise KeyError(
+        f"params ({params_layout(params)} layout, keys {sorted(params)}) "
+        f"hold no table {name!r}"
+    )
 
 
 def init_params(config: Word2VecConfig, vocab_size: int, key: jax.Array) -> Params:
@@ -59,6 +152,10 @@ def init_params(config: Word2VecConfig, vocab_size: int, key: jax.Array) -> Para
             params["emb_in"] = uniform
     if config.use_hs:
         params["emb_out_hs"] = jnp.zeros((vocab_size - 1, d), dtype)  # synapses1, :207
+    if getattr(config, "table_layout", "split") == "unified":
+        # same values, stacked at init: the unified trajectory is bitwise
+        # the split trajectory (tests/test_unified.py)
+        params = fuse_tables(params)
     return params
 
 
@@ -78,22 +175,26 @@ def export_matrix(
     emb_in; gensim's `wv`), "output" = the ns prediction-side table
     (emb_out_ns; gensim's `syn1neg`). "output" requires ns: the hs
     output table holds V-1 Huffman INTERNAL NODES, not word rows, so
-    exporting it as word vectors would be meaningless."""
+    exporting it as word vectors would be meaningless.
+
+    Both layouts are served: unified params yield the requested plane of
+    the [V, 2, d] slab (logical_table), so exporters stream one [V, d]
+    table without a host-side copy of the whole slab."""
     if side == "input":
-        return params["emb_in"]
+        return logical_table(params, "emb_in")
     if side == "output":
         if not config.use_ns:
             raise ValueError(
                 "export side='output' requires negative sampling: the hs "
                 "output table rows are Huffman internal nodes, not words"
             )
-        return params["emb_out_ns"]
+        return logical_table(params, "emb_out_ns")
     if side != "auto":
         raise ValueError(
             f"export side must be auto, input or output, got {side!r}"
         )
     if config.model == "cbow" and config.use_hs:
-        return params["emb_in"]  # C, main.cpp:198-199
+        return logical_table(params, "emb_in")  # C, main.cpp:198-199
     if config.model == "cbow" and config.use_ns:
-        return params["emb_out_ns"]  # W, main.cpp:201
-    return params["emb_in"]  # W for sg, main.cpp:201
+        return logical_table(params, "emb_out_ns")  # W, main.cpp:201
+    return logical_table(params, "emb_in")  # W for sg, main.cpp:201

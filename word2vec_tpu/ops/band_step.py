@@ -120,33 +120,13 @@ def _halo_exchange(tok: jnp.ndarray, w: int, axis: str) -> jnp.ndarray:
     return jnp.concatenate([left, tok, right], axis=1)
 
 
-FUSED_KEY = "emb_ns_fused"
-#: stack-axis order of the public tables inside the fused [V, 2, d] array;
-#: obs/health reports per-table update stats under these names whether or
-#: not a chunk runner has the tables fused, so telemetry keys are stable
-#: across fused_tables configurations
-FUSED_SUBTABLES = ("emb_in", "emb_out_ns")
-
-
-def fuse_tables(params: Params) -> Params:
-    """{emb_in [V,d], emb_out_ns [V,d]} -> {emb_ns_fused [V,2,d]} (other keys
-    pass through). The fused layout lets the band step gather and scatter
-    both tables' rows in ONE indexed op each — the sorted table scatters are
-    row-machinery-bound (~21 ns/row regardless of width, PERF.md), so one
-    [N, 2, d] scatter costs about half of two [N, d] scatters. Applied at
-    chunk boundaries (make_chunk_runner), so the [V, 2, d] restack amortizes
-    over S steps and params keep their public layout everywhere else."""
-    p = dict(params)
-    p[FUSED_KEY] = jnp.stack([p.pop("emb_in"), p.pop("emb_out_ns")], axis=1)
-    return p
-
-
-def unfuse_tables(params: Params) -> Params:
-    p = dict(params)
-    f = p.pop(FUSED_KEY)
-    p["emb_in"] = f[:, 0]
-    p["emb_out_ns"] = f[:, 1]
-    return p
+# The fused [V, 2, d] layout machinery lives with the parameter layout
+# itself (models/params.py) since table_layout="unified" made it a
+# persistent storage format, not just a chunk-scoped restack; re-exported
+# here for the existing importers (obs/health, tests).
+from ..models.params import (  # noqa: F401  (re-exports)
+    FUSED_KEY, FUSED_SUBTABLES, fuse_tables, unfuse_tables,
+)
 
 
 def make_band_train_step(
@@ -162,9 +142,11 @@ def make_band_train_step(
     Same contract as train_step.make_train_step; negative sampling only.
     With sp_axis, tokens is this shard's [B, Lloc] position slice of a longer
     row (see module docstring). With fused=True, params carry the two tables
-    as one [V, 2, d] array under FUSED_KEY (fuse_tables above) and the
-    update runs as a single fused scatter; bitwise-identical trajectory
-    (tests/test_fused.py).
+    as one [V, 2, d] array under FUSED_KEY (models/params.fuse_tables —
+    either the chunk runners' transient restack, config.fused_tables, or the
+    persistent unified layout, config.table_layout) and the update runs as a
+    single fused scatter; bitwise-identical trajectory in every dtype incl.
+    bf16 ± SR (tests/test_fused.py, tests/test_unified.py).
     """
     if not config.use_ns or config.use_hs:
         raise ValueError(
@@ -509,24 +491,35 @@ def make_band_train_step(
         new_params = dict(params)
         if fused:
             # one [N, 2, d] scatter covers both tables (same sorted ids);
-            # negative rows land on the out plane of the fused array
-            vals2 = jnp.stack([d_in_flat, d_out_flat], axis=1)
-            # SR quantizes each delta to the destination row's ulp grid, so
-            # the dest rows are re-gathered at the scatter indices (sr only)
-            new_emb = emb.at[sorted_idx].add(
-                _cast_update(
-                    vals2, emb.dtype, k_sr(0),
-                    emb[sorted_idx] if sr else None,
-                ),
-                indices_are_sorted=True,
+            # negative rows land on the out plane of the fused array.
+            # SR quantizes each delta to the destination row's ulp grid
+            # (dest rows re-gathered at the scatter indices, sr only) —
+            # PER PLANE, with the same stream indices as the split step
+            # (0=in, 1=out): the fused draws are then bit-identical to the
+            # split layout's, which is what makes unified-vs-split bitwise
+            # under bf16+SR too (tests/test_unified.py), not just in f32.
+            vals2 = jnp.stack(
+                [
+                    _cast_update(
+                        d_in_flat, emb.dtype, k_sr(0),
+                        emb[sorted_idx, 0] if sr else None,
+                    ),
+                    _cast_update(
+                        d_out_flat, emb.dtype, k_sr(1),
+                        emb[sorted_idx, 1] if sr else None,
+                    ),
+                ],
+                axis=1,
             )
+            new_emb = emb.at[sorted_idx].add(vals2, indices_are_sorted=True)
             # SR dest rows come from NEW_emb: the positive scatter above may
             # have moved a shared row across a binade, and quantizing on the
             # stale pre-step grid would let the bf16 add re-round (or
-            # swallow) the delta
+            # swallow) the delta. Stream 2 = the split step's negative-row
+            # stream (same parity contract as the planes above).
             new_emb = new_emb.at[flat_negs, 1].add(
                 _cast_update(
-                    d_neg_flat, emb.dtype, k_sr(1),
+                    d_neg_flat, emb.dtype, k_sr(2),
                     new_emb[flat_negs, 1] if sr else None,
                 )
             )

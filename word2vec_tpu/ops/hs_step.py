@@ -140,6 +140,15 @@ def make_hs_train_step(
     """
     if not config.use_hs or config.use_ns:
         raise ValueError("hs kernel supports hierarchical softmax only")
+    if getattr(config, "table_layout", "split") == "unified":
+        # defense in depth (config validation rejects this combination up
+        # front): the unified [V, 2, d] slab holds {emb_in, emb_out_ns};
+        # hs params are {emb_in, emb_out_hs} and emb_out_hs has V-1 rows —
+        # there is no unified form to dispatch on
+        raise ValueError(
+            "table_layout='unified' applies to the ns band kernel only; "
+            "hs params have no [V, 2, d] form (models/params.py)"
+        )
     W = config.window
     is_cbow = config.model == "cbow"
     cbow_mean = config.cbow_mean

@@ -165,7 +165,7 @@ def make_resident_chunk_runner(
 
     def chunk(params, corpus, order, base_key, step0, epoch_t0, alphas):
         if fused:
-            from .band_step import fuse_tables, unfuse_tables
+            from ..models.params import fuse_tables, unfuse_tables
 
             params = fuse_tables(params)
 

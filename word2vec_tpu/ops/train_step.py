@@ -311,6 +311,12 @@ def _make_base_step(
     sp_axis: str | None = None,
     fused: bool = False,
 ):
+    # table_layout="unified": params persistently carry the [V, 2, d] slab
+    # (models/params.py), so EVERY dispatch granularity takes the fused band
+    # step — per-step included, since there is no chunk-boundary restack to
+    # amortize. config validation pins unified to the ns band kernel, so the
+    # hs/pair guards below stay unreachable for it.
+    fused = fused or config.table_layout == "unified"
     if config.resolved_kernel == "band":
         if config.use_hs:
             if fused:
@@ -640,15 +646,17 @@ def make_chunk_runner(
     an epoch is padded to the compiled shape without a second XLA program.
 
     With config.fused_tables the ns tables are restacked to [V, 2, d] for
-    the chunk's lifetime (band_step.fuse_tables) — the restack amortizes
+    the chunk's lifetime (models/params.fuse_tables) — the restack amortizes
     over the S steps, and the public params layout is untouched outside.
+    (table_layout="unified" needs no restack: the params ARE the slab, and
+    make_train_step routes to the fused step by itself.)
     """
     fused = config.fused_tables
     step = make_train_step(config, tables, tp_axis, dp_axis, sp_axis, fused)
 
     def chunk(params, tokens, base_key, step0, alphas):
         if fused:
-            from .band_step import fuse_tables, unfuse_tables
+            from ..models.params import fuse_tables, unfuse_tables
 
             params = fuse_tables(params)
 

@@ -49,7 +49,11 @@ from ..train import Trainer, TrainState
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh
 
 # params replicate over data AND seq shards (both train independent replicas
-# between syncs); the leading replica axis is sharded over the two jointly
+# between syncs); the leading replica axis is sharded over the two jointly.
+# PARAM_SPEC is the split-layout [R, V, d] spec; param_spec(v) derives the
+# rank-matched spec for ANY table rank — the unified layout's [R, V, 2, d]
+# slab (config.table_layout, models/params.py) keeps its extra table axis
+# unsharded between the replica and dim axes.
 PARAM_SPEC = P((DATA_AXIS, SEQ_AXIS), None, MODEL_AXIS)
 # tokens: rows over data shards, row positions over seq shards (band kernel
 # halo-exchanges the window-crossing edges, ops/band_step._halo_exchange)
@@ -57,19 +61,34 @@ TOKEN_SPEC = P(DATA_AXIS, SEQ_AXIS)
 REPLICA_AXES = (DATA_AXIS, SEQ_AXIS)
 
 
+def param_spec(v) -> P:
+    """PartitionSpec for one REPLICATED table array: leading replica axis
+    over (data, seq), trailing embedding-dim axis over model, every middle
+    axis (vocab; the unified layout's 2-wide table axis) unsharded. Rank-
+    derived so split [R, V, d] and unified [R, V, 2, d] both resolve —
+    works on concrete arrays and on tracers (only .ndim is read)."""
+    return P((DATA_AXIS, SEQ_AXIS), *([None] * (v.ndim - 2)), MODEL_AXIS)
+
+
+def param_specs(params: Params) -> dict:
+    return {k: param_spec(v) for k, v in params.items()}
+
+
 def replicate_params(params: Params, mesh: Mesh) -> Params:
-    """[V, d] -> [DP*SP, V, d] identical replicas, sharded over the mesh.
+    """[V, ...] -> [DP*SP, V, ...] identical replicas, sharded over the mesh.
 
     The replicated view is built host-side with np.broadcast_to (zero-copy);
     device_put then places only each shard's slice, so no single device ever
     materializes the full replicated array.
     """
     reps = mesh.shape[DATA_AXIS] * mesh.shape[SEQ_AXIS]
-    sharding = NamedSharding(mesh, PARAM_SPEC)
-    return {
-        k: jax.device_put(np.broadcast_to(np.asarray(v), (reps, *v.shape)), sharding)
-        for k, v in params.items()
-    }
+    out = {}
+    for k, v in params.items():
+        rep = np.broadcast_to(np.asarray(v), (reps, *v.shape))
+        out[k] = jax.device_put(
+            rep, NamedSharding(mesh, param_spec(rep))
+        )
+    return out
 
 
 def unreplicate_params(params: Params) -> Params:
@@ -78,7 +97,7 @@ def unreplicate_params(params: Params) -> Params:
 
 
 def assemble_local_replica(v: jax.Array) -> np.ndarray:
-    """One full [V, d] table from this process's addressable shards.
+    """One full [V, ...] table from this process's addressable shards.
 
     After a sync every replica (leading axis) is identical, so any one will
     do — but in multi-host mode replica 0 may live on another host, and the
@@ -86,15 +105,17 @@ def assemble_local_replica(v: jax.Array) -> np.ndarray:
     mesh keeps the model axis inside a slice (parallel/multihost.py), so
     every process holds at least one complete replica's worth of dim shards.
     Works identically (and is tested) on a single-process virtual mesh.
+    The dim axis is the LAST axis for both table layouts (split [R, V, d],
+    unified [R, V, 2, d] — param_spec), so shards key on index[-1].
     """
     shards = v.addressable_shards
     rep = shards[0].index[0]  # leading-axis slice of some locally-held replica
     parts = {}
     for s in shards:
         if s.index[0] == rep:
-            d0 = s.index[2].start or 0
+            d0 = s.index[-1].start or 0
             parts[d0] = np.asarray(s.data)[0]
-    return np.concatenate([parts[k] for k in sorted(parts)], axis=1)
+    return np.concatenate([parts[k] for k in sorted(parts)], axis=-1)
 
 
 def _reject_pallas(config: Word2VecConfig) -> None:
@@ -145,7 +166,7 @@ def make_sharded_step(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh):
         return {k: v[None] for k, v in new_p.items()}, metrics
 
     def stepfn(params, tokens, key, alpha):
-        specs = {k: PARAM_SPEC for k in params}
+        specs = param_specs(params)
         return shard_map(
             local_step,
             mesh=mesh,
@@ -189,7 +210,7 @@ def make_sharded_chunk(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh)
         if fused:
             # per-shard restack: with tp the stacked [V, 2, d/TP] keeps the
             # dim sharding (stack axis 1 is local); amortizes over the chunk
-            from ..ops.band_step import fuse_tables, unfuse_tables
+            from ..models.params import fuse_tables, unfuse_tables
 
             p = fuse_tables(p)
 
@@ -211,7 +232,7 @@ def make_sharded_chunk(config: Word2VecConfig, tables: DeviceTables, mesh: Mesh)
         return ({k: v[None] for k, v in p.items()}, metrics)
 
     def chunkfn(params, tokens, base_key, step0, alphas):
-        specs = {k: PARAM_SPEC for k in params}
+        specs = param_specs(params)
         return shard_map(
             local_chunk,
             mesh=mesh,
@@ -260,7 +281,7 @@ def make_sharded_resident_chunk(
     def local_chunk(params, corpus, order, base_key, step0, epoch_t0, alphas):
         p = {k: v[0] for k, v in params.items()}
         if fused:
-            from ..ops.band_step import fuse_tables, unfuse_tables
+            from ..models.params import fuse_tables, unfuse_tables
 
             p = fuse_tables(p)
         dpi = jax.lax.axis_index(DATA_AXIS)
@@ -287,7 +308,7 @@ def make_sharded_resident_chunk(
         return ({k: v[None] for k, v in p.items()}, metrics)
 
     def chunkfn(params, corpus, order, base_key, step0, epoch_t0, alphas):
-        specs = {k: PARAM_SPEC for k in params}
+        specs = param_specs(params)
         corpus_specs = {k: P() for k in corpus}
         return shard_map(
             local_chunk,
@@ -304,7 +325,7 @@ def make_sync(mesh: Mesh):
     all-reduce)."""
 
     def syncfn(params):
-        specs = {k: PARAM_SPEC for k in params}
+        specs = param_specs(params)
 
         def local(p):
             return {k: jax.lax.pmean(v, REPLICA_AXES) for k, v in p.items()}
@@ -332,7 +353,7 @@ def make_delta_sync(mesh: Mesh):
     """
 
     def syncfn(params, base):
-        specs = {k: PARAM_SPEC for k in params}
+        specs = param_specs(params)
 
         def local(p, b):
             out = {}
@@ -758,10 +779,19 @@ class ShardedTrainer(Trainer):
         }
 
     def import_params(self, params: Params, state: TrainState) -> None:
-        """Load unreplicated [V, d] tables (e.g. from a checkpoint) into the
-        sharded layout."""
+        """Load unreplicated host tables (e.g. from a checkpoint) into the
+        sharded layout. A checkpoint in the OTHER table layout (split
+        [V, d] pair vs unified [V, 2, d] slab) is converted losslessly
+        host-side first — or fails loudly naming both layouts
+        (models/params.convert_params_layout)."""
+        from ..models.params import convert_params_layout
+
+        host = convert_params_layout(
+            {k: np.asarray(v) for k, v in params.items()},
+            self.config.table_layout,
+        )
         state.params = replicate_params(
-            {k: np.asarray(v) for k, v in params.items()}, self.mesh
+            {k: np.asarray(v) for k, v in host.items()}, self.mesh
         )
         self._reset_sync_base(state.params)
         self._last_sync_step = state.step

@@ -334,6 +334,26 @@ class Trainer:
         })
         return 0
 
+    def _coerce_param_layout(self, params: Params) -> Params:
+        """Externally-supplied params in the OTHER table layout (a split
+        checkpoint handed to a unified-config trainer, or vice versa) are
+        restacked losslessly — or the conversion fails loudly naming both
+        layouts (models/params.convert_params_layout; the restack moves
+        values without rounding, so the continued trajectory is bitwise the
+        same-layout run's, tests/test_unified.py). The CLI resume path
+        never converts: the checkpoint's config is authoritative there, so
+        config and params always agree on layout."""
+        from .models.params import convert_params_layout, params_layout
+
+        target = self.config.table_layout
+        src = params_layout(params)
+        if src == target:
+            return params
+        self._log(
+            {"event": "param_layout_convert", "from": src, "to": target}
+        )
+        return convert_params_layout(params, target)
+
     def _post_step(self, state: TrainState) -> None:
         """Called after every optimizer step (sharded: periodic sync)."""
 
@@ -425,6 +445,10 @@ class Trainer:
             state.params = {
                 k: jnp.asarray(v).copy() for k, v in state.params.items()
             }
+            # cross-layout hand-off (split checkpoint into a unified-config
+            # run, or vice versa): convert losslessly, or fail loudly naming
+            # both layouts (models/params.convert_params_layout)
+            state.params = self._coerce_param_layout(state.params)
             jax.block_until_ready(state.params)
         state = state or self.init_state()
         # the abort paths' checkpoint-where-safe source (class attr note)

@@ -3,13 +3,19 @@
 One file, one JSON object: {schema, plans: {key: entry}}. Keys are the
 planner's identity tuple
 
-    (device_kind, backend, kernel_route, vocab_size, word_dim)
+    (device_kind, backend, kernel_route, vocab_size, word_dim,
+     table_layout, shared_negatives)
 
 rendered as a string (plan_key) — the dimensions along which a tuned step
 shape transfers: the chip generation, where the program runs (cpu/tpu), which
-kernel family realizes the objective, and the two sizes that set every
-matmul/scatter shape. Anything else that could invalidate a plan (window,
-sentence length, dtypes, micro-step block, model/objective) goes into the
+kernel family realizes the objective, the two sizes that set every
+matmul/scatter shape, and the CONFIGURED table layout + negative-pool width.
+The last two are plan dimensions the grid also searches, but they belong in
+the key as the search's STARTING POINT: before schema 2 a plan probed under
+the split layout could be served to a run configured unified (and a KP=8
+quality run could silently inherit a KP=64 plan) because the key could not
+tell the two problems apart. Anything else that could invalidate a plan
+(window, sentence length, micro-step block, model/objective) goes into the
 entry's FINGERPRINT: a lookup whose fingerprint disagrees is a miss, so a
 stale plan can never be silently applied to a different problem.
 
@@ -35,7 +41,9 @@ import tempfile
 import time
 from typing import Dict, Optional
 
-SCHEMA = 1
+# 2: plan_key gained (table_layout, shared_negatives); fingerprints dropped
+#    dtype/stochastic_rounding (now TunePlan dimensions the grid searches)
+SCHEMA = 2
 
 _SEED_PATH = os.path.join(os.path.dirname(__file__), "seed_plans.json")
 
@@ -51,19 +59,31 @@ def default_cache_path() -> str:
 
 def plan_key(
     device_kind: str, backend: str, kernel_route: str, vocab_size: int,
-    dim: int,
+    dim: int, table_layout: str, shared_negatives: int,
 ) -> str:
-    """The cache key: (device_kind, backend, kernel, vocab_size, dim).
+    """The cache key: (device_kind, backend, kernel, vocab_size, dim,
+    table_layout, shared_negatives).
 
     vocab_size is bucketed to 2 significant figures — step shapes do not
     change between a 71,290- and a 71,000-word vocabulary, and an exact
     count would make every corpus re-probe.
+
+    table_layout and shared_negatives are the CONFIGURED values (the
+    problem identity), deliberately required arguments: a default would
+    re-open the schema-1 bug where a cached split-layout plan was silently
+    applied to a unified-layout run (or a pinned-KP quality run inherited
+    another width's plan). The plan stored under the key may still realize
+    a different layout/width — that is the planner's arbitration, recorded
+    in the entry, not an identity mismatch.
     """
     v = int(vocab_size)
     if v >= 100:
         mag = 10 ** (len(str(v)) - 2)
         v = (v // mag) * mag
-    return f"{device_kind or 'unknown'}|{backend}|{kernel_route}|V{v}|d{dim}"
+    return (
+        f"{device_kind or 'unknown'}|{backend}|{kernel_route}|V{v}|d{dim}"
+        f"|{table_layout}|kp{int(shared_negatives)}"
+    )
 
 
 def _read(path: str) -> Dict:

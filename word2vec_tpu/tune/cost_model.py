@@ -60,6 +60,17 @@ CPU_SPEC: Tuple[float, float, float] = (15e9, 2e10, 0.3)
 # (3 x [B, C, S+2W, d] f32 = 236 MB) would stream in ~0.29 ms at 0.82 TB/s.
 LAYOUT_COPY_INEFFICIENCY = 7.4
 
+# Second calibration anchor (same r2 trace): the sorted table scatters run
+# at ~21 ns/ROW regardless of row width — row machinery, not bytes ("Why
+# not a Pallas scatter kernel", PERF.md: 2.08 ms for the two 49,152-row
+# table scatters + 0.41 ms for the 16,384 negative rows ≈ 21 ns/row). This
+# is the term the table LAYOUT moves (utils/profiling.step_hbm_bytes
+# scatter_rows): the unified [V, 2, d] slab halves the token-id scatter
+# count, predicting ~1.0 ms off the ~8 ms flagship step — which is exactly
+# what lets the planner arbitrate split-vs-unified per device
+# (tests/test_tune.py counterfactual-flip pin).
+SCATTER_SEC_PER_ROW = 21e-9
+
 
 def device_spec(
     device_kind: str, platform: str
@@ -77,7 +88,9 @@ class CostEstimate:
     flops: float
     hbm_bytes: float
     copy_bytes: float
-    step_ms: float       # compute + traffic + layout copies, per step
+    scatter_rows: float  # rows fed to table scatter-adds (a count)
+    scatter_ms: float    # scatter_rows * SCATTER_SEC_PER_ROW (per-layout)
+    step_ms: float       # compute + traffic + copies + scatter rows, per step
     dispatch_ms: float   # per-step share of dispatch overhead
     total_ms: float
 
@@ -86,6 +99,8 @@ class CostEstimate:
             "flops": self.flops,
             "hbm_bytes": self.hbm_bytes,
             "copy_bytes": self.copy_bytes,
+            "scatter_rows": self.scatter_rows,
+            "scatter_ms": round(self.scatter_ms, 4),
             "step_ms": round(self.step_ms, 4),
             "dispatch_ms": round(self.dispatch_ms, 4),
             "total_ms": round(self.total_ms, 4),
@@ -94,6 +109,12 @@ class CostEstimate:
 
 def layout_copy_ms(copy_bytes: float, hbm_bw: float) -> float:
     return 1e3 * copy_bytes * LAYOUT_COPY_INEFFICIENCY / hbm_bw
+
+
+def table_scatter_ms(scatter_rows: float) -> float:
+    """The per-layout scatter term: row machinery the byte roofline cannot
+    see (~21 ns/row regardless of width — SCATTER_SEC_PER_ROW anchor)."""
+    return 1e3 * scatter_rows * SCATTER_SEC_PER_ROW
 
 
 def predict(
@@ -112,8 +133,12 @@ def predict(
     flops = step_flops(config, vocab_size)
     traffic = step_hbm_bytes(config, vocab_size)
     streamed = traffic["total"] - traffic["layout_copies"]
-    step_ms = 1e3 * max(flops / peak, streamed / bw) + layout_copy_ms(
-        traffic["layout_copies"], bw
+    scatter_rows = traffic.get("scatter_rows", 0.0)
+    scatter_ms = table_scatter_ms(scatter_rows)
+    step_ms = (
+        1e3 * max(flops / peak, streamed / bw)
+        + layout_copy_ms(traffic["layout_copies"], bw)
+        + scatter_ms
     )
     cap = chunk_cap if chunk_cap is not None else config.chunk_cap
     dispatch_ms = overhead / max(1, cap)
@@ -121,6 +146,8 @@ def predict(
         flops=flops,
         hbm_bytes=traffic["total"],
         copy_bytes=traffic["layout_copies"],
+        scatter_rows=scatter_rows,
+        scatter_ms=scatter_ms,
         step_ms=step_ms,
         dispatch_ms=dispatch_ms,
         total_ms=step_ms + dispatch_ms,
@@ -181,4 +208,20 @@ def attribution_rows(est: CostEstimate, trace_summary: Dict) -> list:
     ]
     for r in rows:
         r["delta_ms"] = round(r["measured_ms"] - r["predicted_ms"], 4)
+    # Per-layout scatter sub-term (SCATTER_SEC_PER_ROW): a component of
+    # device_step, not an extra span — there is no host-visible scatter
+    # span to measure it against directly, so it is measured DIFFERENTIALLY
+    # via a split-vs-unified tracediff A/B (the delta between the two runs'
+    # device_step rows isolates it; PERF.md worked example). Banked so the
+    # record names how much of its predicted step the layout is carrying.
+    rows.append({
+        "term": "table_scatter",
+        "spans": [],
+        "predicted_ms": round(est.scatter_ms, 4),
+        "scatter_rows": est.scatter_rows,
+        "measured_ms": None,
+        "delta_ms": None,
+        "note": "sub-term of device_step; measure via split-vs-unified "
+                "tracediff A/B",
+    })
     return rows

@@ -47,9 +47,12 @@ from . import cost_model
 # divergence_budget) — the full health counters add a roughly uniform
 # per-step cost that does not reorder step-shape candidates, and keying on
 # them would orphan every banked seed plan for an observability overlay.
+# Schema 2 moved dtype/stochastic_rounding OUT of the fingerprint (they are
+# TunePlan dimensions the grid searches — the bf16+SR-default candidate)
+# and table_layout into the KEY (cache.plan_key), not here.
 FINGERPRINT_FIELDS = (
     "model", "train_method", "negative", "window", "max_sentence_len",
-    "dtype", "compute_dtype", "stochastic_rounding", "slab_scatter",
+    "compute_dtype", "slab_scatter",
     "fused_tables", "hs_dense_top", "hs_tail_slots", "clip_row_update",
     "scatter_mean", "cbow_mean",
 )
@@ -96,10 +99,14 @@ def candidate_grid(
     Quality fences: the optimizer block may not carry more tokens per vocab
     word than max(8x vocab, the configured block) — tuning must never walk
     a run INTO the hot-row divergence domain the Trainer warns about; KP
-    stays >= 32 (accuracy measured holding to KP=8, PERF.md — 32 keeps
-    margin); 'batch' scope is the replicated quality-positive lever. A
-    candidate the config rules reject (pallas+hs, batch-scope+pair, ...)
-    is dropped by construction via apply_plan's validation.
+    stays >= 16 (accuracy measured holding all the way to KP=8 on the
+    parity harness, PERF.md — 16 keeps margin); 'batch' scope is the
+    replicated quality-positive lever; the table-layout candidates are
+    trajectory-IDENTICAL (tests/test_unified.py) and the bf16+SR candidate
+    is margin-neutral at parity budget and at scale
+    (PARITY_MATRIX_r3 / QUALITY_FULL_r3). A candidate the config rules
+    reject (pallas+hs, batch-scope+pair, unified+pallas, ...) is dropped by
+    construction via apply_plan's validation.
     """
     c = constraints or {}
     base = config.current_plan()
@@ -125,36 +132,56 @@ def candidate_grid(
         max(W2, config.max_sentence_len // 3),
     })
     is_band_ns = kernel_route(config) == "band-ns"
-    kps = sorted({base.shared_negatives, 32, 64}) if is_band_ns else [
+    # KP width candidates (ROADMAP lever c): 64 -> 32 -> 16, each ~halving
+    # the negative-side einsum width; the accuracy fence measured holding
+    # down to KP=8 (Spearman 0.866 / purity 1.0 at KP in {8, 16, 32},
+    # benchmarks/parity.py --shared-negatives)
+    kps = sorted({base.shared_negatives, 16, 32, 64}) if is_band_ns else [
         base.shared_negatives
     ]
     scopes = ["row", "batch"] if is_band_ns else [base.negative_scope]
+    # Table layout (split vs unified [V, 2, d] slab): trajectory-identical,
+    # arbitrated by the cost model's per-layout scatter term + probes.
+    layouts = (
+        sorted({base.table_layout, "split", "unified"})
+        if is_band_ns else [base.table_layout]
+    )
+    # Storage dtype ± SR: the bf16+SR-default lever rides as a sibling
+    # candidate (margin-neutral, PARITY_MATRIX_r3/QUALITY_FULL_r3); the
+    # configured combo is always present so the incumbent can win.
+    dtypes = [(base.table_dtype, base.stochastic_rounding)]
+    if is_band_ns and ("bfloat16", True) not in dtypes:
+        dtypes.append(("bfloat16", True))
     backends = [base.band_backend]
     if (
         is_band_ns
         and c.get("allow_pallas", True)
         and c.get("platform") == "tpu"
     ):
-        # the fully-fused kernel cannot gather fused [V, 2, d] tables; the
-        # overlap-add kernel composes with fused_tables (token-order output
-        # shares the center side's sorted index set — ops/pallas_overlap.py)
+        # the fully-fused kernel cannot gather fused [V, 2, d] tables
+        # (chunk-restacked OR unified-layout); the overlap-add kernel
+        # composes with both (token-order output shares the center side's
+        # sorted index set — ops/pallas_overlap.py). unified x pallas
+        # combos are additionally dropped by apply_plan's validation.
         if not config.fused_tables and "pallas" not in backends:
             backends.append("pallas")
         if "pallas_oa" not in backends:
             backends.append("pallas_oa")
 
     combos = [
-        (b, cap, kp, scope, S, be)
+        (b, cap, kp, scope, S, be, lay, dt)
         for b in rows
         for cap in caps
         for kp in kps
         for scope in scopes
         for S in chunks
         for be in backends
+        for lay in layouts
+        for dt in dtypes
     ]
     out: List[TunePlan] = []
     seen = set()
-    for b, cap, kp, scope, S, be in combos:
+    for b, cap, kp, scope, S, be, lay, (dt, sr) in combos:
         # batch scope correlates the whole batch on one pool; keep it at
         # the promoted kp=256 width
         eff_kp = max(kp, 256) if scope == "batch" else kp
@@ -166,6 +193,9 @@ def candidate_grid(
             shared_negatives=eff_kp,
             negative_scope=scope,
             band_backend=be,
+            table_layout=lay,
+            table_dtype=dt,
+            stochastic_rounding=sr,
         )
         if plan in seen:
             continue
@@ -312,6 +342,8 @@ def resolve_plan(
     key = plan_cache.plan_key(
         dev.device_kind, platform, kernel_route(config), len(vocab),
         config.word_dim,
+        table_layout=config.table_layout,
+        shared_negatives=config.shared_negatives,
     )
     fp = config_fingerprint(config)
 

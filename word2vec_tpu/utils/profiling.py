@@ -141,6 +141,7 @@ def step_geometry(config, vocab_size: int) -> Dict:
         "NB": NB,
         "K": config.negative,
         "avg_path": max(1, math.ceil(math.log2(max(2, vocab_size)))),
+        "layout": getattr(config, "table_layout", "split"),
         "kernel": config.resolved_kernel,
         "route": (
             "pair"
@@ -195,7 +196,17 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
                        step; absent on the pallas, pallas_oa and
                        slab-scatter paths — pallas_oa replaces the chain
                        with a VMEM overlap-add kernel, ops/pallas_overlap)
-      total          — sum of the above
+      scatter_rows   — a COUNT, not bytes: rows fed to the step's table
+                       scatter-adds. The r2 trace measured XLA's sorted
+                       scatter at ~21 ns/row REGARDLESS of row width
+                       (PERF.md "Why not a Pallas scatter kernel"), so
+                       scatter cost is row machinery the byte roofline
+                       cannot see — the cost model prices this count
+                       separately (tune/cost_model.SCATTER_SEC_PER_ROW),
+                       and it is the term the table LAYOUT moves: the
+                       unified [V, 2, d] slab scatters the shared sorted
+                       id set once at doubled width instead of twice.
+      total          — sum of the BYTE terms (scatter_rows excluded)
 
     Absolute bytes are a model, not a measurement — the value is in the
     ORDERING (pallas < xla band << pair at bench shapes) and the terms'
@@ -215,16 +226,29 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
             "table_io": gathers + scatters,
             "intermediates": inter,
             "layout_copies": 0.0,
+            # per-pair enumeration scatters every (pair, target) row
+            "scatter_rows": float(P + P * targets),
             "total": gathers + scatters + inter,
         }
     if g["route"] == "band-hs":
         rows = B * L * g["avg_path"]
         table_io = 4.0 * rows * d * tb
         inter = 4.0 * B * L * d * f32
+        # positional kernel: the padded [B, L+2W, C] path-row buffer is the
+        # syn1 scatter (PERF.md "~21 ms of row machinery" at dim200 scale);
+        # the two-tier split replaces the dense-prefix levels with a slice
+        # add, leaving only the short tails (~avg_path - log2(top)) to
+        # scatter. Plus the B*L center/context rows on emb_in.
+        path = g["avg_path"]
+        if getattr(config, "hs_dense_top", 0):
+            path = max(
+                1.0, path - math.log2(max(2, config.hs_dense_top))
+            )
         return {
             "table_io": table_io,
             "intermediates": inter,
             "layout_copies": 0.0,
+            "scatter_rows": float(B * (L + 2 * g["W"]) * path + B * L),
             "total": table_io + inter,
         }
     # --- band ns ---
@@ -233,6 +257,18 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
     neg_rows = g["NB"] * g["KP"] * d
     # gathers once + scatter read-modify-write (~2x) for each touched row set
     table_io = 3.0 * (ein_rows + slab_rows + neg_rows) * tb
+    # Scatter-row machinery (the per-LAYOUT term): token-order paths issue
+    # two B*L-row sorted scatters (one per table) + the negative rows; the
+    # unified layout covers both tables with ONE B*L-row scatter at doubled
+    # width; slab-space paths (slab_scatter, the fused pallas kernel) trade
+    # one token-order scatter for a (S+2W)/S-larger slab-id scatter.
+    slab_side = g["backend"] == "pallas" or (config.slab_scatter and g["S"] > 0)
+    if slab_side:
+        scatter_rows = B * L + B * g["C"] * g["slab"] + g["NB"] * g["KP"]
+    elif g["layout"] == "unified":
+        scatter_rows = B * L + g["NB"] * g["KP"]
+    else:
+        scatter_rows = 2 * B * L + g["NB"] * g["KP"]
     if g["backend"] == "pallas":
         # each row tensor crosses HBM exactly once in and once out
         # (kernel outputs d_h/d_ctx/d_neg in f32)
@@ -267,5 +303,6 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
         "table_io": table_io,
         "intermediates": inter,
         "layout_copies": copies,
+        "scatter_rows": float(scatter_rows),
         "total": table_io + inter + copies,
     }

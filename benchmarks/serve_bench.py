@@ -1,0 +1,475 @@
+"""Serve bench: Zipf-distributed query load against the REAL server.
+
+Drives `python -m word2vec_tpu.serve` as a subprocess (the same process an
+operator runs — ready-line handshake, SIGTERM drain, exit codes and all)
+and banks sustained QPS + tail latency as one JSON record:
+
+    python benchmarks/serve_bench.py --smoke          # CI preset, ~5 s
+    python benchmarks/serve_bench.py --duration 10 --concurrency 32
+    python benchmarks/serve_bench.py --mode open --rate 500
+    python benchmarks/serve_bench.py --chaos kill     # SIGTERM mid-load:
+                                                      # drain or 75, with
+                                                      # a flight.json
+    python benchmarks/serve_bench.py --chaos oom      # absorbed as 503s
+    python benchmarks/serve_bench.py --chaos stall    # slow device: p99
+                                                      # spikes, server lives
+
+Load model: word ranks are drawn Zipf(s) over the fixture vocabulary — the
+hot-head distribution real query traffic has, which is exactly what the
+LRU cache and the coalescing window are for. Closed loop (default) keeps
+`--concurrency` workers each waiting for their response before issuing the
+next (throughput-seeking); open loop fires at `--rate` QPS regardless
+(latency under a fixed offered load, queue wait included).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from word2vec_tpu.serve.metrics import percentile  # noqa: E402
+
+
+# ------------------------------------------------------------------ fixture
+def make_fixture(path: str, vocab: int, dim: int, seed: int = 0) -> None:
+    """A synthetic exported table (binary format: fast to write/load)."""
+    from word2vec_tpu.io.embeddings import save_embeddings_binary
+
+    rng = np.random.default_rng(seed)
+    W = rng.normal(size=(vocab, dim)).astype(np.float32)
+    words = [f"w{i}" for i in range(vocab)]
+    save_embeddings_binary(path, words, W)
+
+
+# ------------------------------------------------------------- http client
+class Conn:
+    """One keep-alive connection (the stdlib-only async HTTP client)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self.r: Optional[asyncio.StreamReader] = None
+        self.w: Optional[asyncio.StreamWriter] = None
+
+    async def connect(self) -> None:
+        self.r, self.w = await asyncio.open_connection(self.host, self.port)
+
+    async def request(self, method: str, path: str,
+                      body: Optional[dict] = None) -> Tuple[int, dict]:
+        if self.r is None:
+            await self.connect()
+        data = json.dumps(body).encode() if body is not None else b""
+        req = (f"{method} {path} HTTP/1.1\r\nHost: {self.host}\r\n"
+               f"Content-Length: {len(data)}\r\n\r\n").encode() + data
+        self.w.write(req)
+        await self.w.drain()
+        status_line = await self.r.readline()
+        if not status_line:
+            raise ConnectionError("server closed the connection")
+        status = int(status_line.split()[1])
+        clen = 0
+        while True:
+            h = await self.r.readline()
+            if h in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = h.decode().partition(":")
+            if k.strip().lower() == "content-length":
+                clen = int(v.strip())
+        payload = await self.r.readexactly(clen) if clen else b""
+        try:
+            doc = json.loads(payload) if payload else {}
+        except ValueError:
+            doc = {}
+        return status, doc
+
+    def close(self) -> None:
+        if self.w is not None:
+            try:
+                self.w.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self.r = self.w = None
+
+
+# -------------------------------------------------------------- the server
+class ServerProc:
+    def __init__(self, args, fixture: str, metrics_dir: str):
+        self.metrics_dir = metrics_dir
+        cmd = [
+            sys.executable, "-m", "word2vec_tpu.serve",
+            "--vectors", fixture, "--format", "binary",
+            "--port", "0", "--quiet",
+            "--coalesce-ms", str(args.coalesce_ms),
+            "--max-batch", str(args.max_batch),
+            "--max-pending", str(args.max_pending),
+            "--cache-size", str(args.cache_size),
+            "--table-dtype", args.table_dtype,
+            "--drain-deadline", str(args.drain_deadline),
+            "--metrics-dir", metrics_dir,
+        ]
+        if args.faults:
+            cmd += ["--faults", args.faults]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        )
+        self.port: Optional[int] = None
+
+    def wait_ready(self, timeout: float = 120.0) -> int:
+        t0 = time.monotonic()
+        line = self.proc.stdout.readline().decode()
+        if not line:
+            raise RuntimeError(
+                f"server died before ready (rc={self.proc.poll()})")
+        doc = json.loads(line)
+        assert doc.get("event") == "serving", doc
+        self.port = int(doc["port"])
+        if time.monotonic() - t0 > timeout:
+            raise RuntimeError("server ready-line timeout")
+        return self.port
+
+    def sigterm(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+
+    def wait(self, timeout: float = 60.0) -> int:
+        try:
+            return self.proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            return self.proc.wait(5)
+
+
+# ------------------------------------------------------------------- load
+def zipf_probs(vocab: int, s: float) -> np.ndarray:
+    p = 1.0 / np.arange(1, vocab + 1, dtype=np.float64) ** s
+    return p / p.sum()
+
+
+def build_queries(args, vocab: int, n: int, seed: int) -> List[dict]:
+    """Pre-sampled query stream: Zipf-ranked words, mixed ops."""
+    rng = np.random.default_rng(seed)
+    p = zipf_probs(vocab, args.zipf_s)
+    ids = rng.choice(vocab, size=(n, 3), p=p)
+    ops = rng.choice(
+        ["neighbors", "analogy", "similarity"], size=n,
+        p=[args.mix_neighbors, args.mix_analogy, args.mix_similarity])
+    out = []
+    for (i, j, l), op in zip(ids, ops):
+        if op == "neighbors":
+            out.append({"op": "neighbors", "word": f"w{i}", "k": args.k})
+        elif op == "analogy":
+            out.append({"op": "analogy", "a": f"w{i}", "b": f"w{j}",
+                        "c": f"w{l}", "k": args.k})
+        else:
+            out.append({"op": "similarity", "w1": f"w{i}", "w2": f"w{j}"})
+    return out
+
+
+class LoadResult:
+    def __init__(self):
+        self.lat: List[float] = []
+        self.statuses: Dict[int, int] = {}
+        self.conn_errors = 0
+        self.t_first = None
+        self.t_last = None
+
+    def note(self, status: int, dur: float):
+        now = time.monotonic()
+        self.t_first = self.t_first or now
+        self.t_last = now
+        self.statuses[status] = self.statuses.get(status, 0) + 1
+        if status == 200:
+            self.lat.append(dur)
+
+
+async def closed_loop(host, port, queries, duration, concurrency,
+                      res: LoadResult, stop_evt: asyncio.Event):
+    qiter = iter(queries)
+    t_end = time.monotonic() + duration
+
+    async def worker():
+        conn = Conn(host, port)
+        while time.monotonic() < t_end and not stop_evt.is_set():
+            try:
+                q = next(qiter)
+            except StopIteration:
+                return
+            t0 = time.monotonic()
+            try:
+                status, _ = await conn.request("POST", "/v1/query", q)
+            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                res.conn_errors += 1
+                conn.close()
+                await asyncio.sleep(0.05)
+                continue
+            res.note(status, time.monotonic() - t0)
+        conn.close()
+
+    await asyncio.gather(*(worker() for _ in range(concurrency)))
+
+
+async def open_loop(host, port, queries, duration, rate,
+                    res: LoadResult, stop_evt: asyncio.Event):
+    pool: "asyncio.Queue" = asyncio.Queue()
+    for _ in range(64):
+        pool.put_nowait(Conn(host, port))
+    interval = 1.0 / max(1e-9, rate)
+    t_end = time.monotonic() + duration
+    tasks = []
+
+    async def one(q):
+        conn = await pool.get()
+        t0 = time.monotonic()
+        try:
+            status, _ = await conn.request("POST", "/v1/query", q)
+            res.note(status, time.monotonic() - t0)
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            res.conn_errors += 1
+            conn.close()
+        finally:
+            pool.put_nowait(conn)
+
+    qiter = iter(queries)
+    next_t = time.monotonic()
+    while time.monotonic() < t_end and not stop_evt.is_set():
+        now = time.monotonic()
+        if now < next_t:
+            await asyncio.sleep(next_t - now)
+        next_t += interval
+        try:
+            q = next(qiter)
+        except StopIteration:
+            break
+        tasks.append(asyncio.ensure_future(one(q)))
+    await asyncio.gather(*tasks, return_exceptions=True)
+    while not pool.empty():
+        pool.get_nowait().close()
+
+
+# ------------------------------------------------------------------- main
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--vectors", help="existing table (default: synthesize)")
+    ap.add_argument("--vocab", type=int, default=20000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--mode", choices=["closed", "open"], default="closed")
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--rate", type=float, default=500.0,
+                    help="open-loop offered QPS")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--zipf-s", type=float, default=1.1)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--mix-neighbors", type=float, default=0.8)
+    ap.add_argument("--mix-analogy", type=float, default=0.15)
+    ap.add_argument("--mix-similarity", type=float, default=0.05)
+    ap.add_argument("--coalesce-ms", type=float, default=2.0)
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-pending", type=int, default=1024)
+    ap.add_argument("--cache-size", type=int, default=4096)
+    ap.add_argument("--table-dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--drain-deadline", type=float, default=10.0)
+    ap.add_argument("--faults", default="",
+                    help="forwarded to the server (--chaos presets set it)")
+    ap.add_argument("--chaos", choices=["none", "kill", "oom", "stall"],
+                    default="none")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI preset: tiny fixture, ~3 s of load")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json-out", help="write the record here too")
+    return ap
+
+
+def apply_smoke(args) -> None:
+    args.vocab = min(args.vocab, 2000)
+    args.dim = min(args.dim, 32)
+    args.duration = min(args.duration, 3.0)
+    args.concurrency = min(args.concurrency, 8)
+
+
+def apply_chaos(args) -> None:
+    if args.chaos == "oom" and not args.faults:
+        # enough firings to outlive the 3 warmup batches and still hit the
+        # timed load window
+        args.faults = "oom:times=6"
+    elif args.chaos == "stall" and not args.faults:
+        args.faults = "stall@10:secs=0.8"
+
+
+async def drive(args, server: ServerProc, res: LoadResult) -> Dict:
+    host = "127.0.0.1"
+    port = server.port
+    warm = Conn(host, port)
+    # warm up every op's compiled-bucket set before the timed window
+    warm_statuses: Dict[int, int] = {}
+    for q in ({"op": "neighbors", "word": "w0", "k": args.k},
+              {"op": "analogy", "a": "w0", "b": "w1", "c": "w2",
+               "k": args.k},
+              {"op": "similarity", "w1": "w0", "w2": "w1"}):
+        st, _ = await warm.request("POST", "/v1/query", q)
+        warm_statuses[st] = warm_statuses.get(st, 0) + 1
+    warm.close()
+
+    n = int(max(args.duration * 4000, 20000))
+    queries = build_queries(args, args.vocab, n, args.seed)
+    stop_evt = asyncio.Event()
+    chaos_info: Dict = {}
+    if args.chaos != "none":
+        chaos_info["warmup_statuses"] = {
+            str(k): v for k, v in sorted(warm_statuses.items())}
+
+    async def chaos_kill():
+        await asyncio.sleep(args.duration / 2.0)
+        chaos_info["sigterm_at_s"] = args.duration / 2.0
+        chaos_info["requests_before_sigterm"] = sum(res.statuses.values())
+        server.sigterm()
+
+    tasks = []
+    if args.chaos == "kill":
+        tasks.append(asyncio.ensure_future(chaos_kill()))
+    if args.mode == "closed":
+        await closed_loop(host, port, queries, args.duration,
+                          args.concurrency, res, stop_evt)
+    else:
+        await open_loop(host, port, queries, args.duration, args.rate,
+                        res, stop_evt)
+    for t in tasks:
+        await t
+
+    server_stats: Optional[Dict] = None
+    if args.chaos != "kill":
+        try:
+            c = Conn(host, port)
+            _, server_stats = await c.request("GET", "/stats")
+            _, health = await c.request("GET", "/healthz")
+            chaos_info["healthy_after_load"] = bool(health.get("ok"))
+            c.close()
+        except (ConnectionError, OSError, asyncio.IncompleteReadError):
+            chaos_info["healthy_after_load"] = False
+    else:
+        chaos_info["requests_after_sigterm"] = (
+            sum(res.statuses.values())
+            - chaos_info.get("requests_before_sigterm", 0))
+    return {"server_stats": server_stats, "chaos": chaos_info}
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.smoke:
+        apply_smoke(args)
+    apply_chaos(args)
+
+    tmp = tempfile.mkdtemp(prefix="serve_bench_")
+    fixture = args.vectors
+    if not fixture:
+        fixture = os.path.join(tmp, "fixture.bin")
+        make_fixture(fixture, args.vocab, args.dim, args.seed)
+    metrics_dir = os.path.join(tmp, "mdir")
+
+    server = ServerProc(args, fixture, metrics_dir)
+    res = LoadResult()
+    try:
+        server.wait_ready()
+        extra = asyncio.run(drive(args, server, res))
+    finally:
+        server.sigterm()
+        rc = server.wait()
+
+    lat_ms = [1e3 * x for x in res.lat]
+    span = ((res.t_last - res.t_first)
+            if res.t_first and res.t_last and res.t_last > res.t_first
+            else args.duration)
+    ok = res.statuses.get(200, 0)
+    flight = os.path.join(metrics_dir, "flight.json")
+    rec = {
+        "bench": "serve",
+        "mode": args.mode,
+        "smoke": bool(args.smoke),
+        "fixture": {"vocab": args.vocab, "dim": args.dim,
+                    "table_dtype": args.table_dtype,
+                    "path": None if not args.vectors else args.vectors},
+        "load": {"duration_s": args.duration, "zipf_s": args.zipf_s,
+                 "k": args.k, "concurrency": args.concurrency,
+                 "rate": args.rate if args.mode == "open" else None,
+                 "mix": {"neighbors": args.mix_neighbors,
+                         "analogy": args.mix_analogy,
+                         "similarity": args.mix_similarity}},
+        "server_config": {"coalesce_ms": args.coalesce_ms,
+                          "max_batch": args.max_batch,
+                          "max_pending": args.max_pending,
+                          "cache_size": args.cache_size},
+        "requests": sum(res.statuses.values()),
+        "ok": ok,
+        "statuses": {str(k): v for k, v in sorted(res.statuses.items())},
+        "conn_errors": res.conn_errors,
+        "qps_sustained": ok / span if span > 0 else 0.0,
+        "latency_ms": {
+            "p50": percentile(lat_ms, 0.50),
+            "p90": percentile(lat_ms, 0.90),
+            "p99": percentile(lat_ms, 0.99),
+            "mean": float(np.mean(lat_ms)) if lat_ms else 0.0,
+            "max": max(lat_ms) if lat_ms else 0.0,
+        },
+        "cache_hit_rate": (extra.get("server_stats") or {}).get(
+            "serve_cache_hit_rate"),
+        "batch_fill_mean": (extra.get("server_stats") or {}).get(
+            "serve_batch_fill_mean"),
+        "server_stats": extra.get("server_stats"),
+        "chaos": ({"kind": args.chaos, **extra.get("chaos", {}),
+                   "faults": args.faults or None,
+                   "server_rc": rc,
+                   "flight_json_present": os.path.isfile(flight)}
+                  if args.chaos != "none" else None),
+        "server_rc": rc,
+    }
+    line = json.dumps(rec)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+
+    if args.chaos == "kill":
+        # the drill's contract: drain (0) or forced requeue (75), never a
+        # hang or a stack-trace death — and the flight evidence must exist
+        if rc not in (0, 75):
+            print(f"CHAOS FAIL: server_rc={rc}", file=sys.stderr)
+            return 1
+        if not os.path.isfile(flight):
+            print("CHAOS FAIL: no flight.json after kill", file=sys.stderr)
+            return 1
+    elif rc != 0:
+        print(f"FAIL: server exited {rc}", file=sys.stderr)
+        return 1
+    if args.chaos == "oom":
+        # the injected allocation failures must have SURFACED as 503s
+        # (here or in warmup) and the server must have outlived them
+        shed = res.statuses.get(503, 0) + (extra.get("chaos", {})
+                                           .get("warmup_statuses", {})
+                                           .get("503", 0))
+        if shed == 0:
+            print("CHAOS FAIL: no 503 surfaced for injected oom",
+                  file=sys.stderr)
+            return 1
+        if not (extra.get("chaos", {}).get("healthy_after_load")):
+            print("CHAOS FAIL: server unhealthy after oom", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

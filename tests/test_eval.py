@@ -281,6 +281,84 @@ def test_graded_eval_rejects_diverged_model(tmp_path):
     assert "error" in r2 and "non-finite" in r2["error"]
 
 
+# ------------------------- shared serve/query kernel (serving PR) ----------
+class TestEvalOnSharedKernel:
+    """eval/ now rides serve/query.QueryEngine; the pre-refactor behavior
+    is pinned here: identical results to the raw NumPy math, KeyError
+    naming the OOV word, masking at k >= V-1, deterministic tie order,
+    and table normalization happening ONCE across successive queries."""
+
+    def _case(self):
+        words = [f"w{i}" for i in range(12)]
+        vocab = Vocab.from_counter(
+            {w: 50 - i for i, w in enumerate(words)}, min_count=1)
+        rng = np.random.default_rng(11)
+        W = rng.normal(size=(12, 6)).astype(np.float32)
+        return words, vocab, W
+
+    def test_results_match_raw_numpy(self):
+        words, vocab, W = self._case()
+        got = nearest_neighbors(W, vocab, "w3", k=4)
+        Wn = W / np.maximum(
+            np.linalg.norm(W, axis=1, keepdims=True), 1e-12)
+        sims = Wn @ Wn[vocab["w3"]]
+        sims[vocab["w3"]] = -np.inf
+        want_order = np.argsort(-sims)[:4]
+        assert [w for w, _ in got] == [vocab.words[i] for i in want_order]
+        np.testing.assert_allclose(
+            [s for _, s in got], sims[want_order], rtol=1e-5, atol=1e-6)
+
+    def test_oov_keyerror_names_word(self):
+        words, vocab, W = self._case()
+        with pytest.raises(KeyError, match="'missing' not in vocabulary"):
+            nearest_neighbors(W, vocab, "missing")
+        with pytest.raises(KeyError, match="'nope' not in vocabulary"):
+            analogy_query(W, vocab, "w0", "nope", "w1")
+
+    def test_masking_at_k_ge_V_minus_1(self):
+        words, vocab, W = self._case()
+        V = len(words)
+        for k in (V - 1, V, V + 3):
+            res = nearest_neighbors(W, vocab, "w5", k=k)
+            assert "w5" not in [w for w, _ in res]
+            assert len(res) == V - 1
+        res = analogy_query(W, vocab, "w0", "w1", "w2", k=V)
+        assert not {"w0", "w1", "w2"} & {w for w, _ in res}
+        assert len(res) == V - 3
+
+    def test_tied_scores_deterministic_ascending_index(self):
+        # three identical rows tie exactly; argpartition used to order
+        # them arbitrarily — the kernel contract is ascending vocab index
+        words = ["q", "t1", "t2", "t3", "far"]
+        vocab = Vocab.from_counter(
+            {w: 50 - i for i, w in enumerate(words)}, min_count=1)
+        W = np.array([[1, 0], [0.8, 0.6], [0.8, 0.6], [0.8, 0.6],
+                      [-1, 0]], np.float32)
+        res = nearest_neighbors(W, vocab, "q", k=4)
+        assert [w for w, _ in res] == ["t1", "t2", "t3", "far"]
+        for _ in range(3):
+            assert nearest_neighbors(W, vocab, "q", k=4) == res
+
+    def test_two_queries_normalize_once(self, monkeypatch):
+        from word2vec_tpu.serve import query as sq
+
+        sq.clear_engine_cache()
+        words, vocab, W = self._case()
+        calls = {"n": 0}
+        real = sq.unit_norm
+
+        def counting(W_):
+            calls["n"] += 1
+            return real(W_)
+
+        monkeypatch.setattr(sq, "unit_norm", counting)
+        nearest_neighbors(W, vocab, "w0", k=3)
+        nearest_neighbors(W, vocab, "w7", k=3)
+        analogy_query(W, vocab, "w0", "w1", "w2", k=3)
+        assert calls["n"] == 1
+        sq.clear_engine_cache()
+
+
 def test_analogy_3cosmul_solves_planted_structure():
     """3CosMul (Levy & Goldberg 2014): on clean planted analogies both
     protocols find the gold answer; on unstructured vectors the two

@@ -11,10 +11,14 @@ from word2vec_tpu.config import Word2VecConfig
 from word2vec_tpu.data.vocab import Vocab
 from word2vec_tpu.io.checkpoint import load_checkpoint, save_checkpoint
 from word2vec_tpu.io.embeddings import (
+    INT8_MAGIC,
     load_embeddings_binary,
+    load_embeddings_int8,
     load_embeddings_text,
     load_word2vec,
+    quantize_rows_int8,
     save_embeddings_binary,
+    save_embeddings_int8,
     save_embeddings_text,
     save_word2vec,
 )
@@ -130,6 +134,95 @@ def test_checkpoint_roundtrip(tmp_path, vocab):
     save_checkpoint(path, state, cfg, vocab)
     s3, _, _ = load_checkpoint(path)
     assert s3.step == 18
+
+
+# ----------------------------- int8 symmetric quantization (serve PR) ------
+class TestInt8Export:
+    """The serving export path: per-row scale header, round-trip bounded by
+    the quantization error, loud failures on corrupt files (the PR 4 loader
+    contract), and cross-dtype load into a f32 engine."""
+
+    def test_roundtrip_within_quantization_error(self, tmp_path, vocab, matrix):
+        p = str(tmp_path / "v.i8")
+        save_embeddings_int8(p, vocab.words, matrix)
+        words, deq = load_embeddings_int8(p)
+        assert words == vocab.words
+        scales = np.abs(matrix).max(axis=1) / 127.0
+        # the contract the ISSUE names: |round-trip error| <= scale / 2
+        assert (np.abs(deq - matrix) <= scales[:, None] / 2 + 1e-6).all()
+
+    def test_header_and_scales_layout(self, tmp_path, vocab, matrix):
+        p = str(tmp_path / "v.i8")
+        save_embeddings_int8(p, vocab.words, matrix)
+        raw = open(p, "rb").read()
+        header, _, rest = raw.partition(b"\n")
+        assert header == INT8_MAGIC + b" 3 5"
+        scales = np.frombuffer(rest[: 3 * 4], dtype="<f4")
+        np.testing.assert_allclose(
+            scales, np.abs(matrix).max(axis=1) / 127.0, rtol=1e-6)
+        assert rest[12:16] == b"the "   # first word record follows scales
+
+    def test_quantized_view(self, tmp_path, vocab, matrix):
+        p = str(tmp_path / "v.i8")
+        save_embeddings_int8(p, vocab.words, matrix)
+        words, q, scales = load_embeddings_int8(p, dequantize=False)
+        assert q.dtype == np.int8 and scales.dtype == np.float32
+        qq, ss = quantize_rows_int8(matrix)
+        np.testing.assert_array_equal(q, qq)
+        np.testing.assert_allclose(scales, ss, rtol=1e-6)
+
+    def test_zero_row_roundtrips_exactly(self, tmp_path):
+        m = np.zeros((2, 4), np.float32)
+        m[1] = [1.0, -2.0, 0.5, 0.0]
+        p = str(tmp_path / "z.i8")
+        save_embeddings_int8(p, ["a", "b"], m)
+        _, deq = load_embeddings_int8(p)
+        np.testing.assert_array_equal(deq[0], 0.0)
+
+    def test_not_int8_file_rejected(self, tmp_path, vocab, matrix):
+        p = str(tmp_path / "v.txt")
+        save_embeddings_text(p, vocab.words, matrix)
+        with pytest.raises(ValueError, match="not an int8 embedding file"):
+            load_embeddings_int8(p)
+
+    def test_truncated_scale_header_names_bytes(self, tmp_path, vocab, matrix):
+        p = str(tmp_path / "v.i8")
+        save_embeddings_int8(p, vocab.words, matrix)
+        data = open(p, "rb").read()
+        header_end = data.index(b"\n") + 1
+        open(p, "wb").write(data[: header_end + 5])  # cut into the scales
+        with pytest.raises(ValueError, match="truncated scale header"):
+            load_embeddings_int8(p)
+
+    def test_truncated_row_names_word(self, tmp_path, vocab, matrix):
+        p = str(tmp_path / "v.i8")
+        save_embeddings_int8(p, vocab.words, matrix)
+        data = open(p, "rb").read()
+        open(p, "wb").write(data[:-4])  # cut into the last row
+        with pytest.raises(ValueError, match=r"word #2 \('fox'\).*truncated"):
+            load_embeddings_int8(p)
+
+    def test_corrupt_scales_rejected(self, tmp_path, vocab, matrix):
+        p = str(tmp_path / "v.i8")
+        save_embeddings_int8(p, vocab.words, matrix)
+        data = bytearray(open(p, "rb").read())
+        header_end = data.index(b"\n") + 1
+        data[header_end:header_end + 4] = np.float32(np.nan).tobytes()
+        open(p, "wb").write(bytes(data))
+        with pytest.raises(ValueError, match="corrupt scale header"):
+            load_embeddings_int8(p)
+
+    def test_cross_dtype_load_feeds_f32_math(self, tmp_path, vocab, matrix):
+        """int8 file -> f32 matrix -> the same downstream math every f32
+        export feeds (the serve engine's cross-dtype load path)."""
+        p = str(tmp_path / "v.i8")
+        save_embeddings_int8(p, vocab.words, matrix)
+        _, deq = load_embeddings_int8(p)
+        assert deq.dtype == np.float32
+        n_orig = matrix / np.linalg.norm(matrix, axis=1, keepdims=True)
+        n_deq = deq / np.linalg.norm(deq, axis=1, keepdims=True)
+        # cosine geometry survives quantization
+        assert np.abs((n_orig * n_deq).sum(1) - 1.0).max() < 1e-3
 
 
 # --------------------------- malformed-input diagnostics (resilience PR) ---

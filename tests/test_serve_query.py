@@ -1,0 +1,257 @@
+"""Serve query engine: the shared jit'd batched top-k kernel (serve PR).
+
+The refactor contract is pinned here: engine results must match the
+pre-refactor NumPy math (re-implemented inline as the golden reference) up
+to f32 tolerance, the resident table normalizes ONCE across queries,
+masking holds at k >= V-1, ties order deterministically, and the int8
+export round-trips into a f32/bf16 engine.
+"""
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.serve import query as sq
+from word2vec_tpu.serve.query import QueryEngine, get_engine, unit_norm
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    sq.clear_engine_cache()
+    yield
+    sq.clear_engine_cache()
+
+
+def _vocab(words):
+    return Vocab.from_counter(
+        {w: 100 - i for i, w in enumerate(words)}, min_count=1)
+
+
+@pytest.fixture
+def rand_case():
+    rng = np.random.default_rng(7)
+    words = [f"w{i}" for i in range(23)]
+    W = rng.normal(size=(23, 12)).astype(np.float32)
+    return words, _vocab(words), W
+
+
+# --------------------------------------------------- golden numpy reference
+def _legacy_neighbors(W, vocab, word, k):
+    """The pre-refactor eval/neighbors.py math, verbatim."""
+    Wn = W / np.maximum(np.linalg.norm(W, axis=1, keepdims=True), 1e-12)
+    sims = Wn @ Wn[vocab[word]]
+    sims[vocab[word]] = -np.inf
+    top = np.argpartition(-sims, min(k, len(sims) - 1))[:k]
+    top = top[np.argsort(-sims[top])]
+    return [(vocab.words[i], float(sims[i])) for i in top]
+
+
+def _legacy_analogy(W, vocab, a, b, c, k):
+    Wn = W / np.maximum(np.linalg.norm(W, axis=1, keepdims=True), 1e-12)
+    q = Wn[vocab[b]] - Wn[vocab[a]] + Wn[vocab[c]]
+    q /= max(np.linalg.norm(q), 1e-12)
+    sims = Wn @ q
+    for w in (a, b, c):
+        sims[vocab[w]] = -np.inf
+    top = np.argpartition(-sims, min(k, len(sims) - 1))[:k]
+    top = top[np.argsort(-sims[top])]
+    return [(vocab.words[i], float(sims[i])) for i in top]
+
+
+class TestKernelParity:
+    def test_neighbors_match_legacy_numpy(self, rand_case):
+        words, vocab, W = rand_case
+        eng = QueryEngine(W, vocab)
+        for word in ("w0", "w7", "w22"):
+            for k in (1, 5, 10):
+                got = eng.neighbors_batch([word], k=k)[0]
+                want = _legacy_neighbors(W, vocab, word, k)
+                assert [w for w, _ in got] == [w for w, _ in want]
+                np.testing.assert_allclose(
+                    [s for _, s in got], [s for _, s in want],
+                    rtol=1e-5, atol=1e-6)
+
+    def test_analogy_matches_legacy_numpy(self, rand_case):
+        words, vocab, W = rand_case
+        eng = QueryEngine(W, vocab)
+        got = eng.analogy_batch([("w1", "w2", "w3")], k=6)[0]
+        want = _legacy_analogy(W, vocab, "w1", "w2", "w3", 6)
+        assert [w for w, _ in got] == [w for w, _ in want]
+        np.testing.assert_allclose(
+            [s for _, s in got], [s for _, s in want], rtol=1e-5, atol=1e-6)
+
+    def test_batch_equals_singles(self, rand_case):
+        words, vocab, W = rand_case
+        eng = QueryEngine(W, vocab)
+        batch = eng.neighbors_batch(["w0", "w5", "w9", "w13"], k=4)
+        for i, word in enumerate(["w0", "w5", "w9", "w13"]):
+            single = eng.neighbors_batch([word], k=4)[0]
+            # a [4, V] and a [1, V] matmul are different compiled programs;
+            # scores agree to f32 tolerance, not bitwise
+            assert [w for w, _ in batch[i]] == [w for w, _ in single]
+            np.testing.assert_allclose(
+                [s for _, s in batch[i]], [s for _, s in single],
+                rtol=1e-5, atol=1e-6)
+
+    def test_pair_cosines_match_cosine_rows(self, rand_case):
+        from word2vec_tpu.eval.similarity import cosine_rows
+
+        words, vocab, W = rand_case
+        eng = QueryEngine(W, vocab)
+        i = np.array([0, 3, 8])
+        j = np.array([1, 9, 2])
+        np.testing.assert_allclose(
+            eng.pair_cosines(i, j), cosine_rows(W, i, j),
+            rtol=1e-5, atol=1e-6)
+
+    def test_similarity_batch(self, rand_case):
+        words, vocab, W = rand_case
+        eng = QueryEngine(W, vocab)
+        sims = eng.similarity_batch([("w0", "w1"), ("w2", "w2")])
+        assert sims[1] == pytest.approx(1.0, abs=1e-5)
+
+
+class TestMaskingAndOOV:
+    def test_oov_keyerror_names_word(self, rand_case):
+        words, vocab, W = rand_case
+        eng = QueryEngine(W, vocab)
+        with pytest.raises(KeyError, match="'zzz' not in vocabulary"):
+            eng.neighbors_batch(["zzz"])
+        with pytest.raises(KeyError, match="'gone' not in vocabulary"):
+            eng.analogy_batch([("w0", "gone", "w1")])
+
+    def test_restricted_rows_are_oov(self, rand_case):
+        words, vocab, W = rand_case
+        eng = QueryEngine(W, vocab, restrict=5)
+        assert eng.V == 5
+        with pytest.raises(KeyError, match="'w9' not in vocabulary"):
+            eng.neighbors_batch(["w9"])
+
+    def test_self_mask_holds_at_k_ge_V_minus_1(self, rand_case):
+        words, vocab, W = rand_case
+        V = len(words)
+        eng = QueryEngine(W, vocab)
+        for k in (V - 1, V, V + 10):
+            res = eng.neighbors_batch(["w4"], k=k)[0]
+            names = [w for w, _ in res]
+            assert "w4" not in names
+            assert len(res) == V - 1    # everything except the query word
+
+    def test_analogy_mask_holds_at_k_ge_V(self, rand_case):
+        words, vocab, W = rand_case
+        V = len(words)
+        eng = QueryEngine(W, vocab)
+        res = eng.analogy_batch([("w0", "w1", "w2")], k=V)[0]
+        names = [w for w, _ in res]
+        assert not {"w0", "w1", "w2"} & set(names)
+        assert len(res) == V - 3
+
+
+class TestTieDeterminism:
+    def test_tied_scores_order_by_index(self):
+        # rows 1, 2, 4 are identical -> tied cosines vs row 0; they must
+        # come back in ascending vocab-index order, every time
+        words = ["q", "t1", "t2", "other", "t3"]
+        vocab = _vocab(words)
+        W = np.array([
+            [1.0, 0.0],
+            [0.6, 0.8],
+            [0.6, 0.8],
+            [-1.0, 0.0],
+            [0.6, 0.8],
+        ], np.float32)
+        eng = QueryEngine(W, vocab)
+        first = eng.neighbors_batch(["q"], k=4)[0]
+        assert [w for w, _ in first] == ["t1", "t2", "t3", "other"]
+        for _ in range(3):
+            assert eng.neighbors_batch(["q"], k=4)[0] == first
+
+
+class TestEngineCache:
+    def test_same_array_reuses_engine(self, rand_case):
+        words, vocab, W = rand_case
+        assert get_engine(W, vocab) is get_engine(W, vocab)
+
+    def test_distinct_arrays_distinct_engines(self, rand_case):
+        words, vocab, W = rand_case
+        e1 = get_engine(W, vocab)
+        assert get_engine(W.copy(), vocab) is not e1
+
+    def test_normalizes_once_across_queries(self, rand_case, monkeypatch):
+        from word2vec_tpu.eval.neighbors import (
+            analogy_query,
+            nearest_neighbors,
+        )
+
+        words, vocab, W = rand_case
+        calls = {"n": 0}
+        real = sq.unit_norm
+
+        def counting(W_):
+            calls["n"] += 1
+            return real(W_)
+
+        monkeypatch.setattr(sq, "unit_norm", counting)
+        r1 = nearest_neighbors(W, vocab, "w0", k=3)
+        r2 = nearest_neighbors(W, vocab, "w1", k=3)
+        analogy_query(W, vocab, "w0", "w1", "w2", k=3)
+        assert calls["n"] == 1     # ONE normalization for all three queries
+        assert r1 != r2
+
+    def test_restricted_engine_cached_separately(self, rand_case):
+        words, vocab, W = rand_case
+        full = get_engine(W, vocab)
+        r5 = get_engine(W, vocab, restrict=5)
+        assert full is not r5 and r5.V == 5
+        assert get_engine(W, vocab, restrict=5) is r5
+
+
+class TestDtypes:
+    def test_bf16_engine_close_to_f32(self, rand_case):
+        words, vocab, W = rand_case
+        f32 = QueryEngine(W, vocab).neighbors_batch(["w0"], k=3)[0]
+        bf16 = QueryEngine(
+            W, vocab, table_dtype="bfloat16").neighbors_batch(["w0"], k=3)[0]
+        got = dict(bf16)
+        for w, s in f32:
+            assert w in got and abs(got[w] - s) < 0.02
+
+    def test_bad_dtype_rejected(self, rand_case):
+        words, vocab, W = rand_case
+        with pytest.raises(ValueError, match="table_dtype"):
+            QueryEngine(W, vocab, table_dtype="int8")
+
+    def test_int8_file_feeds_f32_engine(self, rand_case, tmp_path):
+        """The cross-dtype serving path: int8 container -> dequantized f32
+        resident table; neighbor sets survive quantization on a spread-out
+        random table."""
+        from word2vec_tpu.io.embeddings import (
+            load_embeddings_int8,
+            save_embeddings_int8,
+        )
+
+        words, vocab, W = rand_case
+        p = str(tmp_path / "t.i8")
+        save_embeddings_int8(p, words, W)
+        w2, deq = load_embeddings_int8(p)
+        assert w2 == words
+        eng = QueryEngine(deq, vocab)
+        exact = QueryEngine(W, vocab)
+        got = eng.neighbors_batch(["w0"], k=3)[0]
+        want = exact.neighbors_batch(["w0"], k=3)[0]
+        for (gw, gs), (ww, ws) in zip(got, want):
+            assert abs(gs - ws) < 0.05
+
+
+class TestUnitNorm:
+    def test_unit_norm_rows(self):
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(5, 4)).astype(np.float32)
+        n = np.linalg.norm(unit_norm(W), axis=1)
+        np.testing.assert_allclose(n, 1.0, rtol=1e-6)
+
+    def test_zero_row_survives(self):
+        W = np.zeros((2, 4), np.float32)
+        W[0, 0] = 1.0
+        out = unit_norm(W)
+        assert np.isfinite(out).all()

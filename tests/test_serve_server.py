@@ -1,0 +1,338 @@
+"""Async serving layer: coalescing, cache, shedding, drain, chaos, metrics.
+
+Each test drives a real EmbeddingServer over a real localhost socket
+inside one asyncio.run() — the event loop, HTTP parsing, batcher, and
+executor path are all the production ones; only signals are replaced by
+direct begin_drain() calls (tests must not SIGTERM the pytest process).
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.resilience.faults import FaultPlan
+from word2vec_tpu.serve.query import QueryEngine
+from word2vec_tpu.serve.server import EmbeddingServer, ServeConfig
+
+WORDS = ["man", "woman", "king", "queen", "apple", "banana", "cherry"]
+
+
+def _engine():
+    vocab = Vocab(WORDS, np.ones(len(WORDS), np.int64))
+    rng = np.random.default_rng(3)
+    W = rng.normal(size=(len(WORDS), 8)).astype(np.float32)
+    return QueryEngine(W, vocab)
+
+
+async def _http(port, method, path, body=None):
+    r, w = await asyncio.open_connection("127.0.0.1", port)
+    data = json.dumps(body).encode() if body is not None else b""
+    req = (f"{method} {path} HTTP/1.1\r\nHost: t\r\n"
+           f"Content-Length: {len(data)}\r\nConnection: close\r\n\r\n"
+           ).encode() + data
+    w.write(req)
+    await w.drain()
+    raw = await r.read()
+    w.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    try:
+        doc = json.loads(payload)
+    except ValueError:
+        doc = payload.decode()
+    return status, doc
+
+
+def run_with_server(coro_fn, **cfg_kw):
+    """Start a server on an ephemeral port, run coro_fn(server), then
+    drain; returns (coro result, exit code)."""
+
+    async def main():
+        srv = EmbeddingServer(_engine(), ServeConfig(**cfg_kw))
+        await srv.start()
+        try:
+            out = await coro_fn(srv)
+        finally:
+            srv.begin_drain()
+            code = await srv.run()
+        return out, code, srv
+
+    return asyncio.run(main())
+
+
+class TestRoutes:
+    def test_healthz_and_queries(self):
+        async def body(srv):
+            st, h = await _http(srv.port, "GET", "/healthz")
+            assert st == 200 and h["ok"] and h["vocab"] == len(WORDS)
+            st, nb = await _http(
+                srv.port, "GET", "/v1/neighbors?word=king&k=3")
+            assert st == 200 and len(nb["neighbors"]) == 3
+            assert all(w != "king" for w, _ in nb["neighbors"])
+            st, an = await _http(srv.port, "POST", "/v1/query", {
+                "op": "analogy", "a": "man", "b": "woman", "c": "king",
+                "k": 2})
+            assert st == 200 and len(an["neighbors"]) == 2
+            st, sim = await _http(srv.port, "POST", "/v1/query", {
+                "op": "similarity", "w1": "king", "w2": "queen"})
+            assert st == 200 and -1.001 <= sim["similarity"] <= 1.001
+            return True
+
+        out, code, _ = run_with_server(body, coalesce_ms=0.5)
+        assert out and code == 0
+
+    def test_batch_post_and_errors(self):
+        async def body(srv):
+            st, doc = await _http(srv.port, "POST", "/v1/query", {
+                "queries": [
+                    {"op": "neighbors", "word": "king", "k": 2},
+                    {"op": "neighbors", "word": "zzz"},
+                    {"op": "bogus"},
+                ]})
+            assert st == 200
+            r = doc["results"]
+            assert r[0]["status"] == 200 and len(r[0]["neighbors"]) == 2
+            # OOV names the word, satellite contract
+            assert r[1]["status"] == 404 and "'zzz'" in r[1]["error"]
+            assert r[2]["status"] == 400
+            st, _ = await _http(srv.port, "GET", "/nope")
+            assert st == 404
+            st, doc = await _http(
+                srv.port, "GET", "/v1/neighbors?word=king&k=bad")
+            assert st == 400
+            return True
+
+        out, code, _ = run_with_server(body, coalesce_ms=0.5)
+        assert out and code == 0
+
+
+class TestCoalescing:
+    def test_concurrent_queries_share_batches(self):
+        async def body(srv):
+            await asyncio.gather(*[
+                _http(srv.port, "POST", "/v1/query",
+                      {"op": "neighbors", "word": w, "k": 2})
+                for w in WORDS])
+            return srv.stats.batches_total
+
+        batches, code, srv = run_with_server(
+            body, coalesce_ms=100.0, cache_size=0)
+        # 7 concurrent queries within a 100 ms window: strictly fewer
+        # device batches than queries (usually 1-2)
+        assert 1 <= batches < len(WORDS)
+        assert srv.stats.batch_items_total == len(WORDS)
+        assert code == 0
+
+    def test_zero_window_still_serves(self):
+        async def body(srv):
+            st, nb = await _http(
+                srv.port, "GET", "/v1/neighbors?word=king&k=2")
+            assert st == 200 and nb["neighbors"]
+            return True
+
+        out, code, _ = run_with_server(body, coalesce_ms=0.0)
+        assert out and code == 0
+
+
+class TestCacheAndShed:
+    def test_lru_cache_hit(self):
+        async def body(srv):
+            await _http(srv.port, "GET", "/v1/neighbors?word=king&k=3")
+            before = srv.cache.hits
+            st, _ = await _http(
+                srv.port, "GET", "/v1/neighbors?word=king&k=3")
+            assert st == 200
+            assert srv.cache.hits == before + 1
+            # different k = different cache entry
+            await _http(srv.port, "GET", "/v1/neighbors?word=king&k=4")
+            assert srv.cache.misses >= 2
+            return True
+
+        out, code, _ = run_with_server(body, coalesce_ms=0.5)
+        assert out and code == 0
+
+    def test_bounded_queue_sheds_429(self):
+        async def body(srv):
+            results = await asyncio.gather(*[
+                _http(srv.port, "POST", "/v1/query",
+                      {"op": "neighbors", "word": WORDS[i % len(WORDS)],
+                       "k": 2 + i % 5})
+                for i in range(24)])
+            statuses = [st for st, _ in results]
+            assert 429 in statuses            # load shed
+            assert 200 in statuses            # but not a full outage
+            shed = [doc for st, doc in results if st == 429]
+            assert "overloaded" in shed[0]["error"]
+            assert srv.stats.shed_429_total >= 1
+            return True
+
+        out, code, _ = run_with_server(
+            body, coalesce_ms=150.0, max_pending=2, cache_size=0)
+        assert out and code == 0
+
+
+class TestDrain:
+    def test_drain_answers_inflight_then_exits_0(self):
+        async def main():
+            srv = EmbeddingServer(_engine(), ServeConfig(
+                coalesce_ms=200.0, cache_size=0, drain_deadline_s=5.0))
+            await srv.start()
+            # park queries inside the coalescing window, then drain
+            pending = [asyncio.ensure_future(_http(
+                srv.port, "POST", "/v1/query",
+                {"op": "neighbors", "word": w, "k": 2})) for w in WORDS[:4]]
+            await asyncio.sleep(0.05)
+            srv.begin_drain()
+            code = await srv.run()
+            answered = await asyncio.gather(*pending)
+            return answered, code, srv
+
+        answered, code, srv = asyncio.run(main())
+        # NO dropped in-flight requests: every accepted query got a 200
+        assert [st for st, _ in answered] == [200] * 4
+        assert code == 0 and srv.exit_reason == "drained"
+
+    def test_second_drain_forces_75(self):
+        from word2vec_tpu.resilience.shutdown import EXIT_PREEMPTED
+
+        async def main():
+            srv = EmbeddingServer(_engine(), ServeConfig(
+                drain_deadline_s=60.0))
+            await srv.start()
+            srv.begin_drain()
+            srv.begin_drain()     # the operator's second SIGTERM
+            return await srv.run(), srv
+
+        code, srv = asyncio.run(main())
+        assert code == EXIT_PREEMPTED and srv.exit_reason == "forced"
+
+    def test_draining_refuses_new_queries(self):
+        """A keep-alive connection accepted BEFORE drain that submits a new
+        query DURING drain gets 503 draining (fresh connections are refused
+        outright by the closed listener)."""
+
+        async def main():
+            srv = EmbeddingServer(_engine(), ServeConfig(
+                coalesce_ms=100.0, drain_deadline_s=5.0))
+            await srv.start()
+            port = srv.port
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            hold = asyncio.ensure_future(_http(
+                port, "POST", "/v1/query",
+                {"op": "neighbors", "word": "king", "k": 2}))
+            await asyncio.sleep(0.02)
+            srv.begin_drain()
+            body = json.dumps(
+                {"op": "neighbors", "word": "queen", "k": 2}).encode()
+            w.write((f"POST /v1/query HTTP/1.1\r\nHost: t\r\n"
+                     f"Content-Length: {len(body)}\r\n\r\n"
+                     ).encode() + body)
+            await w.drain()
+            status_line = await r.readline()
+            st = int(status_line.split()[1])
+            raw = b""
+            while b"\r\n\r\n" not in raw:
+                raw += await r.read(256)
+            w.close()
+            code = await srv.run()
+            st_held, _ = await hold
+            return st, st_held, code
+
+        st, st_held, code = asyncio.run(main())
+        assert st == 503          # late query on a pre-drain connection
+        assert st_held == 200     # the accepted one still finished
+        assert code == 0
+
+
+class TestChaos:
+    def test_oom_fault_fails_batch_503_server_survives(self):
+        async def body(srv):
+            st1, doc1 = await _http(
+                srv.port, "GET", "/v1/neighbors?word=king&k=2")
+            st2, doc2 = await _http(
+                srv.port, "GET", "/v1/neighbors?word=queen&k=2")
+            return (st1, doc1), (st2, doc2)
+
+        (st1, doc1), (st2, doc2) = run_with_server(
+            body, coalesce_ms=0.5, cache_size=0,
+            faults=FaultPlan.parse("oom:times=1"))[0]
+        assert st1 == 503 and "allocation failure" in doc1["error"]
+        assert st2 == 200 and doc2["neighbors"]
+
+    def test_stall_fault_keeps_healthz_live(self):
+        async def body(srv):
+            t0 = asyncio.get_event_loop().time()
+            slow = asyncio.ensure_future(_http(
+                srv.port, "GET", "/v1/neighbors?word=king&k=2"))
+            await asyncio.sleep(0.1)
+            st, h = await _http(srv.port, "GET", "/healthz")
+            dt = asyncio.get_event_loop().time() - t0
+            assert st == 200 and h["ok"] and dt < 0.5   # healthz unblocked
+            st_slow, _ = await slow
+            assert st_slow == 200
+            return True
+
+        out, code, _ = run_with_server(
+            body, coalesce_ms=0.5, cache_size=0,
+            faults=FaultPlan.parse("stall@1:secs=0.4"))
+        assert out and code == 0
+
+    def test_unservable_fault_kind_rejected(self):
+        with pytest.raises(ValueError, match="not servable"):
+            EmbeddingServer(_engine(), ServeConfig(
+                faults=FaultPlan.parse("nan@1")))
+
+
+class TestObservability:
+    def test_metrics_stats_trace_flight(self, tmp_path):
+        mdir = str(tmp_path / "mdir")
+        tdir = str(tmp_path / "tdir")
+
+        async def body(srv):
+            for w in ("king", "queen", "king"):
+                await _http(srv.port, "POST", "/v1/query",
+                            {"op": "neighbors", "word": w, "k": 2})
+            st, stats = await _http(srv.port, "GET", "/stats")
+            st2, prom = await _http(srv.port, "GET", "/metrics")
+            return stats, prom
+
+        (stats, prom), code, srv = run_with_server(
+            body, coalesce_ms=0.5, metrics_dir=mdir, trace_dir=tdir,
+            stats_every_s=60.0)
+        assert code == 0
+        assert stats["serve_requests_total"] >= 3
+        assert stats["serve_cache_hits"] >= 1
+        for field in ("w2v_serve_p50_ms", "w2v_serve_p99_ms",
+                      "w2v_serve_qps", "w2v_serve_cache_hit_rate",
+                      "w2v_serve_batch_fill_mean"):
+            assert field in prom, prom
+        # exported trace validates against the PR 6 schema and carries
+        # request + batch spans
+        from word2vec_tpu.obs.trace import load_trace, validate_trace_doc
+
+        doc = load_trace(str(tmp_path / "tdir" / "trace.json"))
+        counts = validate_trace_doc(doc)
+        assert counts.get("X", 0) >= 2
+        names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert "request" in names and "step" in names
+        # flight.json landed on the drain path with serve stats attached
+        fl = json.load(open(str(tmp_path / "mdir" / "flight.json")))
+        assert fl["reason"] == "drained" and fl["exit_code"] == 0
+        assert fl["stats"]["serve_requests_total"] >= 3
+        validate_trace_doc(fl["trace"])
+        # prom textfile persisted too
+        assert (tmp_path / "mdir" / "serve.prom").exists()
+
+    def test_request_timeout_504(self):
+        async def body(srv):
+            st, doc = await _http(
+                srv.port, "GET", "/v1/neighbors?word=king&k=2")
+            return st, doc
+
+        (st, doc), code, _ = run_with_server(
+            body, coalesce_ms=0.5, cache_size=0, request_timeout_s=0.05,
+            faults=FaultPlan.parse("stall@1:secs=0.5"))
+        assert st == 504 and "timed out" in doc["error"]

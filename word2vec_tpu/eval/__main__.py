@@ -27,7 +27,11 @@ from .similarity import evaluate_pairs, load_word_pairs
 
 
 def _load(args) -> tuple:
-    if args.binary:
+    if args.int8:
+        from ..io.embeddings import load_embeddings_int8
+
+        words, W = load_embeddings_int8(args.vectors)
+    elif args.binary:
         words, W = load_embeddings_binary(args.vectors, layout=args.binary_layout)
     else:
         words, W = load_embeddings_text(args.vectors)
@@ -41,6 +45,9 @@ def main(argv=None) -> int:
                     help="vectors file is binary (default: text)")
     ap.add_argument("--binary-layout", choices=["reference", "google"],
                     default="reference")
+    ap.add_argument("--int8", action="store_true",
+                    help="vectors file is the int8 symmetric-quantized "
+                    "container (io/embeddings; dequantized on load)")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("neighbors", help="top-k cosine neighbors (distance.c)")

@@ -105,7 +105,12 @@ def evaluate_analogy_sections(
     if method not in ("3cosadd", "3cosmul"):
         raise ValueError(f"method must be 3cosadd or 3cosmul, got {method!r}")
     V = min(len(vocab), restrict_vocab) if restrict_vocab else len(vocab)
-    Wn = W[:V] / np.maximum(np.linalg.norm(W[:V], axis=1, keepdims=True), 1e-12)
+    # shared query kernel (serve/query): the restricted table is
+    # row-normalized once and resident on device; score planes come back
+    # as writable [chunk, V] f32 arrays for the mask/rank math below.
+    from ..serve.query import get_engine
+
+    eng = get_engine(W, vocab, restrict=V)
 
     correct = total = skipped = degenerate = 0
     rank_sum = 0.0
@@ -128,16 +133,12 @@ def evaluate_analogy_sections(
             a, b, c, d = chunk.T
             if method == "3cosmul":
                 # all three candidate-cosine planes, shifted to [0, 1]
-                ca = (Wn[a] @ Wn.T + 1.0) / 2.0
-                cb = (Wn[b] @ Wn.T + 1.0) / 2.0
-                cc = (Wn[c] @ Wn.T + 1.0) / 2.0
+                ca = (eng.cosine_planes(a) + 1.0) / 2.0
+                cb = (eng.cosine_planes(b) + 1.0) / 2.0
+                cc = (eng.cosine_planes(c) + 1.0) / 2.0
                 sims = cb * cc / (ca + 1e-3)  # [chunk, V]
             else:
-                query = Wn[b] - Wn[a] + Wn[c]
-                query /= np.maximum(
-                    np.linalg.norm(query, axis=1, keepdims=True), 1e-12
-                )
-                sims = query @ Wn.T  # [chunk, V]
+                sims = eng.analogy_planes(a, b, c)  # [chunk, V]
             rows = np.arange(len(chunk))
             sims[rows, a] = -np.inf  # exclude question words
             sims[rows, b] = -np.inf

@@ -1,6 +1,14 @@
 """Nearest-neighbor queries — the `distance` tool the reference lacks
 (SURVEY §3.5: "no nearest-neighbor query ... equivalents from the original
 google toolkit").
+
+Since the serving PR these are thin shims over the shared jit'd batched
+top-k kernel (serve/query.QueryEngine) — the same code path the async
+server and the analogy evaluator use. The engine cache means two
+successive queries against the same exported array normalize the table
+ONCE instead of recomputing `W / ||W||` per call (pinned by a regression
+test), and tied scores come back in deterministic ascending-index order
+instead of argpartition's arbitrary one.
 """
 
 from __future__ import annotations
@@ -16,29 +24,15 @@ def nearest_neighbors(
     W: np.ndarray, vocab: Vocab, word: str, k: int = 10
 ) -> List[Tuple[str, float]]:
     """Top-k cosine neighbors of `word`, excluding itself."""
-    if word not in vocab:
-        raise KeyError(f"{word!r} not in vocabulary")
-    Wn = W / np.maximum(np.linalg.norm(W, axis=1, keepdims=True), 1e-12)
-    sims = Wn @ Wn[vocab[word]]
-    sims[vocab[word]] = -np.inf
-    top = np.argpartition(-sims, min(k, len(sims) - 1))[:k]
-    top = top[np.argsort(-sims[top])]
-    return [(vocab.words[i], float(sims[i])) for i in top]
+    from ..serve.query import get_engine
+
+    return get_engine(W, vocab).neighbors_batch([word], k=k)[0]
 
 
 def analogy_query(
     W: np.ndarray, vocab: Vocab, a: str, b: str, c: str, k: int = 5
 ) -> List[Tuple[str, float]]:
     """a:b :: c:? via 3CosAdd (word-analogy tool equivalent)."""
-    for w in (a, b, c):
-        if w not in vocab:
-            raise KeyError(f"{w!r} not in vocabulary")
-    Wn = W / np.maximum(np.linalg.norm(W, axis=1, keepdims=True), 1e-12)
-    q = Wn[vocab[b]] - Wn[vocab[a]] + Wn[vocab[c]]
-    q /= max(np.linalg.norm(q), 1e-12)
-    sims = Wn @ q
-    for w in (a, b, c):
-        sims[vocab[w]] = -np.inf
-    top = np.argpartition(-sims, min(k, len(sims) - 1))[:k]
-    top = top[np.argsort(-sims[top])]
-    return [(vocab.words[i], float(sims[i])) for i in top]
+    from ..serve.query import get_engine
+
+    return get_engine(W, vocab).analogy_batch([(a, b, c)], k=k)[0]

@@ -176,7 +176,14 @@ def evaluate_pairs(
             gold.append(score)
     if not gold:
         return SimilarityResult(0.0, 0.0, 0, len(pairs))
-    sims = cosine_rows(W, np.asarray(idx_a), np.asarray(idx_b))
+    # the serve engine's resident normalized table: one unit_norm pass for
+    # every eval/serve query against this array, cosines on device as a
+    # pair-dot (rows are unit). cosine_rows stays as the host-side
+    # reference implementation (and for callers without a vocab).
+    from ..serve.query import get_engine
+
+    eng = get_engine(W, vocab)
+    sims = eng.pair_cosines(np.asarray(idx_a), np.asarray(idx_b))
     gold_arr = np.asarray(gold)
     return SimilarityResult(
         spearman=spearman(sims, gold_arr),

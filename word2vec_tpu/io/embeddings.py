@@ -205,6 +205,118 @@ def load_embeddings_binary(
     return words, mat
 
 
+# ------------------------------------------------------------- int8 export
+#: magic prefix of the int8 symmetric-quantized container (serve PR): an
+#: ASCII `W2V-INT8 rows cols` header line, then rows little-endian f32
+#: PER-ROW scales, then per word `word <cols int8 bytes>\n` records. Row i
+#: dequantizes as q[i] * scale[i]; symmetric quantization (no zero point)
+#: keeps cosine geometry — the serve engine renormalizes rows anyway.
+INT8_MAGIC = b"W2V-INT8"
+
+
+def quantize_rows_int8(matrix: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-row int8 quantization: scale[i] = max|row_i| / 127.
+
+    Returns (q int8 [rows, cols], scales f32 [rows]). All-zero rows get
+    scale 0 (dequantizing reproduces the zeros exactly). The round-trip
+    error bound |q * scale - row| <= scale / 2 is checked here — a
+    quantizer that silently violates its own contract would poison every
+    downstream serve result.
+    """
+    m = np.asarray(matrix, dtype=np.float32)
+    peak = np.abs(m).max(axis=1)
+    scales = (peak / 127.0).astype(np.float32)
+    safe = np.where(scales > 0, scales, 1.0).astype(np.float32)
+    q = np.clip(np.rint(m / safe[:, None]), -127, 127).astype(np.int8)
+    err = np.abs(q.astype(np.float32) * safe[:, None] - m)
+    bound = safe / 2.0 + 1e-6
+    if (err > bound[:, None]).any():
+        i = int(np.argmax((err > bound[:, None]).any(axis=1)))
+        raise ValueError(
+            f"int8 quantization violated its error bound on row {i}: "
+            f"max err {err[i].max():.3g} > scale/2 {bound[i]:.3g}"
+        )
+    return q, scales
+
+
+def save_embeddings_int8(
+    path: str, words: Sequence[str], matrix: np.ndarray
+) -> None:
+    """Write the int8 symmetric-quantized container (INT8_MAGIC docs)."""
+    q, scales = quantize_rows_int8(matrix)
+    if len(words) != q.shape[0]:
+        raise ValueError(f"{len(words)} words vs {q.shape[0]} rows")
+    with open(path, "wb") as f:
+        f.write(INT8_MAGIC + f" {q.shape[0]} {q.shape[1]}\n".encode())
+        f.write(scales.astype("<f4").tobytes())
+        for w, row in zip(words, q):
+            f.write(w.encode("utf-8") + b" " + row.tobytes() + b"\n")
+
+
+def load_embeddings_int8(
+    path: str, dequantize: bool = True
+) -> Tuple[List[str], np.ndarray]:
+    """Load the int8 container; returns (words, f32 matrix) by default, or
+    (words, int8 matrix) with the scales attached as `.scales` is NOT done —
+    pass dequantize=False to get (words, q, scales) as a 3-tuple instead.
+
+    Truncated/corrupt input raises ValueError naming the file, the field,
+    and the word index — the PR 4 loader contract (a partial download must
+    fail with a pointer, not a shape mismatch three frames deep).
+    """
+    with open(path, "rb") as f:
+        header = f.readline()
+        fields = header.split()
+        if len(fields) != 3 or fields[0] != INT8_MAGIC:
+            raise ValueError(
+                f"{path}: not an int8 embedding file (header {header!r}; "
+                f"expected '{INT8_MAGIC.decode()} rows cols')"
+            )
+        try:
+            rows, cols = int(fields[1]), int(fields[2])
+        except ValueError:
+            raise ValueError(
+                f"{path}: non-integer header dims {header!r}"
+            ) from None
+        if rows < 0 or cols <= 0:
+            raise ValueError(f"{path}: impossible dims {rows} x {cols}")
+        raw = f.read(rows * 4)
+        if len(raw) < rows * 4:
+            raise ValueError(
+                f"{path}: truncated scale header ({len(raw)} of {rows * 4} "
+                f"bytes for {rows} per-row scales)"
+            )
+        scales = np.frombuffer(raw, dtype="<f4").copy()
+        if not np.isfinite(scales).all() or (scales < 0).any():
+            raise ValueError(
+                f"{path}: corrupt scale header (non-finite or negative "
+                "per-row scale)"
+            )
+        words: List[str] = []
+        q = np.empty((rows, cols), dtype=np.int8)
+        for i in range(rows):
+            wb = bytearray()
+            while True:
+                c = f.read(1)
+                if not c or c == b" ":
+                    break
+                wb += c
+            word = wb.decode("utf-8", errors="replace")
+            raw = f.read(cols)
+            if len(raw) < cols:
+                raise ValueError(
+                    f"{path}: word #{i} ({word!r}): truncated row "
+                    f"({len(raw)} of {cols} int8 bytes; header promised "
+                    f"{rows} rows x {cols} cols)"
+                )
+            words.append(word)
+            q[i] = np.frombuffer(raw, dtype=np.int8)
+            f.read(1)  # '\n'
+    if not dequantize:
+        return words, q, scales  # type: ignore[return-value]
+    return words, q.astype(np.float32) * scales[:, None]
+
+
 def save_word2vec(
     path: str,
     vocab: Vocab,

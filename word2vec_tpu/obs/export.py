@@ -148,7 +148,13 @@ class PrometheusTextfile:
             return "-Inf"
         return repr(value)
 
-    def _write(self) -> None:
+    def render(self) -> str:
+        """The full text exposition as a string — the serve HTTP
+        `/metrics` endpoint returns this directly; `_write` persists the
+        same bytes to the textfile."""
+        return "\n".join(self._render_lines()) + "\n"
+
+    def _render_lines(self) -> List[str]:
         by_name: Dict[str, List] = {}
         for (name, labels), value in self._gauges.items():
             by_name.setdefault(name, []).append((labels, value))
@@ -173,9 +179,12 @@ class PrometheusTextfile:
         lines.append(f"# HELP {ts_name} unix time of the last exposition write")
         lines.append(f"# TYPE {ts_name} gauge")
         lines.append(f"{ts_name} {self._fmt(time.time())}")
+        return lines
+
+    def _write(self) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            f.write("\n".join(lines) + "\n")
+            f.write(self.render())
         os.replace(tmp, self.path)
 
     def close(self) -> None:

@@ -49,7 +49,10 @@ from typing import Dict, List, Optional
 #: fault kinds delivered at optimizer-step boundaries by the trainers
 STEP_KINDS = ("nan", "stall", "hang", "sigterm", "peer_dead")
 #: fault kinds delivered at named injection points via raise_if_active()
-EVENT_KINDS = ("ckpt_oserror",)
+#: (oom: an XLA RESOURCE_EXHAUSTED-shaped allocation failure — the serve
+#: batch executor's injection point; the server must fail the affected
+#: requests 503 and keep serving, never die)
+EVENT_KINDS = ("ckpt_oserror", "oom")
 KINDS = STEP_KINDS + EVENT_KINDS
 
 #: default `secs` per kind: a stall is a measured slow-batcher blip, a hang
@@ -269,4 +272,11 @@ def raise_if_active(kind: str, where: str = "") -> None:
     if _ACTIVE is not None and _ACTIVE.fire_event(kind, where):
         if kind == "ckpt_oserror":
             raise OSError(f"injected fault: {kind} at {where or 'checkpoint'}")
+        if kind == "oom":
+            # shaped like XLA's allocation failure so the catch sites that
+            # pattern-match RESOURCE_EXHAUSTED treat it as the real thing
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: injected fault: out of memory "
+                f"allocating device buffer at {where or 'oom'}"
+            )
         raise RuntimeError(f"injected fault: {kind}")

@@ -1,0 +1,19 @@
+"""Embedding serving subsystem: the "millions of users" leg (ROADMAP item 1).
+
+Training exports tables; this package answers queries against them:
+
+  query.py   — QueryEngine: a row-normalized table resident on device
+               (f32/bf16, int8 files dequantize on load) and ONE jit'd
+               batched top-k kernel behind every similarity / neighbor /
+               analogy query. eval/ is rewired onto the same engine, so
+               batch evaluation and online serving share one code path.
+  server.py  — asyncio HTTP/JSON server: request coalescing into padded
+               device batches, an LRU result cache, bounded-queue load
+               shedding (429), graceful SIGTERM drain (exit 0, or
+               EXIT_PREEMPTED=75 past the drain deadline), serve metrics
+               through obs/export.MetricsHub, request/batch spans on the
+               flight recorder's TraceRing, and FaultPlan chaos hooks.
+  __main__   — `python -m word2vec_tpu.serve --vectors vec.txt ...`
+"""
+
+from .query import QueryEngine, get_engine, unit_norm  # noqa: F401

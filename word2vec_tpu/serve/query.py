@@ -1,0 +1,323 @@
+"""The shared batched query kernel: one jit'd top-k matmul for everything.
+
+Before this module, the cosine/top-k math lived three times in eval/ —
+`neighbors.nearest_neighbors`, `neighbors.analogy_query`, and the analogy
+evaluator — each renormalizing the FULL table on every call (an O(V*d) host
+pass per query) and ranking with `np.argpartition`, whose tie order is
+unstable. The `QueryEngine` replaces all of them:
+
+  * the table is row-normalized ONCE (`unit_norm`) and placed on device,
+    resident for the engine's lifetime, in f32 or bf16 (int8 files
+    dequantize on load — io/embeddings.load_embeddings_int8);
+  * every query kind reduces to one shape: a weighted combination of up to
+    3 table rows (neighbors: +row_i; analogy a:b::c:? : -a +b +c),
+    renormalized, scored against the whole table as a `[B, V]` matmul with
+    f32 accumulation, query tokens masked to -inf, `jax.lax.top_k`;
+  * batch and k are padded to power-of-two buckets so a serving mix of
+    sizes reuses a handful of compiled programs instead of recompiling per
+    request shape;
+  * ties are returned in ascending-index order (host-side stable reorder of
+    the top-k slice), so tied scores have ONE documented order instead of
+    argpartition's arbitrary one.
+
+`get_engine(W, vocab)` is the module-level cache the eval/ shims use: same
+array object + same restriction -> same engine, so two successive
+`nearest_neighbors` calls normalize the table once (pinned by a regression
+test). The cache holds a weakref to W, never W itself — it cannot extend an
+exported table's lifetime. Mutating W in place is NOT observed; pass a
+fresh array (every exporter does) or build a QueryEngine directly.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import weakref
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.vocab import Vocab
+
+#: serving dtypes for the resident table (int8 is a FILE format — it
+#: dequantizes into one of these on load, the cross-dtype path)
+TABLE_DTYPES = ("float32", "bfloat16")
+
+
+def unit_norm(W: np.ndarray) -> np.ndarray:
+    """Row-normalize once, host-side, in f32 — THE normalization every
+    query path shares (the eval modules' former per-call `W / ||W||`)."""
+    W = np.asarray(W, dtype=np.float32)
+    return W / np.maximum(np.linalg.norm(W, axis=1, keepdims=True), 1e-12)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1)).bit_length()
+
+
+# ------------------------------------------------------------ jit kernels
+# Module-level jit'd functions taking the table as an argument: engines
+# with the same (V, d, dtype) share compiled programs.
+@jax.jit
+def _combine_queries(table, ids, w):
+    """[B, 3] row ids + weights -> [B, d] unit queries, f32.
+
+    Neighbors: ids=(i,i,i), w=(1,0,0). Analogy a:b::c:? : ids=(a,b,c),
+    w=(-1,1,1) — exactly `Wn[b] - Wn[a] + Wn[c]`, renormalized (3CosAdd).
+    Padding rows (ids=-1 clamped to 0, w=0) come out as zero queries.
+    """
+
+    rows = table[jnp.clip(ids, 0, table.shape[0] - 1)].astype(jnp.float32)
+    q = (w[:, :, None] * rows).sum(axis=1)
+    return q / jnp.maximum(
+        jnp.linalg.norm(q, axis=1, keepdims=True), 1e-12
+    )
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _topk_kernel(table, q, mask, k):
+    """[B, d] unit queries -> top-k (scores, ids) over the [V, d] table.
+
+    The ONE fused kernel behind every neighbor/analogy query: a [B, V]
+    cosine matmul with f32 accumulation (bf16 tables don't accumulate in
+    bf16), -inf masking of the query tokens (mask is [B, M] row ids, -1 =
+    no mask), then `jax.lax.top_k`.
+    """
+
+    scores = jax.lax.dot_general(
+        q.astype(table.dtype), table,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [B, V]
+    rows = jnp.arange(scores.shape[0])[:, None]
+    valid = mask >= 0
+    idx = jnp.where(valid, mask, 0)
+    # masked slots drop to -inf; invalid slots min() against +inf (no-op)
+    fill = jnp.where(valid, -jnp.inf, jnp.inf).astype(scores.dtype)
+    scores = scores.at[rows, idx].min(fill)
+    return jax.lax.top_k(scores, k)
+
+
+@jax.jit
+def _query_planes(table, ids, w):
+    """Full [B, V] cosine planes of combined queries (the analogy
+    evaluator's 3CosAdd path needs every candidate's score for gold-rank
+    math, not just the top k)."""
+
+    q = _combine_queries(table, ids, w)
+    return jax.lax.dot_general(
+        q.astype(table.dtype), table,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@jax.jit
+def _row_planes(table, ids):
+    """[B, V] cosine planes of raw table rows (3CosMul's ca/cb/cc)."""
+
+    q = table[ids]
+    return jax.lax.dot_general(
+        q, table, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@jax.jit
+def _pair_cosines(table, i, j):
+    """Per-pair cosine of rows i and j (rows are unit, so a plain dot)."""
+
+    a = table[i].astype(jnp.float32)
+    b = table[j].astype(jnp.float32)
+    return (a * b).sum(axis=-1)
+
+
+class QueryEngine:
+    """A row-normalized table resident on device + the batched kernels.
+
+    `restrict` keeps only the most frequent `restrict` rows (the analogy
+    evaluator's `restrict_vocab` protocol); words mapping past it are OOV
+    to this engine.
+    """
+
+    #: batch rows are padded to the next power of two up to this cap; a
+    #: bigger batch is split by the caller (the server's max_batch <= this)
+    MAX_BATCH_BUCKET = 1024
+
+    def __init__(
+        self,
+        W: np.ndarray,
+        vocab: Vocab,
+        table_dtype: str = "float32",
+        restrict: Optional[int] = None,
+    ):
+        if table_dtype not in TABLE_DTYPES:
+            raise ValueError(
+                f"table_dtype must be one of {TABLE_DTYPES}, got "
+                f"{table_dtype!r} (int8 is a file format: load it with "
+                "io/embeddings.load_embeddings_int8, it dequantizes here)"
+            )
+        self.vocab = vocab
+        V = W.shape[0] if restrict is None else min(W.shape[0], int(restrict))
+        Wn = unit_norm(np.asarray(W)[:V])
+        dt = jnp.bfloat16 if table_dtype == "bfloat16" else jnp.float32
+        self.table = jax.device_put(jnp.asarray(Wn, dtype=dt))
+        self.table_dtype = table_dtype
+        self.V, self.d = int(V), int(Wn.shape[1])
+
+    # ------------------------------------------------------------- lookup
+    def ids_of(self, words: Sequence[str]) -> np.ndarray:
+        """Word strings -> row ids; KeyError NAMES the missing word (the
+        eval CLI prints these verbatim)."""
+        out = np.empty(len(words), dtype=np.int32)
+        for n, w in enumerate(words):
+            if w not in self.vocab or self.vocab[w] >= self.V:
+                raise KeyError(f"{w!r} not in vocabulary")
+            out[n] = self.vocab[w]
+        return out
+
+    # ------------------------------------------------------ batched top-k
+    def batch_topk(
+        self, ids: np.ndarray, weights: np.ndarray, k: int
+    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """The serving entry point: [B, 3] ids + weights -> per-row
+        (indices, scores), already k-clamped, -inf-filtered, and
+        tie-stable (score desc, index asc). Pads B and k to power-of-two
+        buckets so the compiled-program set stays small."""
+        B = int(ids.shape[0])
+        if B == 0:
+            return []
+        if B > self.MAX_BATCH_BUCKET:
+            return (
+                self.batch_topk(ids[: self.MAX_BATCH_BUCKET],
+                                weights[: self.MAX_BATCH_BUCKET], k)
+                + self.batch_topk(ids[self.MAX_BATCH_BUCKET:],
+                                  weights[self.MAX_BATCH_BUCKET:], k)
+            )
+        k = max(1, min(int(k), self.V))
+        kb = min(self.V, _next_pow2(k))
+        Bb = _next_pow2(B)
+        ids_p = np.full((Bb, 3), -1, dtype=np.int32)
+        w_p = np.zeros((Bb, 3), dtype=np.float32)
+        ids_p[:B] = ids
+        w_p[:B] = weights
+        q = _combine_queries(self.table, ids_p, w_p)
+        vals, top = _topk_kernel(self.table, q, ids_p, kb)
+        vals = np.asarray(vals)[:B]
+        top = np.asarray(top)[:B]
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
+        for r in range(B):
+            v, t = vals[r], top[r]
+            keep = np.isfinite(v)
+            v, t = v[keep], t[keep]
+            # deterministic tie order: score desc, then index asc (lexsort's
+            # last key is primary). top_k output is already score-sorted, so
+            # this only reorders WITHIN tied runs.
+            order = np.lexsort((t, -v))[:k]
+            out.append((t[order], v[order]))
+        return out
+
+    # -------------------------------------------------------- query kinds
+    def neighbors_batch(
+        self, words: Sequence[str], k: int = 10
+    ) -> List[List[Tuple[str, float]]]:
+        """Top-k cosine neighbors per word, the word itself masked."""
+        wid = self.ids_of(words)
+        ids = np.stack([wid, wid, wid], axis=1)
+        w = np.tile(np.array([[1.0, 0.0, 0.0]], np.float32), (len(wid), 1))
+        return [self._decode(t, v) for t, v in self.batch_topk(ids, w, k)]
+
+    def analogy_batch(
+        self, triples: Sequence[Tuple[str, str, str]], k: int = 5
+    ) -> List[List[Tuple[str, float]]]:
+        """a:b :: c:? by 3CosAdd per triple; a, b, c masked."""
+        flat = [w for t in triples for w in t]
+        wid = self.ids_of(flat).reshape(-1, 3)
+        w = np.tile(np.array([[-1.0, 1.0, 1.0]], np.float32), (len(wid), 1))
+        return [self._decode(t, v) for t, v in self.batch_topk(wid, w, k)]
+
+    def similarity_batch(
+        self, pairs: Sequence[Tuple[str, str]]
+    ) -> List[float]:
+        """Cosine per (word, word) pair."""
+        flat = [w for p in pairs for w in p]
+        wid = self.ids_of(flat).reshape(-1, 2)
+        return [float(x) for x in np.asarray(
+            _pair_cosines(self.table, wid[:, 0], wid[:, 1])
+        )]
+
+    def _decode(
+        self, idx: np.ndarray, scores: np.ndarray
+    ) -> List[Tuple[str, float]]:
+        words = self.vocab.words
+        return [(words[int(i)], float(s)) for i, s in zip(idx, scores)]
+
+    # ------------------------------------------------- eval-harness planes
+    def pair_cosines(self, i: np.ndarray, j: np.ndarray) -> np.ndarray:
+        """Cosines of row pairs by index (similarity.evaluate_pairs)."""
+        return np.array(_pair_cosines(
+            self.table, np.asarray(i, np.int32), np.asarray(j, np.int32)
+        ))
+
+    def analogy_planes(
+        self, a: np.ndarray, b: np.ndarray, c: np.ndarray
+    ) -> np.ndarray:
+        """[B, V] 3CosAdd score planes, unmasked and WRITABLE (the analogy
+        evaluator applies its own exclusion mask and rank math)."""
+        ids = np.stack([a, b, c], axis=1).astype(np.int32)
+        w = np.tile(np.array([[-1.0, 1.0, 1.0]], np.float32), (len(ids), 1))
+        return np.array(_query_planes(self.table, ids, w))
+
+    def cosine_planes(self, ids: np.ndarray) -> np.ndarray:
+        """[B, V] cosine planes of table rows (3CosMul's three planes)."""
+        return np.array(_row_planes(
+            self.table, np.asarray(ids, np.int32)
+        ))
+
+
+# -------------------------------------------------------------- engine cache
+# The normalize-once contract for the eval/ shims: repeat queries against
+# the SAME exported array reuse one engine (and its one unit_norm pass +
+# one device table). Keyed on id(W) with a weakref guard — a recycled id
+# whose original array died is a miss, never a stale hit.
+_CACHE_LOCK = threading.Lock()
+_CACHE_CAP = 4
+_ENGINE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+
+
+def get_engine(
+    W: np.ndarray,
+    vocab: Vocab,
+    table_dtype: str = "float32",
+    restrict: Optional[int] = None,
+) -> QueryEngine:
+    """The cached-engine entry point eval/ uses (see module docstring)."""
+    W = np.asarray(W)
+    key = (id(W), id(vocab), table_dtype, restrict)
+    with _CACHE_LOCK:
+        hit = _ENGINE_CACHE.get(key)
+        if hit is not None:
+            ref, eng = hit
+            if ref() is W:
+                _ENGINE_CACHE.move_to_end(key)
+                return eng
+            del _ENGINE_CACHE[key]
+    eng = QueryEngine(W, vocab, table_dtype=table_dtype, restrict=restrict)
+    with _CACHE_LOCK:
+        try:
+            _ENGINE_CACHE[key] = (weakref.ref(W), eng)
+        except TypeError:
+            # a non-weakref-able array subclass: serve it uncached
+            return eng
+        while len(_ENGINE_CACHE) > _CACHE_CAP:
+            _ENGINE_CACHE.popitem(last=False)
+    return eng
+
+
+def clear_engine_cache() -> None:
+    """Drop every cached engine (tests; also frees the device tables)."""
+    with _CACHE_LOCK:
+        _ENGINE_CACHE.clear()

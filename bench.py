@@ -257,6 +257,21 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     flight = FlightRecorder()
     phases = PhaseRecorder(tracer=flight.ring)
 
+    # In-training quality probe (obs/quality.py): at --quality-every chunk
+    # boundaries the live table is scored (planted golds when the corpus
+    # has them — the zipf stream doesn't, so this is stats-only: row norms,
+    # neighbor drift, effective rank) and the row sequence banks as
+    # `quality_curve`. Each probe adds one device fetch mid-measurement, so
+    # it is off by default on throughput runs.
+    qprobe = None
+    if args.quality_every:
+        from word2vec_tpu.obs.quality import ProbeSet, QualityProbe
+
+        qprobe = QualityProbe(
+            vocab, ProbeSet.synthesize(vocab), every=args.quality_every,
+            flight=flight,
+        )
+
     # Chunked dispatch (ops/train_step.make_chunk_runner): S optimizer steps
     # per device program, so per-dispatch overhead — which through the remote
     # tunnel costs ~4-5x the 8 ms device step — amortizes to noise. The
@@ -340,6 +355,9 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         now = time.perf_counter()
         flight.note_step(steps, t_chunk, now - t_chunk, kind="chunk", steps=S)
         t_chunk = now
+        if qprobe is not None and qprobe.due(steps):
+            with phases.span("quality_probe"):
+                qprobe.probe(params, steps)
         if args.measure_steps and steps >= args.measure_steps:
             break
     with phases.span("device_wait"):
@@ -455,6 +473,11 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
             include_config=False,
         ),
     }
+    if qprobe is not None:
+        # the probe-row sequence over the measured epoch: how the table's
+        # health statistics (and planted scores, when the corpus has golds)
+        # moved while the throughput number was being taken
+        record["quality_curve"] = [dict(r) for r in qprobe.history]
     if plan_res is not None:
         record["plan_cache_hit"] = plan_res.source == "cache"
         if plan_res.probes:
@@ -665,6 +688,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "halves table gather/scatter bytes)")
     ap.add_argument("--sr", type=int, default=0, choices=[0, 1],
                     help="stochastic rounding of table updates (bf16 tables)")
+    ap.add_argument("--quality-every", type=int, default=0, metavar="STEPS",
+                    help="bank an in-training quality_curve: probe the "
+                    "live table every STEPS optimizer steps "
+                    "(obs/quality.py — planted scores when the corpus has "
+                    "golds, else row-norm/drift/effective-rank stats). "
+                    "Each probe adds one device fetch mid-measurement, so "
+                    "0 (off) is the throughput default")
     ap.add_argument("--health", type=int, default=0, choices=[0, 1],
                     help="bank the full on-device health counters "
                     "(grad-norm, per-table update magnitudes) in the "
@@ -879,6 +909,7 @@ def main() -> None:
         ("--table-layout", args.table_layout),
         ("--prng", args.prng), ("--table-dtype", args.table_dtype),
         ("--sr", args.sr), ("--health", args.health),
+        ("--quality-every", args.quality_every),
         ("--autotune", args.autotune), ("--plan-cache", args.plan_cache),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
     ]:

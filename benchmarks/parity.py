@@ -174,6 +174,9 @@ def eval_analogy_vectors(path: str, questions) -> dict:
         "correct": r.correct,
         "total": r.total,
         "skipped_oov": r.skipped_oov,
+        # unanswerable-by-construction questions (gold repeats a question
+        # word): banked so a degenerate probe set can't pass silently
+        "skipped_degenerate": r.skipped_degenerate,
         # continuous sensitivity metric: stays informative after both sides
         # reach accuracy 1.0 (the instrument must not saturate)
         "mean_gold_rank": round(r.mean_gold_rank, 3),

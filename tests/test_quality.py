@@ -1,0 +1,513 @@
+"""Online quality telemetry (obs/quality.py): in-training probes, the
+degeneracy sentinel, kernel auto-selection, and their wiring contracts.
+
+The contracts pinned here: probe records are DETERMINISTIC under a fixed
+seed; non-probe steps add ZERO device syncs (due() is one integer compare —
+the dispatch-count tests); a sharded (2, 2)-mesh probe scores the same
+record a single-host probe of the same params does; the sentinel escalates
+warn -> checkpoint-and-continue -> QualityAlert per the budget and the CLI
+maps the alert to rc=3 (EXIT_QUALITY) with the probe rows in flight.json;
+and kernel='auto' inside the measured band degeneracy domain selects 'pair'
+instead of warning (BAND_DEGENERACY_r5.md / ROADMAP item 5)."""
+
+import json
+import statistics
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.obs.quality import (
+    EXIT_QUALITY, ProbeSet, QualityAlert, QualityProbe, QualitySentinel,
+    score_table,
+)
+from word2vec_tpu.train import Trainer
+from word2vec_tpu.utils.synthetic import (
+    analogy_corpus, graded_pair_corpus, planted_probe_golds, topic_corpus,
+)
+
+
+@pytest.fixture(scope="module")
+def graded_setup():
+    """A graded-overlap corpus whose vocabulary carries recoverable probe
+    golds (g{k}a/g{k}b naming)."""
+    tokens, gpairs = graded_pair_corpus(n_pairs=8, n_tokens=30_000, seed=0)
+    sents = [tokens[i:i + 50] for i in range(0, len(tokens), 50)]
+    vocab = Vocab.build(sents, min_count=1)
+    return vocab, sents, gpairs
+
+
+def make_trainer(graded_setup, log_fn=None, **kw):
+    vocab, sents, _ = graded_setup
+    cfg = Word2VecConfig(
+        word_dim=16, window=2, min_count=1, negative=3, batch_rows=8,
+        max_sentence_len=32, subsample_threshold=0, **kw,
+    )
+    corpus = PackedCorpus.pack(
+        vocab.encode_corpus(sents), cfg.max_sentence_len
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # tiny-corpus geometry advice
+        return Trainer(cfg, vocab, corpus, log_fn=log_fn)
+
+
+# -------------------------------------------------------- probe-set golds
+
+def test_planted_golds_recovered_from_graded_vocab(graded_setup):
+    vocab, _, gpairs = graded_setup
+    pairs, questions = planted_probe_golds(list(vocab.words))
+    assert len(pairs) == len(gpairs) and not questions
+    # gold = k preserves the alphas' linspace rank order exactly
+    ks = [g for _, _, g in pairs]
+    assert ks == sorted(ks)
+    pset = ProbeSet.synthesize(vocab)
+    assert pset.source == "planted" and len(pset.pairs) == len(gpairs)
+
+
+def test_planted_golds_recovered_from_analogy_grid():
+    tokens, questions = analogy_corpus(
+        n_rows=3, n_cols=3, words_per_pool=4, n_tokens=5_000, seed=0
+    )
+    words = sorted(set(tokens))
+    pairs, qs = planted_probe_golds(words, max_questions=40)
+    assert not pairs and 0 < len(qs) <= 40
+    assert all(q in set(questions) for q in qs)
+
+
+def test_planted_golds_recovered_from_topic_vocab():
+    tokens, _ = topic_corpus(n_topics=3, words_per_topic=6, n_tokens=4_000)
+    pairs, qs = planted_probe_golds(sorted(set(tokens)))
+    assert pairs and not qs
+    golds = {g for _, _, g in pairs}
+    assert golds == {0.0, 1.0}  # two-level same/cross-topic
+
+
+def test_unplanted_vocab_is_stats_only():
+    vocab = Vocab.build([[f"word{i}" for i in range(30)]], min_count=1)
+    pset = ProbeSet.synthesize(vocab)
+    assert pset.source == "stats-only"
+    assert not pset.pairs and not pset.analogies and pset.tracked
+
+
+def test_probe_set_from_files(tmp_path, graded_setup):
+    vocab, _, gpairs = graded_setup
+    pfile = tmp_path / "pairs.csv"
+    pfile.write_text("".join(f"{a},{b},{g}\n" for a, b, g in gpairs))
+    qfile = tmp_path / "qs.txt"
+    qfile.write_text(": planted\ng0a g0b g1a g1b\n")
+    pset = ProbeSet.from_files(vocab, str(pfile), str(qfile))
+    assert pset.source == "files"
+    assert len(pset.pairs) == len(gpairs) and len(pset.analogies) == 1
+    # tracked leads with the probe words themselves
+    assert pset.tracked[0] in {w for a, b, _ in gpairs for w in (a, b)}
+
+
+# ------------------------------------------------------------ determinism
+
+def test_score_table_deterministic(graded_setup):
+    vocab, _, _ = graded_setup
+    rng = np.random.default_rng(3)
+    W = rng.normal(size=(len(vocab), 16)).astype(np.float32)
+    pset = ProbeSet.synthesize(vocab)
+    r1, n1 = score_table(W, vocab, pset, seed=0)
+    r2, n2 = score_table(W.copy(), vocab, pset, seed=0)
+    assert r1 == r2
+    assert all(np.array_equal(n1[i], n2[i]) for i in n1)
+
+
+def test_probe_deterministic_under_fixed_seed(graded_setup):
+    tr = make_trainer(graded_setup)
+    state = tr.init_state()
+    vocab = tr.vocab
+    recs = []
+    for _ in range(2):
+        probe = QualityProbe(vocab, ProbeSet.synthesize(vocab), every=1)
+        recs.append(probe.probe(state.params, step=7))
+    a, b = recs
+    a.pop("quality_probe_ms"), b.pop("quality_probe_ms")
+    assert a == b
+
+
+# ------------------------------------------------------- probe record body
+
+def test_probe_record_fields_and_rings(graded_setup):
+    logs = []
+    tr = make_trainer(graded_setup, log_fn=logs.append,
+                      quality_probe_every=5)
+    assert tr.quality_probe is not None
+    state, rep = tr.train(log_every=0)
+    assert tr.quality_probe.probes == rep.steps // 5
+    rows = [r for r in logs if "quality_row_norm_p50" in r]
+    assert rows
+    last = rows[-1]
+    for key in ("quality_spearman", "quality_pairs_used",
+                "quality_row_norm_p50", "quality_row_norm_p99",
+                "quality_norm_ratio_in_out", "quality_effective_rank",
+                "quality_probe_ms", "step"):
+        assert key in last, f"probe record lost {key!r}"
+    # drift appears from the second probe on
+    assert "quality_drift_jaccard_mean" in last
+    # counter events for the present-from-zero Prometheus counters
+    assert sum(r.get("event") == "quality_probe" for r in logs) == \
+        tr.quality_probe.probes
+    # probe spans + 'C' counters on the trace timeline
+    names = {e["name"] for e in tr.flight.ring.events()}
+    assert "quality_probe" in names and "quality" in names
+    # the quality ring rides every flight snapshot
+    snap = tr.flight.snapshot("test")
+    assert snap["quality"] and snap["quality"][-1]["step"] == last["step"]
+
+
+def test_probe_fires_at_chunk_boundaries(graded_setup):
+    """Distance-based due(): chunked dispatch advances the step counter by
+    whole chunks and must not step over a probe boundary."""
+    logs = []
+    tr = make_trainer(graded_setup, log_fn=logs.append,
+                      quality_probe_every=3, chunk_steps=5)
+    state, rep = tr.train(log_every=0)
+    assert tr.quality_probe.probes >= rep.steps // 5  # every chunk crosses
+
+
+# --------------------------------------------------------- dispatch counts
+
+def counting_device_get(monkeypatch):
+    calls = {"n": 0}
+    real = jax.device_get
+
+    def counted(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counted)
+    return calls
+
+
+def test_non_probe_steps_add_zero_syncs(graded_setup, monkeypatch):
+    """Acceptance pin: an attached probe whose cadence never fires adds NO
+    device_get beyond the baseline lagged drain, and a firing cadence adds
+    exactly ONE fetch per probe."""
+    tr = make_trainer(graded_setup, chunk_steps=1)
+    calls = counting_device_get(monkeypatch)
+    state, rep = tr.train(log_every=0)
+    baseline = calls["n"]
+
+    tr_idle = make_trainer(graded_setup, chunk_steps=1,
+                           quality_probe_every=10_000)  # never due
+    calls["n"] = 0
+    tr_idle.train(log_every=0)
+    assert calls["n"] == baseline  # zero added syncs on non-probe steps
+
+    tr_probe = make_trainer(graded_setup, chunk_steps=1,
+                            quality_probe_every=25)
+    calls["n"] = 0
+    state, rep = tr_probe.train(log_every=0)
+    probes = tr_probe.quality_probe.probes
+    assert probes > 0
+    assert calls["n"] == baseline + probes  # one table fetch per probe
+
+
+# ----------------------------------------------------------- sharded parity
+
+def test_sharded_22_mesh_probe_parity_with_single_host(graded_setup):
+    """A (dp=2, tp=2) mesh probe scores the SAME record a single-host probe
+    of the same params does: _probe_params exports the synced,
+    de-replicated table, so the probe never sees shard layout."""
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    vocab, sents, _ = graded_setup
+    cfg = Word2VecConfig(
+        word_dim=16, window=2, min_count=1, negative=3, batch_rows=8,
+        max_sentence_len=32, subsample_threshold=0,
+    )
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), 32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        single = Trainer(cfg, vocab, corpus)
+        sharded = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2)
+    host_state = single.init_state()
+    sh_state = sharded.init_state()
+    sharded.import_params(
+        {k: np.asarray(v) for k, v in host_state.params.items()}, sh_state
+    )
+    pset = ProbeSet.synthesize(vocab)
+    p1 = QualityProbe(vocab, pset, every=1)
+    p2 = QualityProbe(vocab, pset, every=1)
+    r1 = p1.probe(single._probe_params(host_state), step=1)
+    r2 = p2.probe(sharded._probe_params(sh_state), step=1)
+    r1.pop("quality_probe_ms"), r2.pop("quality_probe_ms")
+    assert r1 == r2
+
+
+# ---------------------------------------------------------------- sentinel
+
+def test_sentinel_escalation_warn_checkpoint_alert():
+    s = QualitySentinel(budget=2, floor=0.5, in_domain=True)
+    acts = [s.observe({"quality_spearman": 0.9}, 0)]
+    with pytest.raises(QualityAlert) as exc:
+        for i, score in enumerate([0.2, 0.2, 0.2, 0.2]):
+            acts.append(s.observe({"quality_spearman": score}, i + 1))
+    assert acts == [None, "warn", "checkpoint", "warn"]
+    e = exc.value
+    assert e.streak == 4 and e.budget == 2 and e.in_domain
+    assert e.record()["event"] == "quality_alert"
+    assert "floor" in str(e)
+
+
+def test_sentinel_relative_drop_and_recovery():
+    s = QualitySentinel(budget=0, floor=0.1, drop=0.5)
+    assert s.observe({"quality_analogy_accuracy": 0.9}, 0) is None
+    # below (1 - drop) x peak -> degraded even though above the floor
+    assert s.observe({"quality_analogy_accuracy": 0.4}, 1) == "warn"
+    # recovery resets the streak (and re-arms checkpoint-and-continue)
+    assert s.observe({"quality_analogy_accuracy": 0.8}, 2) is None
+    assert s.streak == 0
+
+
+def test_sentinel_grace_defers_floor_only():
+    """The floor check arms after `grace` scored probes (early training
+    legitimately scores low); the relative-drop check is independent of
+    grace since it needs an established peak anyway."""
+    s = QualitySentinel(budget=0, floor=0.5, grace=2)
+    assert s.observe({"quality_spearman": 0.1}, 0) is None  # in grace
+    assert s.observe({"quality_spearman": 0.1}, 1) is None  # in grace
+    assert s.observe({"quality_spearman": 0.1}, 2) == "warn"
+    # drop check fires inside grace once a peak >= floor exists
+    s2 = QualitySentinel(budget=0, floor=0.5, drop=0.5, grace=10)
+    assert s2.observe({"quality_spearman": 0.9}, 0) is None
+    assert s2.observe({"quality_spearman": 0.2}, 1) == "warn"
+
+
+def test_sentinel_rank_collapse():
+    s = QualitySentinel(budget=0, rank_collapse=0.5)
+    assert s.observe({"quality_effective_rank": 40.0}, 0) is None
+    assert s.observe({"quality_effective_rank": 10.0}, 1) == "warn"
+    assert "effective rank" in s.last_reasons[0]
+
+
+def test_quality_alert_propagates_from_training(graded_setup):
+    """An impossible floor degrades every probe; budget 1 alerts at the
+    second — the alert escapes train() like DivergenceError, with the
+    alert record on the flight recorder's quality ring."""
+    logs = []
+    tr = make_trainer(graded_setup, log_fn=logs.append)
+    tr.quality_probe = QualityProbe(
+        tr.vocab, ProbeSet.synthesize(tr.vocab), every=5,
+        log_fn=logs.append, flight=tr.flight,
+        sentinel=QualitySentinel(budget=1, floor=1.01),
+    )
+    checkpoints = []
+    tr.quality_probe.checkpoint_fn = lambda: checkpoints.append(1)
+    with pytest.raises(QualityAlert) as exc:
+        tr.train(log_every=0)
+    assert exc.value.step == 10  # probes at 5 (checkpoint) and 10 (alert)
+    assert checkpoints == [1]  # checkpoint-and-continue fired once
+    events = [r.get("event") for r in logs if "event" in r]
+    assert "quality_checkpoint" in events and "quality_alert" in events
+    snap = tr.flight.snapshot("test")
+    assert any(
+        row.get("event") == "quality_alert" for row in snap["quality"]
+    )
+
+
+# ------------------------------------------------------- kernel selection
+
+def test_kernel_auto_selects_pair_in_degeneracy_domain():
+    from word2vec_tpu.tune.planner import degeneracy_domain, select_kernel
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(40)]
+    sents = [list(rng.choice(words, size=20)) for _ in range(3000)]
+    vocab = Vocab.build(sents, min_count=1)  # 40 words, 1500 occ/word
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), 32)
+
+    def build(kernel):
+        cfg = Word2VecConfig(
+            model="sg", train_method="ns", negative=3, word_dim=8,
+            min_count=1, batch_rows=8, max_sentence_len=32, kernel=kernel,
+        )
+        with warnings.catch_warnings(record=True) as wl:
+            warnings.simplefilter("always")
+            tr = Trainer(cfg, vocab, corpus)
+        return tr, [w for w in wl
+                    if "shared negative pool" in str(w.message)]
+
+    tr, warns = build("auto")
+    assert tr.config.resolved_kernel == "pair" and not warns
+    d = tr.kernel_decision
+    assert d["event"] == "kernel_auto_selection" and d["selected"] == "pair"
+    assert d["vocab_size"] == len(vocab) and d["occ_per_word"] >= 1000
+
+    # explicit band is the override: kept, with the (updated) warning
+    tr, warns = build("band")
+    assert tr.config.resolved_kernel == "band"
+    assert tr.kernel_decision is None
+    assert len(warns) == 1 and "FORCES" in str(warns[0].message)
+
+    # outside the domain the fence is quiet
+    cfg = Word2VecConfig(negative=3, kernel="auto")
+    assert not degeneracy_domain(cfg, 40, 1_000)       # occ too low
+    assert not degeneracy_domain(cfg, 100_000, 10**9)  # vocab too big
+    assert select_kernel(cfg, 100_000, 10**9) is None
+
+    # band-only levers are an explicit band opt-in: selection stands aside
+    # (a pair config would reject them), the static warning still covers it
+    cfg = Word2VecConfig(negative=3, kernel="auto", fused_tables=True)
+    assert select_kernel(cfg, 40, 10**6) is None
+    cfg = Word2VecConfig(negative=3, kernel="auto", table_layout="unified")
+    assert select_kernel(cfg, 40, 10**6) is None
+    with warnings.catch_warnings(record=True) as wl:
+        warnings.simplefilter("always")
+        tr = Trainer(
+            Word2VecConfig(
+                model="sg", train_method="ns", negative=3, word_dim=8,
+                min_count=1, batch_rows=8, max_sentence_len=32,
+                kernel="auto", table_layout="unified", chunk_steps=0,
+            ),
+            vocab, corpus,
+        )
+    assert tr.config.resolved_kernel == "band"  # no crash, band kept
+    assert any("shared negative pool" in str(w.message) for w in wl)
+
+
+# ------------------------------------------------------------ CLI contract
+
+@pytest.fixture
+def graded_corpus_file(tmp_path, graded_setup):
+    _, sents, _ = graded_setup
+    p = tmp_path / "graded.txt"
+    p.write_text(" ".join(w for s in sents for w in s))
+    return str(p)
+
+
+def test_cli_quality_telemetry_e2e(tmp_path, graded_corpus_file):
+    from word2vec_tpu.cli import main
+
+    mdir = str(tmp_path / "mdir")
+    rc = main([
+        "-train", graded_corpus_file, "-output", str(tmp_path / "v.txt"),
+        "-size", "16", "-window", "2", "-negative", "3", "-min-count", "1",
+        "-iter", "1", "--backend", "cpu", "--batch-rows", "8",
+        "--max-sentence-len", "32", "--metrics-dir", mdir,
+        "--quality-probe-every", "20", "--quiet",
+    ])
+    assert rc == 0
+    prom = open(f"{mdir}/metrics.prom").read()
+    assert "w2v_quality_probes_total" in prom
+    assert "w2v_quality_alerts_total 0.0" in prom  # present from zero
+    assert "w2v_quality_spearman" in prom
+    recs = [json.loads(l) for l in open(f"{mdir}/metrics.jsonl")]
+    probes = [r for r in recs if "quality_row_norm_p50" in r]
+    assert probes and any(
+        r.get("event") == "quality_probe" for r in recs
+    )
+
+
+def test_cli_quality_alert_rc3_with_flight(tmp_path, graded_corpus_file):
+    """The acceptance leg: sentinel escalation -> rc=3 (EXIT_QUALITY),
+    manifest shutdown=quality_degraded, flight.json reason=quality_alert
+    carrying the probe rows."""
+    from word2vec_tpu.cli import main
+
+    mdir = str(tmp_path / "mdir")
+    rc = main([
+        "-train", graded_corpus_file, "-output", str(tmp_path / "v.txt"),
+        "-size", "16", "-window", "2", "-negative", "3", "-min-count", "1",
+        "-iter", "2", "--backend", "cpu", "--batch-rows", "8",
+        "--max-sentence-len", "32", "--metrics-dir", mdir,
+        "--quality-probe-every", "10", "--quality-budget", "1",
+        "--quality-floor", "1.01", "--quiet",
+    ])
+    assert rc == EXIT_QUALITY == 3
+    man = json.load(open(f"{mdir}/manifest.json"))
+    assert man["shutdown"] == "quality_degraded"
+    assert man["quality_alert"]["event"] == "quality_alert"
+    fl = json.load(open(f"{mdir}/flight.json"))
+    assert fl["reason"] == "quality_alert"
+    assert fl["quality"], "flight dump lost the probe rows"
+    assert any("quality_spearman" in row for row in fl["quality"])
+    prom = open(f"{mdir}/metrics.prom").read()
+    assert "w2v_quality_alerts_total 1.0" in prom
+
+
+def test_serve_startup_records_reach_metrics(graded_setup):
+    """ServeConfig.startup_records: a startup quality probe's gauges are
+    servable on /metrics (the _MemoryProm render) from request zero."""
+    from word2vec_tpu.serve.query import QueryEngine
+    from word2vec_tpu.serve.server import EmbeddingServer, ServeConfig
+
+    vocab, _, _ = graded_setup
+    rng = np.random.default_rng(0)
+    W = rng.normal(size=(len(vocab), 8)).astype(np.float32)
+    rec, _ = score_table(W, vocab, ProbeSet.synthesize(vocab))
+    srv = EmbeddingServer(
+        QueryEngine(W, vocab),
+        ServeConfig(startup_records=[
+            rec, {"event": "quality_probe", "step": 0},
+        ]),
+    )
+    text = srv.prom.render()
+    assert "w2v_quality_spearman" in text
+    assert "w2v_quality_probes_total 1.0" in text
+
+
+# ------------------------------------------------------ overhead contract
+
+def test_probe_cadence_overhead_contract(graded_setup):
+    """The non-probe-step cost is one due() compare — well under 1% of a
+    step (the watchdog/trace contract shape; the wall A/B is banked by
+    benchmarks/quality_probe_overhead.py)."""
+    tr = make_trainer(graded_setup, chunk_steps=1,
+                      quality_probe_every=10_000)
+    state, rep = tr.train(log_every=0)
+    step_ms = sorted(
+        e["dur"] / 1e3 for e in tr.flight.ring.events()
+        if e.get("ph") == "X" and e["name"] == "step"
+    )
+    p50_s = statistics.median(step_ms) / 1e3
+    probe = tr.quality_probe
+    n = 100_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        probe.due(i)
+    per_check = (time.perf_counter() - t0) / n
+    assert per_check < 0.01 * p50_s, (
+        f"due() costs {per_check * 1e6:.2f}us vs p50 step "
+        f"{p50_s * 1e3:.2f}ms"
+    )
+
+
+# ----------------------------------------------------------- eval surfaces
+
+def test_eval_cli_surfaces_skipped_degenerate(tmp_path, capsys):
+    """Degenerate questions (gold repeats a question word) are counted and
+    SURFACED by the eval CLI instead of silently dropped."""
+    from word2vec_tpu.eval.__main__ import main as eval_main
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(6)]
+    vec = tmp_path / "vec.txt"
+    lines = [f"{len(words)} 4"]
+    for w in words:
+        vals = " ".join(f"{x:.5f}" for x in rng.normal(size=4))
+        lines.append(f"{w} {vals}")
+    vec.write_text("\n".join(lines) + "\n")
+    qs = tmp_path / "qs.txt"
+    qs.write_text(
+        ": s\n"
+        "w0 w1 w2 w3\n"     # scorable
+        "w0 w1 w2 w0\n"     # degenerate: gold repeats a question word
+        "w0 w1 w2 zzz\n"    # oov
+    )
+    rc = eval_main(["analogies", str(vec), str(qs)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["total"] == 1
+    assert out["skipped_degenerate"] == 1
+    assert out["skipped_oov"] == 1
+    assert "mean_gold_rank" in out

@@ -293,6 +293,48 @@ def build_parser() -> argparse.ArgumentParser:
                         "the step metrics (config.health_metrics; default: "
                         "on when --metrics-dir is set, else off — they cost "
                         "one extra table read per step)")
+    p.add_argument("--quality-probe-every", type=int, default=None,
+                   metavar="STEPS",
+                   help="in-training embedding-quality probe cadence "
+                        "(obs/quality.py): every STEPS optimizer steps, "
+                        "score a read-only view of the live tables "
+                        "(planted Spearman + analogy accuracy, Jaccard@k "
+                        "neighbor drift, row-norm/effective-rank health) "
+                        "through the serve query kernel and emit "
+                        "w2v_quality_* telemetry. Default: 100 when "
+                        "--metrics-dir or a --probe-* file is set, else "
+                        "off; 0 disables. Non-probe steps add zero device "
+                        "syncs")
+    p.add_argument("--probe-pairs", metavar="FILE",
+                   help="held-out word-pair golds for the quality probe "
+                        "(WS-353-shaped word1,word2,score lines); default: "
+                        "synthesized from planted-structure vocabularies "
+                        "(utils/synthetic.planted_probe_golds), stats-only "
+                        "otherwise")
+    p.add_argument("--probe-analogies", metavar="FILE",
+                   help="held-out analogy questions for the quality probe "
+                        "(questions-words.txt format)")
+    p.add_argument("--quality-budget", type=int, default=0, metavar="N",
+                   help="degeneracy-sentinel escalation budget "
+                        "(obs/quality.QualitySentinel): N consecutive "
+                        "degraded probes -> checkpoint-and-continue, 2N -> "
+                        "abort rc=3 with a QualityAlert in flight.json "
+                        "(mirrors the DivergenceError contract). 0 = warn "
+                        "only (default)")
+    p.add_argument("--quality-floor", type=float, default=0.1, metavar="F",
+                   help="sentinel absolute floor on the watched planted "
+                        "score (analogy accuracy, else Spearman); probes "
+                        "below it count as degraded")
+    p.add_argument("--quality-drop", type=float, default=0.5, metavar="F",
+                   help="sentinel relative-drop fraction: a probe below "
+                        "(1-F) x the score's own peak counts as degraded "
+                        "(the learn-then-collapse signature; needs a peak "
+                        ">= the floor first)")
+    p.add_argument("--quality-grace", type=int, default=2, metavar="N",
+                   help="scored probes ignored by the sentinel's absolute "
+                        "floor before it arms (early training legitimately "
+                        "scores low; the relative-drop check is always "
+                        "armed since it needs an established peak)")
     p.add_argument("--divergence-budget", type=int, default=8,
                    help="consecutive non-finite-loss steps before the run "
                         "aborts with a structured DivergenceError instead "
@@ -388,6 +430,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.sync_deadline < 0:
         print("error: --sync-deadline must be >= 0", file=sys.stderr)
         return 1
+    if args.quality_budget < 0:
+        print("error: --quality-budget must be >= 0", file=sys.stderr)
+        return 1
+    if args.quality_grace < 0:
+        print("error: --quality-grace must be >= 0", file=sys.stderr)
+        return 1
+    if args.quality_probe_every is not None and args.quality_probe_every < 0:
+        print("error: --quality-probe-every must be >= 0", file=sys.stderr)
+        return 1
+    # quality-probe cadence: on by default for instrumented runs
+    # (--metrics-dir) and whenever the user supplies probe material
+    q_every = args.quality_probe_every
+    if q_every is None:
+        q_every = 100 if (
+            args.metrics_dir or args.probe_pairs or args.probe_analogies
+        ) else 0
 
     # Resume: the checkpoint's config and vocab are authoritative — resuming
     # against a rebuilt vocab would silently re-attribute embedding rows; and
@@ -461,6 +519,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             else args.metrics_dir
         ),
         divergence_budget=args.divergence_budget,
+        quality_probe_every=q_every,
     )
     try:
         cfg = ck_cfg if ck_cfg is not None else Word2VecConfig(**flag_kwargs)
@@ -751,6 +810,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "corpus_tokens": corpus.num_tokens,
                 "corpus_rows": corpus.num_rows,
                 "resumed_from": args.resume or None,
+                # the kernel auto-selection record, when the degeneracy
+                # domain re-routed a kernel='auto' run to 'pair' (the
+                # manifest's "kernel" field already carries the realized
+                # choice; this is the WHY)
+                "kernel_decision": trainer.kernel_decision,
             },
         )
 
@@ -780,6 +844,57 @@ def main(argv: Optional[List[str]] = None) -> int:
                     args.checkpoint_dir, snap, trainer.config, vocab,
                     keep=args.checkpoint_keep,
                 )
+
+    # Quality-probe wiring: the CLI's flags are authoritative over the
+    # trainer's config-built default (telemetry is runtime wiring, like
+    # --metrics-dir — a resumed checkpoint must not pin it off). The probe
+    # logs through the run's hub, rides the trainer's flight recorder, and
+    # the sentinel escalates per --quality-budget; checkpoint-and-continue
+    # reuses the run's checkpoint callback.
+    from .obs.quality import (
+        EXIT_QUALITY, ProbeSet, QualityAlert, QualityProbe, QualitySentinel,
+    )
+
+    if q_every > 0:
+        from .tune.planner import degeneracy_domain
+
+        try:
+            pset = (
+                ProbeSet.from_files(
+                    vocab, args.probe_pairs, args.probe_analogies
+                )
+                if (args.probe_pairs or args.probe_analogies)
+                else ProbeSet.synthesize(vocab)
+            )
+        except (OSError, ValueError) as e:
+            print(f"error: bad probe file: {e}", file=sys.stderr)
+            return 1
+        trainer.quality_probe = QualityProbe(
+            vocab, pset, every=q_every, log_fn=log_fn,
+            flight=trainer.flight,
+            sentinel=QualitySentinel(
+                budget=args.quality_budget,
+                floor=args.quality_floor,
+                drop=args.quality_drop,
+                grace=args.quality_grace,
+                in_domain=degeneracy_domain(
+                    trainer.config, len(vocab), corpus.num_tokens
+                ),
+            ),
+        )
+        if ckpt_cb is not None:
+            trainer.quality_probe.checkpoint_fn = (
+                lambda: ckpt_cb(trainer.last_state)
+            )
+        if not args.quiet:
+            print(
+                f"quality probe: every {q_every} steps, "
+                f"{len(pset.pairs)} pairs + {len(pset.analogies)} "
+                f"analogies ({pset.source}), sentinel budget "
+                f"{args.quality_budget}"
+            )
+    else:
+        trainer.quality_probe = None
 
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
@@ -947,6 +1062,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         export_trace()
         hub.close()
         return 2
+    except QualityAlert as e:
+        # the degeneracy sentinel escalated past 2x its budget: structured
+        # abort, mirroring DivergenceError — manifest records why, the
+        # flight dump carries the probe rows that led here (the quality
+        # ring rides every snapshot), rc=3 (EXIT_QUALITY)
+        print(f"error: QualityAlert: {e}", file=sys.stderr)
+        if manifest_path:
+            update_manifest(manifest_path, {
+                "shutdown": "quality_degraded",
+                "quality_alert": e.record(),
+            })
+        dump_flight(
+            "quality_alert",
+            failure_step=getattr(trainer.last_state, "step", None) or e.step,
+        )
+        export_trace()
+        hub.close()
+        return EXIT_QUALITY
     except SyncTimeout as e:
         # Coordinated abort-to-requeue: a peer died or wedged and a bounded
         # collective timed out on THIS host. Every survivor takes this same
@@ -1142,7 +1275,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"WS-353 spearman: {r.spearman:.4f} ({r.pairs_used}/{r.pairs_total} pairs)")
         if args.eval_analogy:
             r = evaluate_analogies(W, vocab, args.eval_analogy)
-            print(f"analogy accuracy: {r.accuracy:.4f} ({r.correct}/{r.total})")
+            # skip counts are part of the verdict: a probe set full of
+            # OOV/degenerate rows must not read as a clean 0-question pass
+            print(
+                f"analogy accuracy: {r.accuracy:.4f} ({r.correct}/{r.total}"
+                f", {r.skipped_oov} oov-skipped, {r.skipped_degenerate} "
+                f"degenerate-skipped)"
+            )
     hub.close()
     return 0
 

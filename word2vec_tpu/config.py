@@ -332,6 +332,19 @@ class Word2VecConfig:
     # (--divergence-budget); the library default preserves run-to-the-end
     # semantics for existing callers.
     divergence_budget: int = 0
+    # In-training embedding-quality probe cadence in step-counter units —
+    # dispatch steps, like checkpoint_every/log_every; under micro-stepping
+    # one dispatch carries micro_steps optimizer sub-steps
+    # (obs/quality.QualityProbe): at each crossed boundary the trainers take
+    # a read-only view of the live tables and score planted Spearman /
+    # analogy accuracy / neighbor drift / health stats through the serve
+    # query kernel, emitting w2v_quality_* telemetry. 0 = off (the library
+    # default — a probe costs one device fetch of the tables; non-probe
+    # steps stay sync-free either way). The CLI turns it on for
+    # instrumented runs (--metrics-dir implies --quality-probe-every 100
+    # unless overridden) and can attach user probe files + the degeneracy
+    # sentinel (--probe-pairs/--probe-analogies/--quality-budget).
+    quality_probe_every: int = 0
 
     # --- multi-chip (no reference counterpart; replaces OpenMP Hogwild) ---
     # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
@@ -512,6 +525,8 @@ class Word2VecConfig:
             raise ValueError("chunk_cap must be >= 1")
         if self.divergence_budget < 0:
             raise ValueError("divergence_budget must be >= 0 (0 = off)")
+        if self.quality_probe_every < 0:
+            raise ValueError("quality_probe_every must be >= 0 (0 = off)")
         if self.prefetch_depth < 1:
             raise ValueError("prefetch_depth must be >= 1")
 

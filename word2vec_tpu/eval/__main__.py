@@ -151,6 +151,11 @@ def main(argv=None) -> int:
             "accuracy": res.accuracy,
             "correct": res.correct,
             "total": res.total,
+            # previously computed but silently dropped: a question file
+            # full of OOV/degenerate rows read as a clean 0-question pass
+            "skipped_oov": res.skipped_oov,
+            "skipped_degenerate": res.skipped_degenerate,
+            "mean_gold_rank": res.mean_gold_rank,
             "by_section": res.by_section,
         }))
     return 0

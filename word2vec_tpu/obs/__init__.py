@@ -1,5 +1,5 @@
-"""Telemetry subsystem (PR 3 + PR 6): health counters, phase timing,
-manifests, exporters, tracing, flight recorder.
+"""Telemetry subsystem (PR 3 + PR 6 + PR 9): health counters, phase timing,
+manifests, exporters, tracing, flight recorder, quality probes.
 
     obs.health    — on-device health counters inside the existing jit/scan
                     (instrument_step), the lagged-drain HealthMonitor, and
@@ -9,14 +9,19 @@ manifests, exporters, tracing, flight recorder.
     obs.manifest  — run manifests: realized plan/backend, device, versions,
                     git sha
     obs.export    — MetricsHub sink fan-out + the Prometheus textfile sink
-                    (gauges, resilience counters, exposition timestamp)
+                    (gauges, event counters, exposition timestamp)
     obs.trace     — step-scoped span tracing: bounded event ring,
                     Chrome-trace/Perfetto export, deterministic cross-host
                     merge by step index
     obs.flight    — always-on flight recorder: the last N steps of spans +
-                    counters + log records, dumped as flight.json on every
-                    failure path (divergence / stall / preemption / peer
-                    loss) and on demand via SIGUSR1
+                    counters + log records + quality-probe rows, dumped as
+                    flight.json on every failure path (divergence / stall /
+                    preemption / peer loss / quality alert) and on demand
+                    via SIGUSR1
+    obs.quality   — in-training embedding-quality probes (QualityProbe:
+                    planted Spearman/analogy, neighbor drift, effective
+                    rank through the serve query kernel) and the degeneracy
+                    sentinel (QualitySentinel -> QualityAlert, rc=3)
     obs.tracediff — `python -m word2vec_tpu.obs.tracediff A.json B.json`:
                     attribute a step-time delta between two traces to named
                     spans; also the trace_summary bench.py banks
@@ -30,6 +35,9 @@ from .flight import FlightRecorder
 from .health import DivergenceError, HealthMonitor, health_record
 from .manifest import manifest_dict, write_manifest
 from .phases import PhaseRecorder
+from .quality import (
+    ProbeSet, QualityAlert, QualityProbe, QualitySentinel, score_table,
+)
 from .trace import TraceRing, chrome_trace_doc, merge_traces, write_trace
 
 __all__ = [
@@ -42,6 +50,11 @@ __all__ = [
     "manifest_dict",
     "write_manifest",
     "PhaseRecorder",
+    "ProbeSet",
+    "QualityAlert",
+    "QualityProbe",
+    "QualitySentinel",
+    "score_table",
     "TraceRing",
     "chrome_trace_doc",
     "merge_traces",

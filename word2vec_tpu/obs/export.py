@@ -30,16 +30,22 @@ from typing import Callable, Dict, List, Optional
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 
-#: event-record kinds counted as monotonic resilience counters. The events
-#: arrive on the same hub the JSONL sees: the supervisor logs auto_recover,
-#: the trainers log resume_fallback, and cli.py feeds stalled / peer_lost
-#: on the corresponding abort paths (the stall path via the watchdog's
-#: flush_fn, since os._exit skips every atexit hook).
+#: event-record kinds counted as monotonic counters. The events arrive on
+#: the same hub the JSONL sees: the supervisor logs auto_recover, the
+#: trainers log resume_fallback, cli.py feeds stalled / peer_lost on the
+#: corresponding abort paths (the stall path via the watchdog's flush_fn,
+#: since os._exit skips every atexit hook), and the quality probe
+#: (obs/quality.py) logs quality_probe on every probe and quality_alert
+#: when the sentinel escalates past its budget. All present in the
+#: exposition from zero so a dashboard can alert on `increase()` without
+#: waiting for the first incident.
 EVENT_COUNTERS = {
     "auto_recover": "w2v_recoveries_total",
     "stalled": "w2v_stalls_total",
     "peer_lost": "w2v_peer_lost_total",
     "resume_fallback": "w2v_resume_fallbacks_total",
+    "quality_probe": "w2v_quality_probes_total",
+    "quality_alert": "w2v_quality_alerts_total",
 }
 
 
@@ -171,7 +177,7 @@ class PrometheusTextfile:
                 else:
                     lines.append(f"{name} {self._fmt(value)}")
         for name, value in self._counters.items():
-            lines.append(f"# HELP {name} word2vec_tpu resilience counter")
+            lines.append(f"# HELP {name} word2vec_tpu event counter")
             lines.append(f"# TYPE {name} counter")
             lines.append(f"{name} {self._fmt(value)}")
         # when this exposition was last rewritten (a scraper's liveness check)

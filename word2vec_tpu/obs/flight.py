@@ -69,6 +69,10 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self.counters: collections.deque = collections.deque(maxlen=self.steps)
         self.records: collections.deque = collections.deque(maxlen=self.steps)
+        #: last-N quality-probe rows (obs/quality.QualityProbe) — embedded
+        #: in every dump so a failure artifact shows the quality trajectory
+        #: that led there, not just the perf timeline
+        self.quality: collections.deque = collections.deque(maxlen=32)
         #: the last step boundary observed (None before any)
         self.last_step: Optional[int] = None
 
@@ -107,6 +111,12 @@ class FlightRecorder:
             "heartbeat", args={"at_step": int(step), "rows": clean}
         )
 
+    def note_quality(self, row: Dict) -> None:
+        """One quality-probe row (or sentinel alert record): the bounded
+        quality ring every flight.json dump carries."""
+        with self._lock:
+            self.quality.append(dict(row))
+
     def log_record(self, rec: Dict) -> None:
         """One log record (sink-compatible: the trainers' _log feeds this
         alongside the run's MetricsHub)."""
@@ -122,6 +132,7 @@ class FlightRecorder:
         with self._lock:
             counters = list(self.counters)
             records = list(self.records)
+            quality = list(self.quality)
         snap: Dict = {
             "event": "flight",
             "reason": reason,
@@ -135,6 +146,7 @@ class FlightRecorder:
             ),
             "counters": counters,
             "log_records": records,
+            "quality": quality,
         }
         if extra:
             snap.update(extra)

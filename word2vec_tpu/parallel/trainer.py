@@ -699,6 +699,15 @@ class ShardedTrainer(Trainer):
             state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
 
+    def _probe_params(self, state: TrainState) -> Params:
+        """Quality probes score the synced, de-replicated host export —
+        the same table export/eval/checkpoints see — so a (dp, tp) mesh
+        probe is bit-comparable to a single-chip probe of the same params
+        (parity pinned by tests/test_quality.py). export_params runs the
+        replica sync when one is pending, so the probed table reflects
+        every shard's contribution at this boundary."""
+        return self.export_params(state)
+
     def install_shutdown(self, handler, agree_every: int = 0) -> None:
         """Multihost-aware cooperative stop: a preemption notice usually
         hits ONE host, but every process must leave the collective step

@@ -84,6 +84,15 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--faults", metavar="SPEC", default="",
                     help="chaos plan (resilience/faults.py); serve kinds: "
                     "stall/hang/sigterm/oom, @k = batch number")
+    ap.add_argument("--probe-pairs", metavar="FILE",
+                    help="score the loaded table against word-pair golds "
+                    "at startup (obs/quality.score_table) and publish the "
+                    "w2v_quality_* gauges on /metrics — a table exported "
+                    "mid-training serves its measured quality alongside "
+                    "the serve gauges")
+    ap.add_argument("--probe-analogies", metavar="FILE",
+                    help="startup analogy-question probe "
+                    "(questions-words.txt format; see --probe-pairs)")
     ap.add_argument("--quiet", action="store_true")
     return ap
 
@@ -108,6 +117,29 @@ def main(argv=None) -> int:
             print(f"error: bad --faults spec: {e}", file=sys.stderr)
             return 1
 
+    startup_records = None
+    if args.probe_pairs or args.probe_analogies:
+        # one-shot quality probe of the loaded table: the same scoring core
+        # the in-training probe uses (obs/quality.score_table), published
+        # through the server's hub so /metrics carries w2v_quality_* gauges
+        # plus the present-from-zero probe counter
+        from ..obs.quality import ProbeSet, score_table
+
+        try:
+            pset = ProbeSet.from_files(
+                vocab, args.probe_pairs, args.probe_analogies
+            )
+        except (OSError, ValueError) as e:
+            print(f"error: bad probe file: {e}", file=sys.stderr)
+            return 1
+        rec, _ = score_table(W, vocab, pset)
+        startup_records = [rec, {"event": "quality_probe", "step": 0}]
+        if not args.quiet:
+            shown = {k: v for k, v in rec.items()
+                     if k.startswith("quality_")}
+            print(f"startup quality probe: {json.dumps(shown)}",
+                  file=sys.stderr)
+
     cfg = ServeConfig(
         host=args.host, port=args.port, coalesce_ms=args.coalesce_ms,
         max_batch=args.max_batch, max_pending=args.max_pending,
@@ -117,6 +149,7 @@ def main(argv=None) -> int:
         stats_every_s=args.stats_every, metrics_dir=args.metrics_dir,
         prom_textfile=args.prom_textfile, trace_dir=args.trace_dir,
         faults=plan, install_signals=True,
+        startup_records=startup_records,
     )
 
     def ready(server) -> None:

@@ -230,6 +230,18 @@ class QueryEngine:
         w = np.tile(np.array([[1.0, 0.0, 0.0]], np.float32), (len(wid), 1))
         return [self._decode(t, v) for t, v in self.batch_topk(ids, w, k)]
 
+    def neighbor_id_sets(
+        self, ids: np.ndarray, k: int = 10
+    ) -> List[np.ndarray]:
+        """Top-k neighbor ROW IDS per raw row id (self masked) — the
+        in-training quality probe's drift instrument (obs/quality.py):
+        Jaccard@k between successive probes needs id sets, not decoded
+        words, and must not re-run the word->id OOV checks per probe."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        trip = np.stack([ids, ids, ids], axis=1)
+        w = np.tile(np.array([[1.0, 0.0, 0.0]], np.float32), (len(ids), 1))
+        return [t for t, _ in self.batch_topk(trip, w, k)]
+
     def analogy_batch(
         self, triples: Sequence[Tuple[str, str, str]], k: int = 5
     ) -> List[List[Tuple[str, float]]]:

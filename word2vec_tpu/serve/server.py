@@ -112,6 +112,11 @@ class ServeConfig:
     trace_dir: Optional[str] = None
     faults: Optional[object] = None  # resilience.faults.FaultPlan
     install_signals: bool = False
+    #: records fed into the metrics hub at construction (before the first
+    #: request): the serve CLI's startup quality probe publishes its
+    #: w2v_quality_* gauges + probe counter here, so a table exported
+    #: mid-training serves its measured quality on /metrics from request 0
+    startup_records: Optional[list] = None
 
 
 class _Shed(Exception):
@@ -179,6 +184,8 @@ class EmbeddingServer:
 
             self.hub.add(jsonl_logger(
                 os.path.join(self.cfg.metrics_dir, "serve_metrics.jsonl")))
+        for rec in self.cfg.startup_records or []:
+            self.hub(dict(rec))
         self.port: Optional[int] = None
         self.exit_reason: Optional[str] = None
         self._draining = False

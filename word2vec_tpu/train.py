@@ -17,6 +17,7 @@ alpha schedule, metering and checkpointing live in exactly one place.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
@@ -118,6 +119,18 @@ class Trainer:
     #: out-of-range checkpointed step counter (the CLI records it in the
     #: run manifest); None on a clean resume or fresh run
     resume_fallback: Optional[str] = None
+    #: in-training quality probe (obs/quality.QualityProbe) — None unless
+    #: config.quality_probe_every > 0 (auto-built with synthesized golds)
+    #: or a driver installs one. Beaten from _check_stop at every
+    #: step/chunk boundary: due() is one integer compare, so non-probe
+    #: steps add zero device syncs (pinned by tests/test_quality.py).
+    #: Duck-typed: anything with .due(step)/.probe(params, step) works.
+    quality_probe = None
+    #: kernel auto-selection record (tune/planner.select_kernel): set when
+    #: a kernel='auto' config inside the measured band degeneracy domain
+    #: was re-routed to kernel='pair' (BAND_DEGENERACY_r5.md); the CLI
+    #: lands it in the run manifest
+    kernel_decision: Optional[Dict] = None
 
     def __init__(
         self,
@@ -143,6 +156,22 @@ class Trainer:
         # the flight recorder's timeline through the tracer hook.
         self.phases = PhaseRecorder(tracer=self.flight.ring)
         self._health: Optional[HealthMonitor] = None
+        if config.kernel == "auto":
+            # Kernel auto-selection (ROADMAP item 5): inside the measured
+            # band degeneracy domain the planner CHOOSES kernel='pair'
+            # instead of warning and collapsing (an explicit --kernel band
+            # overrides — select_kernel only fires for 'auto'). Resolved
+            # BEFORE the plan search so the plan key/grid see the real
+            # kernel route.
+            from .tune.planner import select_kernel
+
+            decision = select_kernel(config, len(vocab), corpus.num_tokens)
+            if decision is not None:
+                self.kernel_decision = decision
+                self.config = config = dataclasses.replace(
+                    config, kernel=decision["selected"]
+                )
+                self._log(dict(decision))
         if config.autotune != "off":
             # Resolve the execution plan BEFORE anything shape-dependent is
             # built: cached plans apply with zero probe cost, probe mode
@@ -163,6 +192,27 @@ class Trainer:
             self.config = config = config.apply_plan(self.plan_resolution.plan)
         self.tables = DeviceTables.build(vocab, config)
         self.total_words = corpus.num_tokens
+        if config.quality_probe_every > 0:
+            # default in-training quality probe: synthesized planted golds
+            # (stats-only when the vocab carries none) + a warn-only
+            # sentinel; drivers replace/extend it (cli.py wires user probe
+            # files, a budgeted sentinel, and the checkpoint hook)
+            from .obs.quality import ProbeSet, QualityProbe, QualitySentinel
+            from .tune.planner import degeneracy_domain
+
+            self.quality_probe = QualityProbe(
+                vocab,
+                ProbeSet.synthesize(vocab),
+                every=config.quality_probe_every,
+                log_fn=log_fn,
+                flight=self.flight,
+                sentinel=QualitySentinel(
+                    budget=0,
+                    in_domain=degeneracy_domain(
+                        config, len(vocab), corpus.num_tokens
+                    ),
+                ),
+            )
         # resident-corpus runner + HBM corpus, built once per instance
         self._resident_cache = None
         self._resident_ready = False
@@ -245,8 +295,12 @@ class Trainer:
                 f"{len(self.vocab)}-word vocabulary: the band kernel's "
                 "shared negative pool measurably degrades planted "
                 "structure in this over-trained tiny-vocab regime "
-                "(benchmarks/BAND_DEGENERACY_r5.md). Use kernel='pair' "
-                "(per-pair negative draws) for corpora this degenerate.",
+                "(benchmarks/BAND_DEGENERACY_r5.md). The planner selects "
+                "kernel='pair' automatically here for kernel='auto' runs "
+                "(tune/planner.select_kernel); this config FORCES the band "
+                "fast path, so expect planted-structure collapse — drop "
+                "the explicit kernel='band' (or pass --quality-probe-every "
+                "/ --quality-budget to watch and gate it live).",
                 stacklevel=3,
             )
         steps_per_epoch = max(
@@ -375,7 +429,31 @@ class Trainer:
             self.watchdog.beat(state.step)
         if self.fault_plan is not None:
             self.fault_plan.on_step(state, self)
+        if self.quality_probe is not None and self.quality_probe.due(
+            state.step
+        ):
+            # probe AFTER the beat: the probe's table fetch counts against
+            # the step deadline like any other boundary work. due() is one
+            # integer compare, so non-probe boundaries stay sync-free.
+            self._run_quality_probe(state)
         return self.stop_check is not None and self.stop_check(state.step)
+
+    def _run_quality_probe(self, state: TrainState) -> None:
+        """One in-training quality probe under its own phase span (the span
+        lands on the trace timeline; excluded from the input-vs-compute
+        verdict like checkpoint). QualityAlert propagates out of train()
+        exactly like DivergenceError — the watchdog disarms in the
+        wrapper's finally, and cli.py maps it to EXIT_QUALITY (rc=3)."""
+        with self.phases.span("quality_probe"):
+            self.quality_probe.probe(self._probe_params(state), state.step)
+
+    def _probe_params(self, state: TrainState) -> Dict:
+        """The parameter view a quality probe scores: the live device
+        params here (the probe slices logical planes and does its one
+        device fetch); the sharded trainer overrides with its synced,
+        de-replicated host export so a (dp, tp) mesh probes the same table
+        a single chip would (parity pinned by tests/test_quality.py)."""
+        return state.params
 
     def _finalize(self, state: TrainState) -> None:
         """Called once after the last epoch (sharded: final sync)."""

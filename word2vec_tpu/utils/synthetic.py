@@ -17,6 +17,7 @@ No-network environments have no text8; two generators stand in:
 
 from __future__ import annotations
 
+import re
 from typing import Dict, List, Tuple
 
 import numpy as np
@@ -265,6 +266,88 @@ def mixed_eval_corpus(
     ]
     rng.shuffle(spans)
     return [t for s in spans for t in s], topic_of, gpairs
+
+
+#: naming conventions of the planted-structure generators above, recognized
+#: by planted_probe_golds: graded pair centers, analogy grid cells, topic
+#: content words
+_GRADED_A = re.compile(r"^g(\d+)a$")
+_GRID_CELL = re.compile(r"^c(\d+)_(\d+)$")
+_TOPIC_WORD = re.compile(r"^t(\d+)w(\d+)$")
+
+
+def planted_probe_golds(
+    words: List[str],
+    max_pairs: int = 64,
+    max_questions: int = 96,
+    seed: int = 0,
+) -> Tuple[List[Tuple[str, str, float]], List[Tuple[str, str, str, str]]]:
+    """Recover (pairs, analogy questions) gold sets from a vocabulary built
+    over the planted-structure generators in this module — the in-training
+    quality probe's held-out instrument (obs/quality.py).
+
+    The generators encode their structure in the word names, so the golds
+    are recoverable from the vocabulary alone — no side channel between
+    corpus synthesis and the probe:
+
+      * graded_pair_corpus centers g{k}a/g{k}b: the planted similarity
+        alpha_k is linspace-monotone in k, so gold = k preserves the exact
+        rank order Spearman is scored against;
+      * analogy_corpus cells c{i}_{j}: every (c i_j, c i_k, c l_j, c l_k)
+        with i != l, j != k is a planted 3CosAdd question (strided down to
+        max_questions for even grid coverage);
+      * topic_corpus content words t{t}w{i}: two-level similarity pairs
+        (same topic 1.0, cross topic 0.0), deterministic draw.
+
+    A vocabulary with none of these (a real corpus, a Zipf stream) returns
+    ([], []): the probe then runs stats-only (row norms, drift, effective
+    rank) unless the user supplies --probe-pairs/--probe-analogies files.
+    """
+    wordset = set(words)
+    pairs: List[Tuple[str, str, float]] = []
+    graded = sorted(
+        int(m.group(1)) for w in words if (m := _GRADED_A.match(w))
+    )
+    for k in graded:
+        if f"g{k}b" in wordset:
+            pairs.append((f"g{k}a", f"g{k}b", float(k)))
+    if len(pairs) > max_pairs:
+        idx = np.linspace(0, len(pairs) - 1, max_pairs).astype(int)
+        pairs = [pairs[i] for i in idx]
+
+    cells = sorted(
+        (int(m.group(1)), int(m.group(2)))
+        for w in words if (m := _GRID_CELL.match(w))
+    )
+    cellset = set(cells)
+    rows = sorted({i for i, _ in cells})
+    cols = sorted({j for _, j in cells})
+    questions = [
+        (f"c{i}_{j}", f"c{i}_{k}", f"c{l}_{j}", f"c{l}_{k}")
+        for i in rows for l in rows for j in cols for k in cols
+        if i != l and j != k
+        and {(i, j), (i, k), (l, j), (l, k)} <= cellset
+    ]
+    if len(questions) > max_questions:
+        idx = np.linspace(0, len(questions) - 1, max_questions).astype(int)
+        questions = [questions[i] for i in idx]
+
+    if not pairs:
+        topic_of = {
+            w: int(m.group(1)) for w in words if (m := _TOPIC_WORD.match(w))
+        }
+        sizes: Dict[int, int] = {}
+        for t in topic_of.values():
+            sizes[t] = sizes.get(t, 0) + 1
+        # min_count can strand a topic on one surviving word; same-topic
+        # pair draws need two
+        topic_of = {w: t for w, t in topic_of.items() if sizes[t] >= 2}
+        if len(set(topic_of.values())) >= 2:
+            pairs = topic_similarity_pairs(
+                topic_of, n_pairs=min(max_pairs, 64), seed=seed,
+                same_score=1.0, diff_score=0.0,
+            )
+    return pairs, questions
 
 
 def topic_similarity_pairs(

@@ -30,6 +30,20 @@ EXIT_PREEMPTED from a bounded collective's SyncTimeout) instead of hanging
 in a collective the dead peer never joins — the pre-watchdog behavior was
 N-1 processes blocked forever. Emits one JSON line with per-rank exit codes
 and exit walls; no eval comparison (the run is deliberately truncated).
+
+Elastic mode (`--chaos elastic`, resilience/elastic.py): the same kill, the
+OPPOSITE contract — the survivors must NOT exit. They detect the loss via
+the bounded collectives, agree on membership at the elastic rendezvous,
+snapshot the last integrity-verified checkpoint, and re-form the fleet at
+N-1 in place (same pids, new generation); with --elastic-mode shrink+grow
+the drill then relaunches the victim and asserts it is admitted back at a
+sync boundary (generation 2, world N). Every process must end rc=0 — any
+75/76 on the elastic path is a failure. The drill polls the SHARED
+checkpoint's step/words counters for an external throughput curve
+(pre-kill vs post-shrink vs post-grow words/sec slopes) and, in plain
+shrink mode, runs a FRESH (N-1)-process fleet resumed from the same
+generation snapshot and asserts the final embeddings are byte-identical —
+elastic continuation IS a fresh shrunken resume, provably.
 """
 
 from __future__ import annotations
@@ -174,6 +188,288 @@ def _run_chaos(args, result, tmp, procs, logs, victim, t0) -> None:
     print(json.dumps(result))
 
 
+def _manifest(tmp, rank=0):
+    try:
+        with open(os.path.join(tmp, f"m{rank}", "manifest.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _tail(logs, r, n=12):
+    logs[r].seek(0)
+    return logs[r].read().strip().splitlines()[-n:]
+
+
+def _run_elastic(args, result, tmp, procs, logs, victim, cmds, envs,
+                 dp, t0) -> None:
+    """Kill-one-of-N, elastic contract: survivors REMESH and CONTINUE
+    (rc=0, never 75/76); shrink+grow additionally relaunches the victim and
+    asserts sync-boundary readmission. Emits one JSON line with the
+    per-phase walls, the external throughput curve sampled from the shared
+    checkpoint, and (shrink mode) the byte-parity verdict against a fresh
+    N-1 fleet resumed from the same generation snapshot."""
+    import numpy as np
+
+    result["chaos"] = "elastic"
+    result["elastic_mode"] = args.elastic_mode
+    result["victim_rank"] = victim
+    result["kill_at_step"] = args.kill_at
+    result["step_deadline_s"] = args.step_deadline
+    result["sync_deadline_s"] = args.sync_deadline
+
+    def fail(msg, tails=()):
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        result["error"] = msg
+        if tails:
+            result["log_tails"] = [_tail(logs, r) for r in tails]
+        print(json.dumps(result))
+
+    curve = []
+
+    def sample_curve():
+        """(t, step, words_done) from the SHARED checkpoint — an external
+        observer's view of fleet progress, immune to the exec that
+        separates generations (both renames in the rotation are atomic, so
+        a read sees a complete dir or nothing)."""
+        try:
+            with np.load(os.path.join(tmp, "ck_shared", "state.npz")) as z:
+                row = {
+                    "t_s": round(time.perf_counter() - t0, 2),
+                    "step": int(z["__step"]),
+                    "words_done": int(z["__words_done"]),
+                }
+        except Exception:  # noqa: BLE001 — mid-rotation gap or no ckpt yet
+            return
+        if not curve or curve[-1]["step"] != row["step"]:
+            curve.append(row)
+
+    def wait_for(pred, budget, what):
+        deadline = time.time() + budget
+        while time.time() < deadline:
+            sample_curve()
+            if pred():
+                return True
+            # a survivor exiting is an immediate verdict, not a timeout
+            for r, p in enumerate(procs):
+                if r != victim and p.poll() is not None and p.returncode != 0:
+                    return False
+            time.sleep(0.4)
+        return False
+
+    # ---- phase 1: the victim dies at the pinned boundary ----------------
+    hard = time.time() + args.timeout
+    while procs[victim].poll() is None and time.time() < hard:
+        sample_curve()
+        time.sleep(0.2)
+    if procs[victim].poll() is None:
+        return fail(f"victim never died within {args.timeout:.0f}s", [victim])
+    t_kill = time.perf_counter() - t0
+    result["victim_rc"] = procs[victim].returncode
+    if procs[victim].returncode != -9:
+        return fail(
+            f"victim exited rc={procs[victim].returncode}, expected "
+            "SIGKILL(-9)", [victim],
+        )
+    result["t_kill_s"] = round(t_kill, 1)
+
+    # ---- phase 2: survivors shrink-remesh to N-1 and keep training ------
+    # budget: detection (~sync deadline) + the rendezvous join window
+    # (2.5x sync deadline) + exec + jax.distributed re-init + recompile
+    shrink_budget = 4.0 * args.sync_deadline + 120.0
+    if not wait_for(
+        lambda: _manifest(tmp).get("elastic_generation", 0) >= 1,
+        shrink_budget, "shrink",
+    ):
+        survivors = [r for r in range(len(procs)) if r != victim]
+        rcs = {str(r): procs[r].poll() for r in survivors}
+        return fail(
+            f"no generation-1 manifest within {shrink_budget:.0f}s of the "
+            f"kill (survivor rcs so far: {rcs}) — survivors aborted or "
+            "hung instead of remeshing", survivors,
+        )
+    man1 = _manifest(tmp)
+    t_shrink = time.perf_counter() - t0
+    result["shrink_detect_to_resume_s"] = round(t_shrink - t_kill, 1)
+    result["gen1_world"] = (man1.get("mesh_events") or [{}])[-1].get("world")
+    snap1 = os.path.join(tmp, "ck_shared.elastic_g1")
+    result["gen1_snapshot"] = os.path.isdir(snap1)
+
+    # ---- phase 3 (shrink+grow): relaunch the victim, expect readmission -
+    if args.elastic_mode == "shrink+grow":
+        relaunch_cmd = [t for t in cmds[victim]]
+        # strip the fault: a relaunched host re-killing itself would loop
+        i = relaunch_cmd.index("--faults")
+        del relaunch_cmd[i:i + 2]
+        logs[victim].write("\n--- relaunched for rejoin ---\n")
+        procs[victim] = subprocess.Popen(
+            relaunch_cmd, cwd=tmp, env=envs[victim],
+            stdout=logs[victim], stderr=subprocess.STDOUT, text=True,
+        )
+        grow_budget = 4.0 * args.sync_deadline + 150.0
+        if not wait_for(
+            lambda: _manifest(tmp).get("elastic_generation", 0) >= 2,
+            grow_budget, "grow",
+        ):
+            return fail(
+                f"no generation-2 manifest within {grow_budget:.0f}s of the "
+                "relaunch — the rejoiner was not admitted",
+                list(range(len(procs))),
+            )
+        t_grow = time.perf_counter() - t0
+        result["grow_relaunch_to_resume_s"] = round(t_grow - t_shrink, 1)
+        events = _manifest(tmp).get("mesh_events") or []
+        gen2 = [e for e in events if e.get("gen") == 2
+                and e.get("event") == "generation_start"]
+        result["gen2_world"] = gen2[-1].get("world") if gen2 else None
+        if result["gen2_world"] != args.procs:
+            return fail(
+                f"generation 2 formed at world {result['gen2_world']}, "
+                f"expected {args.procs}", list(range(len(procs))),
+            )
+
+    # ---- completion: every LIVE process ends rc=0 (no 75/76 on this
+    # path); in plain shrink mode the victim stays dead (-9) by design ----
+    live = [r for r in range(len(procs))
+            if args.elastic_mode == "shrink+grow" or r != victim]
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        sample_curve()
+        if all(procs[r].poll() is not None for r in live):
+            break
+        time.sleep(0.3)
+    still = [r for r in live if procs[r].poll() is None]
+    if still:
+        return fail(f"ranks {still} still running at the drill timeout",
+                    still)
+    result["rcs"] = [p.returncode for p in procs]
+    bad = [r for r in live if procs[r].returncode != 0]
+    if bad:
+        return fail(f"ranks {bad} exited nonzero on the elastic path "
+                    f"(rcs={result['rcs']})", bad)
+    result["wall_s"] = round(time.perf_counter() - t0, 1)
+
+    # ---- throughput curve: pre-kill vs post-remesh slopes ---------------
+    # words_done is rank 0's LOCAL count (constant words per global step),
+    # so the slope is proportional to the global step rate; fleet
+    # throughput is slope x world. Recovery contract: the post-shrink fleet
+    # rate should approach (N-1)/N of pre-kill — i.e. the per-host step
+    # rate must not collapse (blackout excluded: slopes are measured
+    # between checkpoint samples within one generation).
+    def slope(rows):
+        rates = []
+        for a, b in zip(rows, rows[1:]):
+            dt = b["t_s"] - a["t_s"]
+            if dt > 0 and b["words_done"] > a["words_done"]:
+                rates.append((b["words_done"] - a["words_done"]) / dt)
+        return float(np.median(rates)) if rates else None
+    pre = slope([c for c in curve if c["t_s"] <= t_kill])
+    post_rows = [c for c in curve if c["t_s"] >= t_shrink]
+    post = slope(post_rows)
+    result["curve"] = curve
+    result["words_per_s_rank0_prekill"] = round(pre, 1) if pre else None
+    result["words_per_s_rank0_postshrink"] = round(post, 1) if post else None
+    n = args.procs
+    if pre and post:
+        # fleet-level recovery ratio vs the (N-1)/N ideal
+        result["fleet_recovery_ratio"] = round(
+            (post * (n - 1)) / (pre * n), 3
+        )
+        result["fleet_recovery_target"] = round((n - 1) / n, 3)
+        # loose CPU-noise bound; the banked JSON carries the exact ratio
+        if post < 0.4 * pre:
+            return fail(
+                f"post-shrink step rate collapsed: {post:.0f} vs "
+                f"{pre:.0f} words/s (rank-0 local)"
+            )
+
+    # ---- parity (shrink mode): fresh N-1 fleet from the same snapshot ---
+    if args.elastic_mode == "shrink" and result["gen1_snapshot"]:
+        ok, detail = _parity_reference(args, tmp, victim, dp)
+        result["parity"] = detail
+        if not ok:
+            result["error"] = "byte-parity vs fresh N-1 resume FAILED"
+            print(json.dumps(result))
+            return
+
+    result["ok"] = True
+    print(json.dumps(result))
+
+
+def _parity_reference(args, tmp, victim, dp):
+    """Run a FRESH (N-1)-process fleet resumed from the generation-1
+    snapshot on the survivors' shards and byte-compare its final vectors
+    with the elastic run's: elastic continuation must be indistinguishable
+    from a clean shrunken resume."""
+    import filecmp
+
+    survivors = [r for r in range(args.procs) if r != victim]
+    world = len(survivors)
+    new_dp = dp * world // args.procs
+    port = free_port()
+    eport = free_port()
+    procs = []
+    logs = []
+    for i, r in enumerate(survivors):
+        env = {
+            **os.environ,
+            "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            "XLA_FLAGS": (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{args.devices_per_proc}"
+            ).strip(),
+            "W2V_COORDINATOR": f"127.0.0.1:{port}",
+            "W2V_NUM_PROCS": str(world),
+            "W2V_PROC_ID": str(i),
+            "W2V_ELASTIC_COORD": f"127.0.0.1:{eport}",
+        }
+        extra = [
+            "--multihost", "--sync-mode", args.sync_mode,
+            "--batch-rows", "8", "--dp-sync-every", "4", "--chunk-steps", "1",
+            "--step-deadline", str(args.step_deadline),
+            "--sync-deadline", str(args.sync_deadline),
+            "--metrics-dir", f"mref{i}",
+            "--elastic", args.elastic_mode,
+            "--checkpoint-dir", "ck_ref", "--checkpoint-every", "5",
+            "--checkpoint-keep", "2", "--quality-probe-every", "0",
+            "--resume", "ck_shared.elastic_g1",
+        ]
+        log = open(os.path.join(tmp, f"ref{i}.log"), "w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            cli_cmd(f"shard{r}", "vocab.txt", "vec_ref.txt", new_dp,
+                    args.tp, args.iters, tuple(extra),
+                    method=args.train_method, dense_top=args.hs_dense_top),
+            cwd=tmp, env=env, stdout=log, stderr=subprocess.STDOUT,
+            text=True,
+        ))
+    deadline = time.time() + args.timeout
+    for p in procs:
+        try:
+            p.wait(timeout=max(1.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return False, {"error": "reference fleet hung"}
+    rcs = [p.returncode for p in procs]
+    if any(rcs):
+        tails = []
+        for log in logs:
+            log.seek(0)
+            tails.append(log.read().strip().splitlines()[-8:])
+        return False, {"error": f"reference rcs={rcs}", "log_tails": tails}
+    same = filecmp.cmp(
+        os.path.join(tmp, "vec_mp.txt"),
+        os.path.join(tmp, "vec_ref.txt"),
+        shallow=False,
+    )
+    return same, {"byte_identical": same, "reference_rcs": rcs,
+                  "reference_world": world, "reference_dp": new_dp}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--procs", type=int, default=2)
@@ -198,7 +494,19 @@ def main() -> None:
                     help="kill-one-of-N drill: deliver SPEC (e.g. "
                     "'peer_dead@8') to --chaos-rank only, run every rank "
                     "with the step/sync deadlines, and assert the "
-                    "survivors exit within them instead of hanging")
+                    "survivors exit within them instead of hanging; the "
+                    "special value 'elastic' runs the elastic shrink/grow "
+                    "drill instead (survivors must remesh and CONTINUE)")
+    ap.add_argument("--elastic-mode", choices=["shrink", "shrink+grow"],
+                    default="shrink+grow",
+                    help="--chaos elastic: shrink runs the kill->remesh leg "
+                    "plus the byte-parity check against a fresh N-1 resume; "
+                    "shrink+grow additionally relaunches the victim and "
+                    "asserts sync-boundary readmission at world N")
+    ap.add_argument("--kill-at", type=int, default=6,
+                    help="--chaos elastic: step boundary of the victim's "
+                    "SIGKILL (after the first checkpoint at step 5, so a "
+                    "verified resume point exists)")
     ap.add_argument("--chaos-rank", type=int, default=-1,
                     help="rank receiving the chaos fault (-1 = the LAST "
                     "rank, keeping process 0 — the jax.distributed "
@@ -256,15 +564,19 @@ def main() -> None:
         }
 
         # --- multi-process run -------------------------------------------
+        elastic = args.chaos == "elastic"
         victim = None
         if args.chaos:
             victim = (
                 args.chaos_rank if args.chaos_rank >= 0 else args.procs - 1
             )
         port = free_port()
+        elastic_port = free_port() if elastic else None
         t0 = time.perf_counter()
         procs = []
         logs = []
+        cmds = []
+        envs = []
         for r in range(args.procs):
             env = {
                 **env_base,
@@ -272,6 +584,8 @@ def main() -> None:
                 "W2V_NUM_PROCS": str(args.procs),
                 "W2V_PROC_ID": str(r),
             }
+            if elastic:
+                env["W2V_ELASTIC_COORD"] = f"127.0.0.1:{elastic_port}"
             extra = ["--multihost", "--sync-mode", args.sync_mode]
             if args.chaos:
                 extra += [
@@ -287,23 +601,54 @@ def main() -> None:
                     "--chunk-steps", "1",
                     "--step-deadline", str(args.step_deadline),
                     "--sync-deadline", str(args.sync_deadline),
-                    "--checkpoint-dir", f"ck{r}", "--checkpoint-every", "5",
                     "--metrics-dir", f"m{r}",
                 ]
+                if elastic:
+                    extra += [
+                        "--elastic", args.elastic_mode,
+                        # SHARED checkpoint dir (the elastic contract: all
+                        # hosts must read the same integrity chain), tight
+                        # cadence so a verified resume point predates the
+                        # kill, keep>=2 so rotation never leaves the chain
+                        # empty mid-write
+                        "--checkpoint-dir", "ck_shared",
+                        "--checkpoint-every", "5",
+                        "--checkpoint-keep", "2",
+                        # probe cadence is a sync boundary; pinned off so the
+                        # byte-parity reference run trivially matches it
+                        "--quality-probe-every", "0",
+                    ]
+                else:
+                    extra += [
+                        "--checkpoint-dir", f"ck{r}",
+                        "--checkpoint-every", "5",
+                    ]
                 if r == victim:
-                    extra += ["--faults", args.chaos]
+                    kind = (
+                        "peer_rejoin" if args.elastic_mode == "shrink+grow"
+                        else "peer_dead"
+                    ) if elastic else None
+                    extra += ["--faults",
+                              f"{kind}@{args.kill_at}" if elastic
+                              else args.chaos]
             # child output goes to FILES, not pipes: an undrained pipe fills
             # at ~64 KiB and deadlocks the child against our wait()
             log = open(os.path.join(tmp, f"rank{r}.log"), "w+")
             logs.append(log)
+            cmd = cli_cmd(f"shard{r}", "vocab.txt", "vec_mp.txt", dp, args.tp,
+                          args.iters, tuple(extra),
+                          method=args.train_method,
+                          dense_top=args.hs_dense_top)
+            cmds.append(cmd)
+            envs.append(env)
             procs.append(subprocess.Popen(
-                cli_cmd(f"shard{r}", "vocab.txt", "vec_mp.txt", dp, args.tp,
-                        args.iters, tuple(extra),
-                        method=args.train_method,
-                        dense_top=args.hs_dense_top),
-                cwd=tmp, env=env,
+                cmd, cwd=tmp, env=env,
                 stdout=log, stderr=subprocess.STDOUT, text=True,
             ))
+        if elastic:
+            _run_elastic(args, result, tmp, procs, logs, victim,
+                         cmds, envs, dp, t0)
+            return
         if args.chaos:
             _run_chaos(args, result, tmp, procs, logs, victim, t0)
             return

@@ -1,0 +1,501 @@
+"""Elastic multi-host training (resilience/elastic.py + the remesh
+refactor in parallel/trainer.py).
+
+Fast, single-process coverage of every protocol component the 3-process
+drills (tests/test_multiproc.py, benchmarks/multiproc.py --chaos elastic)
+exercise end to end: the rendezvous server's shrink/grow/transient rounds
+run over REAL localhost TCP with no jax fleet; remesh() is pinned as a pure
+refactor of __init__ (state-identical construction, byte-parity re-shard
+resume for BOTH table layouts); and the CLI-level contracts — flag
+validation pairing, the single-host SyncTimeout fast-fail — run through the
+real cli.main.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.resilience.elastic import (
+    ElasticError,
+    ElasticServer,
+    GrowRequested,
+    pick_good_checkpoint,
+    rendezvous,
+    rewrite_argv,
+    snapshot_checkpoint,
+    startup_hello,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------ config / argv
+def test_config_elastic_validation():
+    for mode in ("off", "shrink", "shrink+grow"):
+        assert Word2VecConfig(elastic=mode).elastic == mode
+    with pytest.raises(ValueError, match="elastic"):
+        Word2VecConfig(elastic="grow")
+
+
+def test_rewrite_argv_replaces_and_strips():
+    argv = ["-train", "shard2", "--dp", "6", "--faults", "peer_dead@6",
+            "--elastic", "shrink", "--resume", "old_ck", "--inject-nan"]
+    out = rewrite_argv(argv, dp=4, resume="ck.elastic_g1")
+    assert "--faults" not in out and "peer_dead@6" not in out
+    assert "--inject-nan" not in out
+    assert out[out.index("--dp") + 1] == "4"
+    assert out[out.index("--resume") + 1] == "ck.elastic_g1"
+    assert "old_ck" not in out
+    # untouched flags carry over in order
+    assert out[:2] == ["-train", "shard2"]
+    assert "--elastic" in out
+
+
+def test_rewrite_argv_appends_when_absent():
+    out = rewrite_argv(["-train", "s0"], dp=2, resume="snap")
+    assert out[out.index("--dp") + 1] == "2"
+    assert out[out.index("--resume") + 1] == "snap"
+
+
+def test_rewrite_argv_handles_eq_form():
+    out = rewrite_argv(["--dp=6", "--faults=nan@3", "--resume=old"],
+                       dp=4, resume="new")
+    assert "--dp=6" not in out and "--faults=nan@3" not in out
+    assert out[out.index("--dp") + 1] == "4"
+    assert out[out.index("--resume") + 1] == "new"
+
+
+# --------------------------------------------------------- fault-plan kinds
+def test_fault_kinds_peer_rejoin_and_sync_timeout():
+    from word2vec_tpu.resilience.faults import FaultPlan
+    from word2vec_tpu.resilience.watchdog import SyncTimeout
+    from word2vec_tpu.train import TrainState
+
+    plan = FaultPlan.parse("peer_rejoin@5,sync_timeout@2")
+    kinds = [f.kind for f in plan.faults]
+    assert kinds == ["peer_rejoin", "sync_timeout"]
+    state = TrainState(params={}, step=2)
+    with pytest.raises(SyncTimeout, match="injected sync_timeout"):
+        plan.on_step(state)
+    assert plan.log and plan.log[0]["kind"] == "sync_timeout"
+
+
+# --------------------------------------------------- checkpoint / snapshot
+def _mini_checkpoint(tmp_path, name="ck", step=7):
+    from word2vec_tpu.io.checkpoint import save_checkpoint
+    from word2vec_tpu.train import TrainState
+
+    cfg = Word2VecConfig(min_count=1)
+    path = os.path.join(tmp_path, name)
+    state = TrainState(
+        params={"emb_in": np.ones((4, 8), np.float32),
+                "emb_out_ns": np.zeros((4, 8), np.float32)},
+        step=step, words_done=100, epoch=0,
+    )
+    save_checkpoint(path, state, cfg, keep=2)
+    return path
+
+
+def test_snapshot_walks_integrity_chain(tmp_path):
+    from word2vec_tpu.io.checkpoint import save_checkpoint
+    from word2vec_tpu.train import TrainState
+
+    path = _mini_checkpoint(tmp_path, step=5)
+    # a second save rotates the first to .old
+    save_checkpoint(path, TrainState(
+        params={"emb_in": np.full((4, 8), 2.0, np.float32),
+                "emb_out_ns": np.zeros((4, 8), np.float32)},
+        step=10, words_done=200, epoch=0,
+    ), Word2VecConfig(min_count=1), keep=2)
+    assert pick_good_checkpoint(path) == path
+    # tear the newest: the chain must fall back to .old, without quarantine
+    with open(os.path.join(path, "state.npz"), "r+b") as f:
+        f.truncate(16)
+    assert pick_good_checkpoint(path) == path + ".old"
+    snap = snapshot_checkpoint(path, gen=1)
+    assert snap == path + ".elastic_g1" and os.path.isdir(snap)
+    # the snapshot itself verifies and is idempotent
+    from word2vec_tpu.io.checkpoint import verify_checkpoint
+
+    verify_checkpoint(snap)
+    assert snapshot_checkpoint(path, gen=1) == snap
+    assert os.path.isdir(path)  # read-only on the source: no quarantine
+
+
+def test_snapshot_none_without_good_checkpoint(tmp_path):
+    assert snapshot_checkpoint(os.path.join(tmp_path, "absent"), 1) is None
+
+
+# ------------------------------------------------------- rendezvous server
+def _server(tmp_path, world, mode="shrink+grow", gen=0, window=4.0,
+            with_ckpt=True):
+    ck = _mini_checkpoint(tmp_path) if with_ckpt else os.path.join(
+        tmp_path, "none"
+    )
+    port = free_port()
+    srv = ElasticServer(
+        f"127.0.0.1:{port}", world=world, ckpt_dir=ck,
+        jax_host="127.0.0.1", jax_port0=9000, mode=mode, gen=gen,
+        join_window=window,
+    )
+    srv.start()
+    assert srv.bound.wait(5.0) and not srv.bind_error
+    return srv, f"127.0.0.1:{port}", ck
+
+
+def _join_async(addr, rank, gen, kind="shrink", timeout=30.0):
+    out = {}
+
+    def run():
+        try:
+            out["decision"] = rendezvous(addr, rank, gen, kind, timeout)
+        except Exception as e:  # noqa: BLE001 — surfaced by the test
+            out["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, out
+
+
+def test_shrink_round_two_of_three(tmp_path):
+    srv, addr, ck = _server(tmp_path, world=3, window=3.0)
+    try:
+        t0, r0 = _join_async(addr, 0, 1)
+        t1, r1 = _join_async(addr, 1, 1)
+        t0.join(30)
+        t1.join(30)
+        d0, d1 = r0["decision"], r1["decision"]
+        assert d0["status"] == d1["status"] == "go"
+        assert d0["world"] == 2 and d0["prev_world"] == 3
+        assert d0["rank"] == 0 and d1["rank"] == 1  # old-rank order kept
+        assert d0["coordinator"] == "127.0.0.1:9001"  # port0 + gen
+        assert d0["resume"] == ck + ".elastic_g1"
+        assert os.path.isdir(d0["resume"])
+        assert d0["members"] == [0, 1] and d0["rejoined"] == []
+        # the server advanced its own view
+        assert srv.gen == 1 and srv.world == 2
+    finally:
+        srv.stop()
+
+
+def test_transient_wedge_all_join_world_unchanged(tmp_path):
+    srv, addr, _ = _server(tmp_path, world=2, window=10.0)
+    try:
+        t0, r0 = _join_async(addr, 0, 1)
+        t1, r1 = _join_async(addr, 1, 1)
+        t0.join(30)
+        t1.join(30)
+        # everyone alive: the round closes immediately (no window wait)
+        # with the world unchanged — a transient wedge, re-formed in place
+        assert r0["decision"]["world"] == 2
+        assert r1["decision"]["rank"] == 1
+    finally:
+        srv.stop()
+
+
+def test_late_join_after_decision_gets_requeue_verdict(tmp_path):
+    srv, addr, _ = _server(tmp_path, world=3, window=2.0)
+    try:
+        t0, r0 = _join_async(addr, 0, 1)
+        t1, r1 = _join_async(addr, 1, 1)
+        t0.join(30)
+        t1.join(30)
+        assert r0["decision"]["status"] == "go"
+        # rank 2 was declared dead; its eventual join must not resurrect it
+        t2, r2 = _join_async(addr, 2, 1)
+        t2.join(30)
+        assert r2["decision"]["status"] == "late"
+    finally:
+        srv.stop()
+
+
+def test_abort_without_verified_checkpoint(tmp_path):
+    srv, addr, _ = _server(tmp_path, world=2, window=2.0, with_ckpt=False)
+    try:
+        t0, r0 = _join_async(addr, 0, 1)
+        t1, r1 = _join_async(addr, 1, 1)
+        t0.join(30)
+        t1.join(30)
+        assert r0["decision"]["status"] == "abort"
+        assert "integrity-verified" in r0["decision"]["reason"]
+    finally:
+        srv.stop()
+
+
+def test_grow_admission_at_boundary(tmp_path):
+    srv, addr, ck = _server(tmp_path, world=2, window=5.0)
+    try:
+        # initial-formation hello: a member of the current gen, pre-run
+        assert startup_hello(addr, 1, 0, 5.0, 5.0) is None
+        srv.mark_running()
+        # a restarted host (stale gen-0 env) announces and parks
+        admit = {}
+
+        def waiter():
+            admit["decision"] = startup_hello(addr, 2, 0, 10.0, 30.0)
+
+        wt = threading.Thread(target=waiter, daemon=True)
+        wt.start()
+        deadline = time.monotonic() + 5.0
+        while srv.grow_pending() == 0.0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert srv.grow_pending() == 1.0
+        # the fleet joins the grow round at the next sync boundary
+        t0, r0 = _join_async(addr, 0, 1, kind="grow")
+        t1, r1 = _join_async(addr, 1, 1, kind="grow")
+        t0.join(30)
+        t1.join(30)
+        wt.join(30)
+        assert r0["decision"]["status"] == "go"
+        assert r0["decision"]["world"] == 3
+        assert r0["decision"]["rejoined"] == [2]
+        d = admit["decision"]
+        assert d["status"] == "admit" and d["rank"] == 2 and d["world"] == 3
+        assert d["resume"] == ck + ".elastic_g1"
+        assert srv.grow_pending() == 0.0
+    finally:
+        srv.stop()
+
+
+def test_shrink_mode_rejects_rejoin(tmp_path):
+    srv, addr, _ = _server(tmp_path, world=2, mode="shrink")
+    try:
+        srv.mark_running()
+        with pytest.raises(ElasticError, match="rejoin is disabled"):
+            startup_hello(addr, 1, 0, 5.0, 5.0)
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------- PeerAgreement grow channel
+def test_peer_agreement_elastic_column_raises_grow():
+    from word2vec_tpu.resilience.shutdown import ShutdownHandler
+    from word2vec_tpu.resilience.watchdog import PeerAgreement
+
+    handler = ShutdownHandler()
+    pa = PeerAgreement(handler, agree_every=1, elastic_fn=lambda: 1.0)
+    with pytest.raises(GrowRequested):
+        pa.check(4)
+    # a requested stop takes precedence over a pending grow
+    handler.requested = True
+    assert pa.check(5) is True
+    # without the elastic channel: plain stop verdict, no raise
+    handler2 = ShutdownHandler()
+    pa2 = PeerAgreement(handler2, agree_every=1)
+    assert pa2.check(4) is False
+
+
+def test_peer_agreement_inspect_accepts_4_and_5_col_rows():
+    from word2vec_tpu.resilience.shutdown import ShutdownHandler
+    from word2vec_tpu.resilience.watchdog import PeerAgreement
+
+    pa = PeerAgreement(ShutdownHandler(), agree_every=1)
+    with pytest.warns(UserWarning, match="straggler"):
+        pa.inspect(
+            np.array([[0, 0, 8, 10.0], [1, 0, 8, 12.0], [2, 0, 8, 900.0]]),
+            8,
+        )
+    pa2 = PeerAgreement(ShutdownHandler(), agree_every=1)
+    with pytest.warns(UserWarning, match="straggler"):
+        pa2.inspect(
+            np.array([[0, 0, 8, 10.0, 0.0], [1, 0, 8, 12.0, 0.0],
+                      [2, 0, 8, 900.0, 1.0]]),
+            8,
+        )
+
+
+# ------------------------------------------------------ remesh (refactor)
+def _tiny_setup(table_layout="split", iters=2, seed=3):
+    import random
+
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.data.corpus import load_corpus
+
+    random.seed(0)
+    toks = []
+    for _ in range(400):
+        toks += ["x", random.choice("ab"), "y", "p", random.choice("cd"), "q"]
+    import tempfile
+
+    path = os.path.join(tempfile.mkdtemp(), "c.txt")
+    with open(path, "w") as f:
+        f.write(" ".join(toks))
+    cfg = Word2VecConfig(
+        iters=iters, window=2, min_count=1, word_dim=16, negative=3,
+        batch_rows=8, max_sentence_len=32, chunk_steps=1, seed=seed,
+        dp_sync_every=4, resident="off", table_layout=table_layout,
+    )
+    vocab, flat = load_corpus(path, min_count=1)
+    corpus = PackedCorpus.from_flat(flat, cfg.max_sentence_len)
+    return cfg, vocab, corpus
+
+
+@pytest.mark.parametrize("shape", [(1, 1, 1), (2, 2, 1), (4, 1, 1)])
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_remesh_is_a_pure_refactor_of_init(shape):
+    """Construction through remesh() is state-identical to the old
+    __init__-only path: same specs, same mesh, and a trained trajectory
+    that matches array-for-array."""
+    from word2vec_tpu.parallel import ShardedTrainer
+    from word2vec_tpu.parallel.trainer import param_specs
+
+    dp, tp, sp = shape
+    cfg, vocab, corpus = _tiny_setup()
+    tA = ShardedTrainer(cfg, vocab, corpus, dp=dp, tp=tp, sp=sp)
+    tB = ShardedTrainer(cfg, vocab, corpus, dp=dp, tp=tp, sp=sp)
+    tB.remesh(dp=dp, tp=tp, sp=sp)  # re-enter the same topology
+    assert tB.mesh.shape == tA.mesh.shape
+    assert (tB.dp, tB.sp, tB.tp) == (tA.dp, tA.sp, tA.tp)
+    sA, sB = tA.init_state(), tB.init_state()
+    assert param_specs(sA.params) == param_specs(sB.params)
+    sA, _ = tA.train(state=sA, log_every=0)
+    sB, _ = tB.train(state=sB, log_every=0)
+    pA, pB = tA.export_params(sA), tB.export_params(sB)
+    assert set(pA) == set(pB)
+    for k in pA:
+        assert np.array_equal(np.asarray(pA[k]), np.asarray(pB[k])), k
+
+
+@pytest.mark.parametrize("table_layout", ["split", "unified"])
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_remesh_reshard_resume_byte_parity(table_layout, tmp_path):
+    """The elastic shrink semantics, in-process: train on one topology,
+    checkpoint, remesh() onto another with re-shard-from-checkpoint, and
+    continue — byte-identical to a FRESH trainer of the new topology
+    resuming from the same checkpoint. Pinned for both table layouts (the
+    unified [V, 2, d] slab derives rank-matched specs through the same
+    param_spec path)."""
+    from word2vec_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+    from word2vec_tpu.parallel import ShardedTrainer
+    from word2vec_tpu.parallel.mesh import make_mesh
+    from word2vec_tpu.train import TrainState
+
+    cfg, vocab, corpus = _tiny_setup(table_layout=table_layout, iters=1)
+    t1 = ShardedTrainer(cfg, vocab, corpus, dp=4)
+    s1 = t1.init_state()
+    s1, _ = t1.train(state=s1, log_every=0)
+    ck = os.path.join(tmp_path, "ck")
+    save_checkpoint(ck, TrainState(
+        params=t1.export_params(s1), step=s1.step,
+        words_done=s1.words_done, epoch=s1.epoch,
+    ), cfg, vocab)
+
+    import dataclasses
+
+    cfg2 = dataclasses.replace(cfg, iters=2)
+    t1.config = cfg2
+    t1.remesh(mesh=make_mesh(2, 2, 1), state=s1, checkpoint_dir=ck)
+    assert (t1.dp, t1.tp, t1.sp) == (2, 2, 1)
+    s1, _ = t1.train(state=s1, log_every=0)
+
+    t2 = ShardedTrainer(cfg2, vocab, corpus, dp=2, tp=2)
+    s2, _ck_cfg, _ck_vocab = load_checkpoint(ck)
+    t2.import_params(s2.params, s2)
+    s2, _ = t2.train(state=s2, log_every=0)
+    p1, p2 = t1.export_params(s1), t2.export_params(s2)
+    for k in p1:
+        assert np.array_equal(np.asarray(p1[k]), np.asarray(p2[k])), k
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_remesh_logs_event_and_counts(tmp_path):
+    """A remesh lands on the log sink (the w2v_remesh_total counter's
+    feed) and on the flight ring."""
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    cfg, vocab, corpus = _tiny_setup()
+    records = []
+    t = ShardedTrainer(cfg, vocab, corpus, dp=2, log_fn=records.append)
+    t.remesh(dp=4)
+    ev = [r for r in records if r.get("event") == "remesh"]
+    assert ev and ev[-1]["mesh_size"] == 4 and ev[-1]["dp"] == 4
+    names = [e["name"] for e in t.flight.ring.events()]
+    assert "remesh" in names
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_bounded_drain_only_with_deadline(monkeypatch):
+    """The elastic steady-state-overhead contract: without a sync deadline
+    (or single-process) the metrics drain is a PLAIN device_get — no
+    bounded_call, no thread per step. The bounded path engages only when a
+    deadline is installed in multi-process mode."""
+    from word2vec_tpu.parallel import ShardedTrainer
+    from word2vec_tpu.resilience import watchdog as wd
+
+    cfg, vocab, corpus = _tiny_setup()
+    t = ShardedTrainer(cfg, vocab, corpus, dp=2)
+
+    def boom(*a, **k):
+        raise AssertionError("bounded_call must not run without a deadline")
+
+    monkeypatch.setattr(wd, "bounded_call", boom)
+    assert t._device_get(np.float32(1.0)) == 1.0  # plain path, no raise
+    # multi-process + deadline: the bounded path is selected
+    calls = []
+    monkeypatch.setattr(
+        wd, "bounded_call", lambda fn, **kw: calls.append(kw) or fn()
+    )
+    t.procs = 2  # instance attribute: pretend a second process exists
+    prev = wd.set_sync_deadline(5.0)
+    try:
+        assert t._device_get(np.float32(2.0)) == 2.0
+        assert calls and calls[0]["what"] == "sharded metrics fetch"
+    finally:
+        wd.set_sync_deadline(prev)
+
+
+# ------------------------------------------------------------- CLI contracts
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_cli_elastic_flag_validation(tmp_path, capsys):
+    from word2vec_tpu import cli
+
+    corpus = os.path.join(tmp_path, "c.txt")
+    with open(corpus, "w") as f:
+        f.write("a b c d " * 50)
+    rc = cli.main(["-train", corpus, "--backend", "cpu",
+                   "--elastic", "shrink"])
+    assert rc == 1
+    assert "--elastic requires --sync-deadline" in capsys.readouterr().err
+    rc = cli.main(["-train", corpus, "--backend", "cpu",
+                   "--elastic", "shrink", "--sync-deadline", "5"])
+    assert rc == 1
+    assert "--checkpoint-dir" in capsys.readouterr().err
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_cli_single_host_sync_timeout_fails_fast(tmp_path, capsys):
+    """The latent single-host hole: a SyncTimeout with num_processes == 1
+    (injected here via the sync_timeout fault) must NOT run the peer-loss
+    protocol — structured rc=1 error naming the misconfiguration, manifest
+    marked, no exit-75 'requeue me' lie."""
+    from word2vec_tpu import cli
+
+    corpus = os.path.join(tmp_path, "c.txt")
+    with open(corpus, "w") as f:
+        f.write("x a y p c q " * 120)
+    mdir = os.path.join(tmp_path, "m")
+    rc = cli.main([
+        "-train", corpus, "-output", os.path.join(tmp_path, "v.txt"),
+        "-size", "16", "-window", "2", "-negative", "3", "-min-count", "1",
+        "-iter", "1", "--backend", "cpu", "--batch-rows", "8",
+        "--max-sentence-len", "32", "--chunk-steps", "1",
+        "--sync-deadline", "5", "--faults", "sync_timeout@2",
+        "--metrics-dir", mdir, "--quiet",
+    ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "num_processes == 1" in err
+    assert "no peer exists" in err
+    man = json.load(open(os.path.join(mdir, "manifest.json")))
+    assert man["shutdown"] == "sync_timeout_single_host"
+    assert man["elastic"] == "off" and man["mesh_size"] == 1

@@ -91,3 +91,53 @@ def test_kill_one_of_n_survivors_exit_within_deadline():
     # dir (metrics artifacts are primary-gated, so rank 0 is the one with
     # a guaranteed dump; the drill reports the rest informationally)
     assert result["survivor_flights"].get("0"), result
+
+
+def _elastic_drill(mode: str, timeout: int):
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "multiproc.py"),
+            "--procs", "3", "--devices-per-proc", "2",
+            "--tokens", "120000", "--iters", "2",
+            "--chaos", "elastic", "--elastic-mode", mode,
+            "--kill-at", "6",
+            "--step-deadline", "10", "--sync-deadline", "6",
+            "--timeout", str(timeout),
+        ],
+        capture_output=True, text=True, timeout=timeout + 240,
+        env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_elastic_shrink_survivors_continue_and_match_fresh_resume():
+    """Elastic acceptance, shrink leg (resilience/elastic.py): SIGKILL one
+    of 3 real jax.distributed processes mid-run with --elastic shrink —
+    the survivors must NOT exit 75/76 (the PR 5 contract this replaces):
+    they detect the loss, agree on membership at the rendezvous, re-form
+    the fleet at world 2 in place, resume from the generation snapshot,
+    and run to completion rc=0. The continued run's final embeddings are
+    byte-identical to a FRESH 2-process fleet resumed from the same
+    snapshot — elastic continuation IS a clean shrunken resume."""
+    result = _elastic_drill("shrink", 480)
+    assert result.get("ok"), result
+    assert result["victim_rc"] == -9, result
+    assert result["gen1_world"] == 2 and result["gen1_snapshot"], result
+    # survivors ended rc=0; the dead victim stays -9 by design
+    assert result["rcs"][0] == 0 and result["rcs"][1] == 0, result
+    assert result["parity"]["byte_identical"] is True, result
+
+
+def test_elastic_grow_rejoined_host_admitted_at_sync_boundary():
+    """Elastic acceptance, grow leg: after the shrink to world 2, the
+    relaunched victim announces at the rendezvous, the fleet admits it at
+    the next sync boundary (generation 2, world 3), and EVERY process —
+    rejoiner included — runs to completion rc=0."""
+    result = _elastic_drill("shrink+grow", 540)
+    assert result.get("ok"), result
+    assert result["victim_rc"] == -9, result
+    assert result["gen1_world"] == 2, result
+    assert result["gen2_world"] == 3, result
+    assert result["rcs"] == [0, 0, 0], result

@@ -249,11 +249,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "mid-run compile wall")
     p.add_argument("--sync-deadline", type=float, default=0.0, metavar="SECS",
                    help="deadline on cross-process collectives (multihost "
-                        "agree/heartbeat + replica sync; 0 = off/unbounded): "
-                        "a dead peer turns the infinite collective hang "
-                        "into a coordinated abort — survivors checkpoint "
-                        "where safe and exit 75 (EXIT_PREEMPTED) for "
-                        "requeue with --resume")
+                        "agree/heartbeat + replica sync + the sharded "
+                        "metrics drain; 0 = off/unbounded): a dead peer "
+                        "turns the infinite collective hang into a "
+                        "coordinated abort — survivors checkpoint where "
+                        "safe and exit 75 (EXIT_PREEMPTED) for requeue "
+                        "with --resume — or, with --elastic, into a "
+                        "shrink-remesh that keeps training")
+    p.add_argument("--elastic", choices=["off", "shrink", "shrink+grow"],
+                   default="off",
+                   help="elastic multi-host training "
+                        "(resilience/elastic.py): off = PR 5 semantics (a "
+                        "dead peer aborts the fleet to requeue, exit "
+                        "75/76); shrink = on SyncTimeout the survivors "
+                        "agree on membership through the elastic "
+                        "rendezvous (W2V_ELASTIC_COORD; hosted by rank 0), "
+                        "re-form the mesh at N-1 in place, re-shard from "
+                        "the last integrity-verified checkpoint, and keep "
+                        "training — no scheduler round-trip; shrink+grow "
+                        "additionally admits a restarted host back at the "
+                        "next sync boundary. Requires --sync-deadline and "
+                        "--checkpoint-dir/--checkpoint-every (validated); "
+                        "single-process runs ignore it with a warning")
     p.add_argument("--allow-vocab-mismatch", action="store_true",
                    help="skip the --resume vocabulary-compatibility guard "
                         "(by default a resume whose corpus rebuilds to a "
@@ -382,6 +399,55 @@ def main(argv: Optional[List[str]] = None) -> int:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
+
+    # Elastic mode: validated and connected BEFORE the first jax touch — a
+    # rejoining host must be parked at the rendezvous instead of hanging on
+    # a coordination service the fleet has already moved past.
+    elastic_ctl = None
+    if args.elastic != "off":
+        if args.sync_deadline <= 0:
+            print(
+                "error: --elastic requires --sync-deadline > 0: peer loss "
+                "is detected by the deadline-bounded collectives, and "
+                "without a deadline a dead peer is an unbounded hang, not "
+                "a recoverable SyncTimeout",
+                file=sys.stderr,
+            )
+            return 1
+        if not (args.checkpoint_dir and args.checkpoint_every):
+            print(
+                "error: --elastic requires --checkpoint-dir and "
+                "--checkpoint-every (on a filesystem every host shares): "
+                "survivors re-shard from the last integrity-verified "
+                "checkpoint, and without one there is no agreed state to "
+                "re-form from",
+                file=sys.stderr,
+            )
+            return 1
+        from .resilience.elastic import ElasticController, ElasticError
+
+        elastic_ctl = ElasticController.from_env(
+            mode=args.elastic, argv=list(argv), dp=args.dp,
+            ckpt_dir=args.checkpoint_dir, sync_deadline=args.sync_deadline,
+            step_deadline=args.step_deadline,
+        )
+        if elastic_ctl is None:
+            if not args.quiet:
+                print(
+                    "warning: --elastic set but the W2V_COORDINATOR/"
+                    "W2V_NUM_PROCS multi-process contract is not "
+                    "configured; a single-process run has no fleet to "
+                    "shrink or grow — continuing non-elastic",
+                    file=sys.stderr,
+                )
+        else:
+            try:
+                # rank 0 binds the rendezvous; other ranks hello — and an
+                # admitted rejoiner EXECS into the grown generation here
+                elastic_ctl.startup()
+            except ElasticError as e:
+                print(f"error: elastic startup: {e}", file=sys.stderr)
+                return 1
 
     if args.multihost:
         # must run before any backend use on every host
@@ -520,12 +586,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         ),
         divergence_budget=args.divergence_budget,
         quality_probe_every=q_every,
+        elastic=args.elastic,
     )
     try:
         cfg = ck_cfg if ck_cfg is not None else Word2VecConfig(**flag_kwargs)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    if cfg.elastic != args.elastic:
+        # elasticity is runtime wiring, like --sync-deadline: the flag is
+        # authoritative on resume (a checkpoint from a non-elastic
+        # generation must not pin recovery off — every elastic generation
+        # IS such a resume)
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, elastic=args.elastic)
 
     if args.export_side == "output" and cfg.use_hs:
         # fail BEFORE training, not at the export step after a long run —
@@ -626,7 +701,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             diffs = sorted(
                 f.name
                 for f in _dc.fields(flag_cfg)
-                if f.name != "prng_impl"  # warned separately above
+                # prng_impl warned separately above; elastic is runtime
+                # wiring the flag overrides on resume (never ignored)
+                if f.name not in ("prng_impl", "elastic")
                 and user_set(f.name)
                 and flag_value(f.name) != getattr(ck_cfg, f.name)
             )
@@ -774,6 +851,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.tensorboard:
             hub.add(tensorboard_logger(args.tensorboard))
     log_fn = hub if hub.sinks else None
+    if elastic_ctl is not None:
+        # the rendezvous decisions and announces land on the run's sinks
+        elastic_ctl.log_fn = log_fn
+        if elastic_ctl.server is not None:
+            elastic_ctl.server.log_fn = log_fn
     if args.dp * args.tp * args.sp > 1:
         from .parallel import ShardedTrainer
 
@@ -796,27 +878,70 @@ def main(argv: Optional[List[str]] = None) -> int:
             hit = "cache hit" if pr.source == "cache" else "probed"
             print(f"autotune ({hit}, key {pr.key}): {pr.plan.to_json()}")
 
+    elastic_gen = int(os.environ.get("W2V_ELASTIC_GEN", "0") or 0)
     if metrics_dir:
         # the manifest carries the REALIZED config (plan applied) so every
         # record in this directory can be traced to what actually ran
+        import json as _json
+
         from .obs.manifest import write_manifest
 
+        man_path0 = os.path.join(metrics_dir, "manifest.json")
+        extra = {
+            "corpus_tokens": corpus.num_tokens,
+            "corpus_rows": corpus.num_rows,
+            "resumed_from": args.resume or None,
+            # the kernel auto-selection record, when the degeneracy
+            # domain re-routed a kernel='auto' run to 'pair' (the
+            # manifest's "kernel" field already carries the realized
+            # choice; this is the WHY)
+            "kernel_decision": trainer.kernel_decision,
+            "mesh_size": args.dp * args.tp * args.sp,
+            "elastic": args.elastic,
+            "elastic_generation": elastic_gen,
+        }
+        if args.elastic != "off":
+            # mesh_events survive the exec between generations: carry the
+            # prior generations' rows forward before this rewrite, and
+            # append this generation's start (with the exec->here wall when
+            # we were re-formed rather than launched)
+            prior_events = []
+            if os.path.exists(man_path0):
+                try:
+                    with open(man_path0) as f:
+                        prior_events = _json.load(f).get("mesh_events") or []
+                except (OSError, ValueError):
+                    prior_events = []
+            exec_t = os.environ.get("W2V_ELASTIC_EXEC_T")
+            extra["mesh_events"] = list(prior_events) + [{
+                "event": "generation_start",
+                "gen": elastic_gen,
+                "world": jax.process_count(),
+                "mesh_size": args.dp * args.tp * args.sp,
+                "dp": args.dp, "tp": args.tp, "sp": args.sp,
+                "resumed_from": args.resume or None,
+                "startup_wall_s": (
+                    round(time.monotonic() - float(exec_t), 3)
+                    if exec_t and elastic_gen > 0 else None
+                ),
+            }]
         write_manifest(
-            os.path.join(metrics_dir, "manifest.json"),
+            man_path0,
             trainer.config,
             vocab_size=len(vocab),
             plan_resolution=trainer.plan_resolution,
-            extra={
-                "corpus_tokens": corpus.num_tokens,
-                "corpus_rows": corpus.num_rows,
-                "resumed_from": args.resume or None,
-                # the kernel auto-selection record, when the degeneracy
-                # domain re-routed a kernel='auto' run to 'pair' (the
-                # manifest's "kernel" field already carries the realized
-                # choice; this is the WHY)
-                "kernel_decision": trainer.kernel_decision,
-            },
+            extra=extra,
         )
+    if log_fn is not None:
+        # the mesh-topology gauges (obs/export.GAUGE_EVENTS): one record
+        # per generation — w2v_mesh_size is the live fleet-shape signal
+        # the elastic drill (and a dashboard) watches across remeshes
+        log_fn({
+            "event": "mesh",
+            "mesh_size": args.dp * args.tp * args.sp,
+            "mesh_processes": jax.process_count(),
+            "elastic_generation": elastic_gen,
+        })
 
     if state is not None and hasattr(trainer, "import_params"):
         # checkpoints always hold unreplicated [V, d] tables; re-shard them
@@ -907,6 +1032,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .obs.manifest import update_manifest
     from .resilience import faults as _faults
     from .resilience import watchdog as _watchdog
+    from .resilience.elastic import GrowRequested
     from .resilience.shutdown import EXIT_PREEMPTED, ShutdownHandler
     from .resilience.watchdog import SyncTimeout
 
@@ -929,7 +1055,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.step_deadline:
         def _stall_flush(rec):
             hub({"event": "stalled", "step": rec.get("step")})
-            hub.close()
+            if elastic_ctl is None:
+                # os._exit skips atexit, so close now; the elastic path
+                # instead keeps the sinks open for the remesh records (its
+                # execve also skips atexit, but the jsonl sink is
+                # line-buffered and the prom textfile rewrites per record —
+                # nothing is buffered to lose)
+                hub.close()
+
+        # Elastic shrink detection, leg 2: on a CPU/gloo backend the step
+        # DISPATCH itself blocks synchronously on the collective, so a dead
+        # peer wedges the main thread before any bounded channel runs — the
+        # watchdog is the only detector that still fires. With --elastic,
+        # its fire path attempts the shrink-remesh FROM THE MONITOR THREAD
+        # (execve replaces the whole process, wedged main thread included)
+        # and only falls back to the EXIT_STALLED shot when the rendezvous
+        # fails. The stall artifacts (stacks, stall.json, flight) are still
+        # written first — a recovered wedge should leave evidence too.
+        elastic_on_fire = None
+        if elastic_ctl is not None:
+            def elastic_on_fire(rec):
+                try:
+                    elastic_ctl.remesh_and_exec(
+                        "shrink", rec.get("step"),
+                        manifest_path=manifest_path, hub=hub,
+                        flight=trainer.flight, metrics_dir=metrics_dir,
+                    )
+                except Exception as e:  # noqa: BLE001 — fall through to 76
+                    print(f"elastic: stall recovery failed: {e}",
+                          file=sys.stderr)
+                os._exit(_watchdog.EXIT_STALLED)
 
         trainer.watchdog = _watchdog.StepWatchdog(
             deadline=args.step_deadline,
@@ -938,6 +1093,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             manifest_path=manifest_path,
             flight=trainer.flight,
             flush_fn=_stall_flush,
+            on_fire=elastic_on_fire,
+        )
+    elif elastic_ctl is not None and not args.quiet:
+        print(
+            "warning: --elastic without --step-deadline: a dead peer that "
+            "wedges the step dispatch itself (synchronous collectives, "
+            "e.g. the CPU/gloo backend) is only detected by the step "
+            "watchdog — set --step-deadline to bound that leg",
+            file=sys.stderr,
         )
     # Deadline-bounded collectives: process-wide, consumed by
     # parallel/multihost's agree/heartbeat allgathers and the sharded
@@ -947,6 +1111,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     prev_sync_deadline = _watchdog.set_sync_deadline(
         args.sync_deadline or None
     )
+    if elastic_ctl is not None and args.elastic == "shrink+grow":
+        # the grow channel: rank 0's pending-rejoin poll rides the
+        # PeerAgreement heartbeat row install_shutdown wires below, so the
+        # whole fleet admits a rejoiner at the same sync boundary
+        trainer.elastic_poll = elastic_ctl.grow_pending
     trainer.install_shutdown(handler)
 
     # On-demand diagnostics: SIGUSR1 dumps the flight recorder + all-thread
@@ -1032,14 +1201,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         prev_plan = _faults.activate(fault_plan)
 
     profile_ctx = trace(args.profile) if args.profile else contextlib.nullcontext()
+    if elastic_ctl is not None:
+        # from here on, a hello claiming membership of this generation is a
+        # crashed member coming back, not a late starter
+        elastic_ctl.mark_running()
     try:
         with profile_ctx:
-            state, report = run_train(
-                state=state,
-                log_every=args.log_every,
-                checkpoint_cb=ckpt_cb,
-                checkpoint_every=args.checkpoint_every,
-            )
+            try:
+                state, report = run_train(
+                    state=state,
+                    log_every=args.log_every,
+                    checkpoint_cb=ckpt_cb,
+                    checkpoint_every=args.checkpoint_every,
+                )
+            except Exception as e:
+                # A lost peer has TWO faces: the silent hang the bounded
+                # collectives turn into SyncTimeout — and an immediate
+                # runtime ERROR when the peer died mid-transfer (gloo
+                # connection reset, coordination heartbeat timeout), which
+                # can surface from ANY device interaction, bounded or not.
+                # Route the second face into the same SyncTimeout handling
+                # (elastic shrink, or abort-to-requeue) instead of crashing
+                # with a raw XlaRuntimeError whose teardown then wedges in
+                # the distributed shutdown barrier.
+                if (
+                    not isinstance(e, (SyncTimeout, DivergenceError))
+                    and jax.process_count() > 1
+                    and _watchdog.is_peer_failure(e)
+                ):
+                    raise SyncTimeout(
+                        "distributed runtime peer failure "
+                        f"({type(e).__name__}: "
+                        f"{str(e).splitlines()[0][:160]})",
+                        args.sync_deadline or 0.0,
+                    ) from e
+                raise
     except DivergenceError as e:
         # structured abort: the step/counters/checkpoint hint are in the
         # message; the flight dump carries the timeline of the steps that
@@ -1080,7 +1276,97 @@ def main(argv: Optional[List[str]] = None) -> int:
         export_trace()
         hub.close()
         return EXIT_QUALITY
+    except GrowRequested as e:
+        # Elastic grow: a restarted host waits at the rendezvous, and every
+        # fleet member raised this at the SAME sync boundary (the verdict
+        # rides one allgather). The fleet is intact, so write a collective
+        # checkpoint — the admission snapshot's source — then re-form at
+        # N+rejoiners. remesh_and_exec replaces the process image; it only
+        # RETURNS on failure, in which case requeue like a preemption (the
+        # checkpoint just landed, nothing is lost).
+        print(f"elastic: {e}", file=sys.stderr)
+        last = getattr(trainer, "last_state", None)
+        grow_saved = False
+        if last is not None:
+            try:
+                snap = unreplicated(last)  # collective: all ranks enter
+                if is_primary:
+                    save_checkpoint(
+                        args.checkpoint_dir, snap, trainer.config, vocab,
+                        keep=args.checkpoint_keep,
+                    )
+                grow_saved = True
+            except Exception as ce:  # noqa: BLE001 — degrade to last periodic
+                print(
+                    f"warning: grow-boundary checkpoint failed ({ce}); the "
+                    "generation snapshot falls back to the last periodic "
+                    "checkpoint",
+                    file=sys.stderr,
+                )
+        if elastic_ctl is not None:
+            elastic_ctl.remesh_and_exec(
+                "grow", getattr(last, "step", None),
+                manifest_path=manifest_path, hub=hub,
+                flight=trainer.flight, metrics_dir=metrics_dir,
+            )
+        # unreachable after a successful exec — this is the failure path
+        if manifest_path:
+            update_manifest(manifest_path, {
+                "shutdown": "elastic_failed",
+                "grow_checkpoint": grow_saved,
+            })
+        dump_flight("elastic_failed", failure_step=getattr(last, "step", None))
+        export_trace()
+        hub.close()
+        return EXIT_PREEMPTED
     except SyncTimeout as e:
+        if jax.process_count() <= 1:
+            # Latent single-host hole: a SyncTimeout with no peers (an
+            # injected sync_timeout fault, or a --sync-deadline bounding a
+            # local operation that wedged) must NOT run the peer-loss
+            # protocol — there is no fleet to agree with, no membership to
+            # shrink, and calling it "peer_lost" would send an operator
+            # hunting for a host that never existed. Fail fast, named.
+            print(
+                f"error: {e}\n"
+                "error: SyncTimeout with num_processes == 1: no peer "
+                "exists to lose or agree with. This is a misconfiguration "
+                "(a --sync-deadline bounding single-host work, or an "
+                "injected sync_timeout fault outside a fleet) or a wedged "
+                "local device/host operation — use --step-deadline for "
+                "single-host hang detection.",
+                file=sys.stderr,
+            )
+            if manifest_path:
+                update_manifest(manifest_path, {
+                    "shutdown": "sync_timeout_single_host",
+                    "sync_timeout": {"what": e.what, "deadline_s": e.deadline},
+                })
+            dump_flight(
+                "sync_timeout_single_host",
+                failure_step=getattr(
+                    getattr(trainer, "last_state", None), "step", None
+                ),
+            )
+            export_trace()
+            hub.close()
+            return 1
+        if elastic_ctl is not None:
+            # Elastic shrink: survivors re-form at N-1 instead of aborting.
+            # remesh_and_exec replaces the process image on success; on
+            # failure (rendezvous unreachable, declared late, no verified
+            # checkpoint) it returns and we fall through to the PR 5
+            # abort-to-requeue below — elasticity degrades, never regresses.
+            print(
+                f"elastic: {e}; attempting shrink-remesh instead of abort",
+                file=sys.stderr,
+            )
+            elastic_ctl.remesh_and_exec(
+                "shrink",
+                getattr(getattr(trainer, "last_state", None), "step", None),
+                manifest_path=manifest_path, hub=hub,
+                flight=trainer.flight, metrics_dir=metrics_dir,
+            )
         # Coordinated abort-to-requeue: a peer died or wedged and a bounded
         # collective timed out on THIS host. Every survivor takes this same
         # path (their collectives time out too), so nobody is stranded.

@@ -350,6 +350,25 @@ class Word2VecConfig:
     # Steps between psum-mean of the data-parallel replicas (parallel/trainer.py).
     dp_sync_every: int = 64
 
+    # Elastic multi-host training (resilience/elastic.py; CLI --elastic):
+    #   "off"         — PR 5 semantics: a dead peer turns every survivor's
+    #                   bounded collective into a coordinated abort-to-
+    #                   requeue (exit 75/76; scheduler restarts the fleet).
+    #   "shrink"      — on SyncTimeout the survivors agree on the live
+    #                   membership through the elastic rendezvous, re-form
+    #                   the runtime at N-1 (ShardedTrainer.remesh inside an
+    #                   in-place exec — the jax coordination service cannot
+    #                   drop a live member), re-shard from the last
+    #                   integrity-verified checkpoint, and keep training —
+    #                   no scheduler round-trip, no 75/76.
+    #   "shrink+grow" — additionally admit a restarted host back at the
+    #                   next sync boundary (announce -> grow-remesh at N).
+    # Runtime wiring like --sync-deadline: the CLI flag is authoritative on
+    # resume (a checkpoint from a non-elastic run must not pin elasticity
+    # off). Requires a sync deadline and a shared checkpoint dir; the CLI
+    # validates that pairing.
+    elastic: str = "off"
+
     # How replicas are reconciled at each sync (parallel/trainer.make_sync):
     #   "mean"  — pmean the full f32 tables over the replica axes.
     #   "delta" — delta-psum (SURVEY §7(d)): each replica sends only what
@@ -511,6 +530,11 @@ class Word2VecConfig:
         if self.sync_mode not in ("mean", "delta"):
             raise ValueError(
                 f"sync_mode must be 'mean' or 'delta', got {self.sync_mode!r}"
+            )
+        if self.elastic not in ("off", "shrink", "shrink+grow"):
+            raise ValueError(
+                f"elastic must be 'off', 'shrink' or 'shrink+grow', "
+                f"got {self.elastic!r}"
             )
         if self.batch_rows % self.micro_steps != 0:
             raise ValueError(

@@ -46,7 +46,22 @@ EVENT_COUNTERS = {
     "resume_fallback": "w2v_resume_fallbacks_total",
     "quality_probe": "w2v_quality_probes_total",
     "quality_alert": "w2v_quality_alerts_total",
+    # elastic shrink/grow (resilience/elastic.py): a remesh event fires on
+    # both legs — the recovering generation counts it before its in-place
+    # exec, and in-process ShardedTrainer.remesh() calls count here too; a
+    # rejoined host's admission counts peer_rejoin on every fleet member.
+    # (The w2v_mesh_size GAUGE rides the ordinary record path: the CLI logs
+    # a numeric mesh_size record at every generation start.)
+    "remesh": "w2v_remesh_total",
+    "peer_rejoin": "w2v_peer_rejoin_total",
 }
+
+#: event kinds whose NUMERIC fields also land as gauges. Mesh topology
+#: (w2v_mesh_size / w2v_mesh_processes / w2v_elastic_generation) is a
+#: continuous signal that only changes at remesh boundaries, so it rides
+#: the event channel (one record per generation, rendered as a labelled
+#: line by the console sink) but must still be scrapeable as a gauge.
+GAUGE_EVENTS = ("mesh",)
 
 
 class MetricsHub:
@@ -115,10 +130,22 @@ class PrometheusTextfile:
 
     def __call__(self, record: Dict) -> None:
         if "event" in record:
-            # one-off notices are not gauges — but resilience events count
+            # one-off notices are not gauges — but resilience events count,
+            # and GAUGE_EVENTS carry continuous signals worth scraping
+            dirty = False
             name = EVENT_COUNTERS.get(record["event"])
             if name is not None:
                 self._counters[name] += 1.0
+                dirty = True
+            if record["event"] in GAUGE_EVENTS:
+                for key, val in record.items():
+                    if key == "event" or isinstance(val, bool) or not (
+                        isinstance(val, (int, float))
+                    ):
+                        continue
+                    self._set(_metric_name(key), (), val)
+                    dirty = True
+            if dirty:
                 self._write()
             return
         for key, val in record.items():

@@ -132,6 +132,26 @@ def write_manifest(path: str, config, **kwargs) -> Dict:
     return man
 
 
+def append_manifest_event(path: str, key: str, record: Dict) -> Optional[Dict]:
+    """Append `record` to the manifest's `key` LIST field (creating it),
+    atomically. The elastic wiring uses this for `mesh_events`: every
+    shrink/grow decision and every generation start lands as one ordered
+    row in the same file that pins the run's configuration, surviving the
+    in-place exec that separates generations (the new generation carries
+    the prior list forward before rewriting its manifest). Same
+    never-fail-the-run contract as update_manifest."""
+    try:
+        with open(path) as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    events = man.get(key)
+    if not isinstance(events, list):
+        events = []
+    events.append(dict(record))
+    return update_manifest(path, {key: events})
+
+
 def update_manifest(path: str, fields: Dict) -> Optional[Dict]:
     """Merge `fields` into an existing manifest (atomic rewrite).
 

@@ -39,9 +39,36 @@ from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh
 #   W2V_COORDINATOR  host:port of process 0           (e.g. "10.0.0.1:8476")
 #   W2V_NUM_PROCS    total process count
 #   W2V_PROC_ID      this process's rank in [0, num_procs)
+#
+# Elastic extension (resilience/elastic.py; CLI --elastic): each
+# shrink/grow re-forms the runtime in a new GENERATION — same processes,
+# new coordination service — so the contract gains:
+#   W2V_ELASTIC_COORD  host:port of the elastic rendezvous (stable across
+#                      generations; defaults to the gen-0 coordinator host
+#                      at port+1000). Hosted by rank 0's process.
+#   W2V_ELASTIC_GEN    current generation (0 = the launch topology)
+#   W2V_ELASTIC_PORT0  the gen-0 jax coordinator port; generation g's
+#                      coordinator is that port + g, so re-formed fleets
+#                      never collide with a half-dead predecessor service
 ENV_COORDINATOR = "W2V_COORDINATOR"
 ENV_NUM_PROCS = "W2V_NUM_PROCS"
 ENV_PROC_ID = "W2V_PROC_ID"
+ENV_ELASTIC_COORD = "W2V_ELASTIC_COORD"
+ENV_ELASTIC_GEN = "W2V_ELASTIC_GEN"
+ENV_ELASTIC_PORT0 = "W2V_ELASTIC_PORT0"
+
+
+def generation_env(coordinator: str, num_processes: int, process_id: int,
+                   gen: int) -> dict:
+    """The W2V_* environment a re-formed generation launches under — the
+    one place the elastic exec protocol spells the contract, so it can
+    never drift from the names initialize_from_env reads."""
+    return {
+        ENV_COORDINATOR: coordinator,
+        ENV_NUM_PROCS: str(int(num_processes)),
+        ENV_PROC_ID: str(int(process_id)),
+        ENV_ELASTIC_GEN: str(int(gen)),
+    }
 
 _initialized = False
 
@@ -249,9 +276,10 @@ def global_heartbeat(values) -> "np.ndarray":
 
     The liveness channel of resilience/watchdog.PeerAgreement: at the
     agreement cadence every process contributes (process id, stop flag,
-    step, step-time p50 ms) in ONE collective — the stop vote and the
-    straggler/desync attribution ride the same allgather the old
-    global_agree_max used, so peer liveness costs no extra collective.
+    step, step-time p50 ms, elastic flag) in ONE collective — the stop
+    vote, the straggler/desync attribution, and the elastic grow channel
+    ride the same allgather the old global_agree_max used, so peer
+    liveness costs no extra collective.
     Deadline-bounded like _global_agree: a dead peer raises SyncTimeout
     instead of hanging the fleet. Single-process: returns [[*values]]
     without touching the collective machinery.

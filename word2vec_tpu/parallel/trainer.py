@@ -390,7 +390,21 @@ class ShardedTrainer(Trainer):
         mesh: Optional[Mesh] = None,
         log_fn=None,
     ):
-        self.mesh = mesh if mesh is not None else make_mesh(dp, tp, sp)
+        self._apply_mesh(
+            mesh if mesh is not None else make_mesh(dp, tp, sp), config
+        )
+        self._last_sync_step: Optional[int] = None
+        self._epoch_steps: Optional[int] = None
+        super().__init__(config, vocab, corpus, log_fn=log_fn)
+
+    # ------------------------------------------------------ mesh lifecycle
+    def _apply_mesh(self, mesh: Mesh, config: Word2VecConfig) -> None:
+        """Adopt `mesh` as this trainer's device mesh: derive the axis
+        widths, validate the config against the RESOLVED shape, and rebuild
+        the shardings. The one place mesh topology enters the trainer —
+        __init__ routes through it, and remesh() re-enters it on a live
+        instance (elastic shrink/grow, autoscaling)."""
+        self.mesh = mesh
         self.dp = self.mesh.shape[DATA_AXIS]
         self.sp = self.mesh.shape[SEQ_AXIS]
         self.tp = self.mesh.shape[MODEL_AXIS]
@@ -427,9 +441,85 @@ class ShardedTrainer(Trainer):
                 f"by the process count {self.procs} (each process feeds "
                 f"dp/procs replicas; parallel/multihost.py)"
             )
-        self._last_sync_step: Optional[int] = None
-        self._epoch_steps: Optional[int] = None
-        super().__init__(config, vocab, corpus, log_fn=log_fn)
+
+    def remesh(
+        self,
+        mesh: Optional[Mesh] = None,
+        dp: int = 0,
+        tp: int = 0,
+        sp: int = 0,
+        state=None,
+        checkpoint_dir: Optional[str] = None,
+    ) -> "ShardedTrainer":
+        """Re-form this trainer over a new device mesh, re-entrantly.
+
+        Rebuilds everything mesh-derived — the step/sync programs, the
+        PartitionSpecs both table layouts resolve through (param_spec), the
+        token sharding, the chunk/resident runners (rebuilt lazily on the
+        next train()), and the cross-process agreement caches — so a live
+        trainer can change topology the way __init__ sets it up: the same
+        `_apply_mesh` validation path, the same builders. This is the
+        autoscaling primitive, and the core the elastic shrink/grow
+        protocol (resilience/elastic.py) runs inside each generation.
+
+        Parameters: pass `mesh`, or axis widths (`dp`/`tp`/`sp`, defaulting
+        to the current values). With `state`, the live params are exported
+        host-side on the OLD mesh (replica-synced) and re-sharded onto the
+        new one — resuming is state-identical to handing the same host
+        tables to a freshly constructed trainer of the new shape (pinned by
+        tests/test_elastic.py for both table layouts). With
+        `checkpoint_dir`, tables and counters are instead re-shard-loaded
+        from the newest GOOD checkpoint through the existing integrity
+        chain (io/checkpoint.load_checkpoint: sha256 verify, quarantine,
+        .old fallback) — the elastic shrink semantics.
+
+        NOTE: the process-count and the jax global device set cannot change
+        inside a live process (the coordination service has no member
+        removal); cross-process elasticity re-enters through an in-place
+        exec and lands here via __init__. In-process remesh is therefore a
+        single-process (virtual or real multi-device) operation.
+        """
+        host_params = None
+        ck_state = None
+        if checkpoint_dir is not None:
+            from ..io.checkpoint import load_checkpoint
+
+            ck_state, _cfg, _vocab = load_checkpoint(checkpoint_dir)
+            host_params = ck_state.params
+        elif state is not None:
+            # synced, de-replicated host view taken on the OLD mesh
+            host_params = self.export_params(state)
+        self._apply_mesh(
+            mesh if mesh is not None else make_mesh(
+                dp or self.dp, tp or self.tp, sp or self.sp
+            ),
+            self.config,
+        )
+        self._build_step()
+        self.chunk_fn = None
+        self._resident_cache = None
+        self._resident_ready = False
+        self._epoch_steps = None  # agreed steps/epoch are topology-derived
+        self._last_sync_step = None
+        if state is not None and ck_state is not None:
+            state.step = ck_state.step
+            state.words_done = ck_state.words_done
+            state.epoch = ck_state.epoch
+        if state is not None and host_params is not None:
+            self.import_params(host_params, state)
+        self._log({
+            "event": "remesh",
+            "mesh_size": self.dp * self.sp * self.tp,
+            "dp": self.dp, "sp": self.sp, "tp": self.tp,
+            "source": "checkpoint" if checkpoint_dir else (
+                "live" if state is not None else "specs-only"
+            ),
+        })
+        if self.flight is not None:
+            self.flight.ring.instant("remesh", args={
+                "dp": self.dp, "sp": self.sp, "tp": self.tp,
+            })
+        return self
 
     # ---------------------------------------------------------------- hooks
     def _build_step(self) -> None:
@@ -492,6 +582,26 @@ class ShardedTrainer(Trainer):
             what="replica-sync collective",
             deadline=deadline,
         )
+
+    def _device_get(self, x):
+        """Deadline-bound the metrics drain in MULTI-PROCESS mode: fetching
+        a step's metrics blocks on the step's own collectives, so with a
+        dead peer the hang surfaces here — between the bounded
+        agree/heartbeat boundaries. Unbounded, only the step watchdog's
+        os._exit(EXIT_STALLED) could end it; bounding it turns the wedge
+        into the same SyncTimeout every other channel raises, which the
+        elastic path (resilience/elastic.py) recovers from WITHOUT an exit.
+        Single-process, or without a --sync-deadline: the plain fetch, zero
+        added machinery (pinned by tests/test_elastic.py)."""
+        if self.procs > 1:
+            from ..resilience.watchdog import bounded_call, sync_deadline
+
+            if sync_deadline():
+                return bounded_call(
+                    lambda: jax.device_get(x),
+                    what="sharded metrics fetch",
+                )
+        return jax.device_get(x)
 
     def _batches(
         self, batcher: BatchIterator, epoch_index: int, skip: int = 0
@@ -740,6 +850,11 @@ class ShardedTrainer(Trainer):
                 # dump shows the fleet's last agreed state, and the merged
                 # cross-host trace names its tracks (obs/trace.merge_traces)
                 flight=self.flight,
+                # elastic grow channel: the rendezvous host's pending-rejoin
+                # poll rides the heartbeat row so the whole fleet admits a
+                # restarted host at the SAME sync boundary (cli.py wires
+                # trainer.elastic_poll before calling install_shutdown)
+                elastic_fn=self.elastic_poll,
             ).check
         else:
             self.stop_check = handler.make_stop_check(process_count=1)

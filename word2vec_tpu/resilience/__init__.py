@@ -42,8 +42,12 @@ an import cycle through io/checkpoint -> resilience.faults.
 from __future__ import annotations
 
 __all__ = [
+    "ElasticController",
+    "ElasticError",
+    "ElasticServer",
     "Fault",
     "FaultPlan",
+    "GrowRequested",
     "PeerAgreement",
     "ShutdownHandler",
     "StepWatchdog",
@@ -63,6 +67,12 @@ _LAZY = {
     "PeerAgreement": ("word2vec_tpu.resilience.watchdog", "PeerAgreement"),
     "SyncTimeout": ("word2vec_tpu.resilience.watchdog", "SyncTimeout"),
     "EXIT_STALLED": ("word2vec_tpu.resilience.watchdog", "EXIT_STALLED"),
+    "ElasticController": (
+        "word2vec_tpu.resilience.elastic", "ElasticController"
+    ),
+    "ElasticServer": ("word2vec_tpu.resilience.elastic", "ElasticServer"),
+    "ElasticError": ("word2vec_tpu.resilience.elastic", "ElasticError"),
+    "GrowRequested": ("word2vec_tpu.resilience.elastic", "GrowRequested"),
 }
 
 

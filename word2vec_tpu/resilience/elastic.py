@@ -1,0 +1,884 @@
+"""Elastic multi-host training: remesh on peer loss, sync-boundary rejoin.
+
+PR 5 turned a dead peer into a coordinated abort-to-requeue: every survivor's
+bounded collective raises SyncTimeout, everyone checkpoints where safe and
+exits 75/76, and a scheduler restarts the WHOLE fleet. That is correct but
+expensive — one lost host costs a scheduler round-trip and a full-fleet cold
+start. This module closes ROADMAP item 3: on SyncTimeout the survivors
+re-form the mesh at N-1 and keep training, and a restarted host is admitted
+back at a sync boundary. No 75/76 on the elastic path, no scheduler
+involvement; the fleet heals itself.
+
+The protocol, per failure leg:
+
+  SHRINK (a peer died)
+    1. detect   — unchanged from PR 5: a deadline-bounded collective (the
+                  agree/heartbeat allgather, the replica-sync wait, or the
+                  now-bounded sharded metrics drain) raises SyncTimeout on
+                  every survivor within ~--sync-deadline.
+    2. agree    — survivors cannot agree THROUGH the wedged collectives (the
+                  dead peer is a member of every one), so membership moves
+                  to the elastic rendezvous: a tiny TCP barrier hosted by
+                  rank 0's process (`ElasticServer`, address stable across
+                  generations via W2V_ELASTIC_COORD). Each survivor joins
+                  generation g+1; the round closes when all current members
+                  joined (a transient wedge — world unchanged), or world-1
+                  joined plus a short grace, or the join window expires.
+                  Whoever did not join is declared dead.
+    3. snapshot — the server walks the shared checkpoint dir's integrity
+                  chain (io/checkpoint: sha256 verify, .old fallback) and
+                  copies the newest GOOD checkpoint to `<dir>.elastic_g<g>`
+                  — the agreed, immutable resume point of the generation.
+    4. remesh   — each survivor replaces its own process image in place
+                  (`os.execve`, same pid, same scheduler allocation) with
+                  the generation-g env: remapped rank, shrunken world, a
+                  fresh jax coordinator on port0+g, `--dp` rescaled, and
+                  `--resume <snapshot>`. The jax coordination service has
+                  no member removal, so a clean re-init is the only sound
+                  way to shrink the global device set; ShardedTrainer
+                  .remesh() is the in-process core the new image rebuilds
+                  through (its __init__ routes through the same
+                  _apply_mesh). Training continues byte-identical to a
+                  fresh N-1 fleet resumed from the same snapshot — which is
+                  exactly what the chaos drill asserts with `cmp`.
+
+  GROW (a host came back)
+    1. announce — the restarted host's CLI contacts the rendezvous BEFORE
+                  touching jax: the server sees a hello that is not a
+                  member of the current generation and parks it as a
+                  waiter (mode "shrink+grow"; plain "shrink" rejects it).
+    2. boundary — rank 0's PeerAgreement heartbeat row carries an elastic
+                  column; when a waiter is pending the whole fleet reads it
+                  from the SAME allgather and raises GrowRequested at the
+                  same sync boundary — admission lands where replicas
+                  reconcile anyway, never mid-interval.
+    3. checkpoint + remesh — the fleet (still intact!) writes a collective
+                  checkpoint, joins generation g+1, and the decision now
+                  includes the waiters: everyone (fleet members on their
+                  join reply, waiters on their parked hello connection)
+                  gets its new rank/world/coordinator and execs into the
+                  grown generation, resuming from the snapshot.
+
+Failure containment: if rank 0 (the rendezvous host) is the one that dies,
+or no integrity-verified checkpoint exists yet, or the round ends degenerate,
+`remesh_and_exec` returns False and the caller falls back to PR 5's
+abort-to-requeue — elasticity degrades to the old contract, never past it.
+A member too wedged to join before the round closes gets a "late" verdict
+and takes the same fallback; after its scheduler requeue it announces as a
+rejoiner.
+
+Everything here is observable: remesh events count w2v_remesh_total /
+w2v_peer_rejoin_total, the mesh size is a gauge, every decision lands in the
+manifest's `mesh_events` (carried across generations), and the recovering
+process dumps its flight recorder as `flight_remesh_g<g>.json` before the
+exec so the last N steps before the loss survive the image replacement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import socket
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class ElasticError(RuntimeError):
+    """The elastic protocol could not proceed (rendezvous unreachable,
+    degenerate decision, rejected announce); callers fall back to the PR 5
+    abort-to-requeue semantics."""
+
+
+class GrowRequested(RuntimeError):
+    """Raised by PeerAgreement.check on EVERY fleet member at the same sync
+    boundary when a restarted host is waiting for admission. The CLI
+    catches it, writes a collective checkpoint, and re-forms the fleet at
+    N+waiters through the rendezvous."""
+
+    def __init__(self, step: int):
+        self.step = int(step)
+        super().__init__(
+            f"elastic grow requested at sync boundary (step {step}): a "
+            "restarted host announced itself and waits for admission"
+        )
+
+
+# --------------------------------------------------------------- wire format
+# One JSON object per line, newline-terminated, over plain TCP. Small,
+# debuggable with netcat, and entirely outside jax — the rendezvous must
+# work precisely when the collectives don't.
+_MAX_LINE = 1 << 16
+
+
+def _send(sock: socket.socket, obj: Dict) -> None:
+    sock.sendall(json.dumps(obj).encode() + b"\n")
+
+
+def _recv(sock: socket.socket) -> Dict:
+    buf = b""
+    while not buf.endswith(b"\n"):
+        chunk = sock.recv(4096)
+        if not chunk:
+            raise ElasticError("rendezvous connection closed")
+        buf += chunk
+        if len(buf) > _MAX_LINE:
+            raise ElasticError("rendezvous message too large")
+    return json.loads(buf.decode())
+
+
+def _split_addr(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host, int(port)
+
+
+# ----------------------------------------------------------- checkpoint side
+def pick_good_checkpoint(path: str) -> Optional[str]:
+    """The newest checkpoint candidate (`path`, `.old`, ...) that passes
+    the integrity chain (sha256 manifest verify); None when nothing does.
+    Read-only — no quarantine: the rendezvous host must not mutate a
+    directory other processes may be reading."""
+    from ..io import checkpoint as ck
+
+    for cand in ck.checkpoint_candidates(path):
+        if not os.path.exists(os.path.join(cand, "state.npz")):
+            continue
+        try:
+            ck.verify_checkpoint(cand)
+        except ck.CheckpointError:
+            continue
+        return cand
+    return None
+
+
+def snapshot_checkpoint(path: str, gen: int) -> Optional[str]:
+    """Copy the newest GOOD checkpoint to the generation's immutable resume
+    point `<path>.elastic_g<gen>` (atomic, idempotent). Every member of the
+    new generation resumes from this snapshot, so later checkpoint rotation
+    in `path` can never pull the resume point out from under a member that
+    boots slowly — and the chaos drill diffs against it."""
+    dst = f"{path}.elastic_g{int(gen)}"
+    if os.path.isdir(dst):
+        return dst
+    cand = pick_good_checkpoint(path)
+    if cand is None:
+        return None
+    tmp = dst + ".tmp"
+    shutil.rmtree(tmp, ignore_errors=True)
+    try:
+        shutil.copytree(cand, tmp)
+        os.replace(tmp, dst)
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)
+        return None
+    return dst
+
+
+# ------------------------------------------------------------------- server
+class ElasticServer(threading.Thread):
+    """The rendezvous: membership barrier + admission queue, one per fleet,
+    hosted inside rank 0's process as a daemon thread (it must keep serving
+    while the main thread is itself recovering from a SyncTimeout, and it
+    dies with the exec that ends the generation — the next generation's
+    rank 0 binds the same stable address again).
+
+    State: `gen` (current generation), `world` (current membership size),
+    parked `waiters` (rejoin announces), and at most one active `round`
+    (generation gen+1 being agreed). Decisions are computed by a per-round
+    timer thread and replied on the held connections.
+    """
+
+    #: extra seconds granted to the last laggard once world-1 members joined
+    GRACE = 2.0
+
+    def __init__(
+        self,
+        bind_addr: str,
+        world: int,
+        ckpt_dir: str,
+        jax_host: str,
+        jax_port0: int,
+        mode: str = "shrink",
+        gen: int = 0,
+        join_window: float = 10.0,
+        log_fn: Optional[Callable[[Dict], None]] = None,
+    ):
+        super().__init__(name="elastic-rendezvous", daemon=True)
+        self.bind_addr = bind_addr
+        self.world = int(world)
+        self.ckpt_dir = ckpt_dir
+        self.jax_host = jax_host
+        self.jax_port0 = int(jax_port0)
+        self.mode = mode
+        self.gen = int(gen)
+        self.join_window = float(join_window)
+        self.log_fn = log_fn
+        self.running_fleet = False
+        self._lock = threading.Lock()
+        #: [(announced rank, conn)] in announce order — admission order
+        self._waiters: List[Tuple[int, socket.socket]] = []
+        #: active round: {"gen", "members": {rank: conn}, "opened": t}
+        self._round: Optional[Dict] = None
+        self._sock: Optional[socket.socket] = None
+        self._stopped = threading.Event()
+        self.bound = threading.Event()
+        self.bind_error: Optional[str] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def run(self) -> None:
+        host, port = _split_addr(self.bind_addr)
+        try:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((host, port))
+            srv.listen(16)
+        except OSError as e:
+            self.bind_error = str(e)
+            self.bound.set()
+            return
+        self._sock = srv
+        self.bound.set()
+        while not self._stopped.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                break  # socket closed by stop()/exec
+            threading.Thread(
+                target=self._serve, args=(conn,),
+                name="elastic-conn", daemon=True,
+            ).start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def mark_running(self) -> None:
+        """The fleet entered its training loop: from here on, a hello that
+        claims membership of the current generation is a CRASHED member
+        coming back, not a late starter — park it as a rejoiner."""
+        self.running_fleet = True
+
+    def grow_pending(self) -> float:
+        """The elastic column of rank 0's heartbeat row: nonzero when a
+        rejoiner waits for admission (one float compare per beat)."""
+        with self._lock:
+            return 1.0 if self._waiters else 0.0
+
+    # ------------------------------------------------------------- handlers
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(30.0)
+            msg = _recv(conn)
+        except (ElasticError, OSError, ValueError):
+            conn.close()
+            return
+        op = msg.get("op")
+        if op == "hello":
+            self._handle_hello(conn, msg)
+        elif op == "join":
+            self._handle_join(conn, msg)
+        else:
+            try:
+                _send(conn, {"status": "error", "reason": f"bad op {op!r}"})
+            except OSError:
+                pass
+            conn.close()
+
+    def _handle_hello(self, conn: socket.socket, msg: Dict) -> None:
+        rank = int(msg.get("rank", -1))
+        hello_gen = int(msg.get("gen", 0))
+        with self._lock:
+            member = (
+                not self.running_fleet
+                and hello_gen == self.gen
+                and 0 <= rank < self.world
+            )
+            if member:
+                reply = {"status": "run", "gen": self.gen}
+            elif self.mode == "shrink+grow":
+                conn.settimeout(None)  # parked until an admission decision
+                self._waiters.append((rank, conn))
+                reply = {"status": "wait", "gen": self.gen}
+            else:
+                reply = {
+                    "status": "reject",
+                    "reason": (
+                        f"elastic mode {self.mode!r}: rejoin is disabled "
+                        "(the fleet only shrinks); requeue through the "
+                        "scheduler instead"
+                    ),
+                }
+        try:
+            _send(conn, reply)
+        except OSError:
+            self._drop_waiter(conn)
+            return
+        if reply["status"] != "wait":
+            conn.close()
+        else:
+            self._note({
+                "event": "peer_announce", "rank": rank, "gen": self.gen,
+            })
+
+    def _drop_waiter(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._waiters = [(r, c) for r, c in self._waiters if c is not conn]
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _handle_join(self, conn: socket.socket, msg: Dict) -> None:
+        rank = int(msg.get("rank", -1))
+        gen = int(msg.get("gen", 0))
+        with self._lock:
+            if gen <= self.gen:
+                # the round already decided without this member: it was
+                # declared dead; it must fall back to abort-to-requeue and
+                # come back through the announce path
+                try:
+                    _send(conn, {
+                        "status": "late",
+                        "reason": (
+                            f"generation {gen} already decided (current "
+                            f"{self.gen}); fall back to requeue"
+                        ),
+                    })
+                except OSError:
+                    pass
+                conn.close()
+                return
+            if self._round is None or self._round["gen"] != gen:
+                self._round = {
+                    "gen": gen,
+                    "members": {},
+                    "opened": time.monotonic(),
+                }
+                threading.Thread(
+                    target=self._run_round, args=(self._round,),
+                    name="elastic-round", daemon=True,
+                ).start()
+            old = self._round["members"].get(rank)
+            self._round["members"][rank] = conn
+        if old is not None:
+            try:
+                old.close()  # a retried join supersedes the stale conn
+            except OSError:
+                pass
+        # the round thread owns the reply; this handler just parked the conn
+
+    # -------------------------------------------------------------- rounds
+    def _run_round(self, rnd: Dict) -> None:
+        deadline = rnd["opened"] + self.join_window
+        grace_applied = False
+        while True:
+            now = time.monotonic()
+            with self._lock:
+                n = len(rnd["members"])
+                world = self.world
+            if n >= world:
+                break  # everyone alive: a transient wedge, world unchanged
+            if n >= world - 1 and not grace_applied:
+                deadline = min(deadline, now + self.GRACE)
+                grace_applied = True
+            if now >= deadline:
+                break
+            time.sleep(0.05)
+        self._decide(rnd)
+
+    def _decide(self, rnd: Dict) -> None:
+        t0 = time.monotonic()
+        with self._lock:
+            members = sorted(rnd["members"].items())  # [(old rank, conn)]
+            waiters = list(self._waiters)
+            gen = rnd["gen"]
+            prev_world = self.world
+        if not members:
+            with self._lock:
+                if self._round is rnd:
+                    self._round = None
+            return
+        resume = snapshot_checkpoint(self.ckpt_dir, gen)
+        if resume is None:
+            # nothing integrity-verified to resume from: the generation
+            # cannot form — every joiner falls back to abort-to-requeue
+            self._reply_all(members, waiters, {
+                "status": "abort",
+                "reason": (
+                    f"no integrity-verified checkpoint under "
+                    f"{self.ckpt_dir!r} to re-shard from"
+                ),
+            })
+            with self._lock:
+                if self._round is rnd:
+                    self._round = None
+            return
+        new_world = len(members) + len(waiters)
+        coordinator = f"{self.jax_host}:{self.jax_port0 + gen}"
+        base = {
+            "status": "go",
+            "gen": gen,
+            "world": new_world,
+            "prev_world": prev_world,
+            "coordinator": coordinator,
+            "resume": resume,
+            "snapshot_wall_s": round(time.monotonic() - t0, 3),
+            "members": [r for r, _ in members],
+            "rejoined": [r for r, _ in waiters],
+        }
+        self._note({
+            "event": "remesh_decision", "gen": gen, "kind":
+            "grow" if waiters else
+            ("transient" if len(members) == prev_world else "shrink"),
+            "from_world": prev_world, "to_world": new_world,
+            "members": base["members"], "rejoined": base["rejoined"],
+            "resume": resume,
+        })
+        # advance the server's view BEFORE any reply lands: a member acts
+        # on its decision immediately (exec, re-hello) and must find the
+        # server already in the new generation
+        with self._lock:
+            self.gen = gen
+            self.world = new_world
+            self._waiters = []
+            if self._round is rnd:
+                self._round = None
+            self.running_fleet = False  # the new generation re-marks it
+        for new_rank, (old_rank, conn) in enumerate(members):
+            try:
+                _send(conn, {**base, "rank": new_rank, "old_rank": old_rank})
+            except OSError:
+                pass
+            conn.close()
+        for i, (old_rank, conn) in enumerate(waiters):
+            try:
+                _send(conn, {
+                    **base,
+                    "status": "admit",
+                    "rank": len(members) + i,
+                    "old_rank": old_rank,
+                })
+            except OSError:
+                pass
+            conn.close()
+
+    def _reply_all(self, members, waiters, reply: Dict) -> None:
+        for _, conn in list(members) + list(waiters):
+            try:
+                _send(conn, reply)
+            except OSError:
+                pass
+            conn.close()
+        with self._lock:
+            self._waiters = []
+
+    def _note(self, rec: Dict) -> None:
+        if self.log_fn is not None:
+            try:
+                self.log_fn(dict(rec))
+            except Exception:  # noqa: BLE001 — telemetry must not kill it
+                pass
+
+
+# ------------------------------------------------------------------ clients
+def _connect(addr: str, overall_deadline: float) -> socket.socket:
+    host, port = _split_addr(addr)
+    while True:
+        try:
+            return socket.create_connection((host, port), timeout=5.0)
+        except OSError as e:
+            if time.monotonic() >= overall_deadline:
+                raise ElasticError(
+                    f"elastic rendezvous at {addr} unreachable: {e}"
+                ) from None
+            time.sleep(0.3)
+
+
+def rendezvous(addr: str, rank: int, gen: int, kind: str,
+               timeout: float) -> Dict:
+    """Join generation `gen` and block for the decision. Retries transient
+    connection failures within `timeout`; a 'late'/'abort' decision is
+    returned as-is (the caller falls back to abort-to-requeue)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        sock = _connect(addr, deadline)
+        try:
+            sock.settimeout(max(1.0, deadline - time.monotonic()))
+            _send(sock, {"op": "join", "rank": rank, "gen": gen,
+                         "kind": kind})
+            return _recv(sock)
+        except (ElasticError, OSError, ValueError) as e:
+            if time.monotonic() >= deadline:
+                raise ElasticError(
+                    f"rendezvous join (gen {gen}) failed: {e}"
+                ) from None
+            time.sleep(0.3)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def startup_hello(addr: str, rank: int, gen: int, hello_timeout: float,
+                  admit_timeout: float) -> Optional[Dict]:
+    """The pre-jax handshake of every non-leader elastic process.
+
+    Returns None when the fleet is forming normally ("run": proceed with
+    the launch env), or the admission decision when this process is a
+    rejoiner that was parked and admitted at a sync boundary. Raises
+    ElasticError on a reject or an unreachable rendezvous. A connection
+    that dies mid-wait (the fleet's rank 0 exec'd between decision and
+    reply, or a shrink re-formed the server) is retried transparently —
+    the new generation's server re-parks the announce.
+    """
+    deadline = time.monotonic() + hello_timeout
+    while True:
+        sock = _connect(addr, deadline)
+        try:
+            sock.settimeout(max(1.0, deadline - time.monotonic()))
+            _send(sock, {"op": "hello", "rank": rank, "gen": gen})
+            reply = _recv(sock)
+            if reply.get("status") == "run":
+                return None
+            if reply.get("status") == "reject":
+                raise ElasticError(reply.get("reason", "announce rejected"))
+            if reply.get("status") == "wait":
+                # parked: block for the admission decision (bounded by the
+                # admit timeout, reset per successful park)
+                sock.settimeout(admit_timeout)
+                admitted = _recv(sock)
+                if admitted.get("status") == "admit":
+                    return admitted
+                raise ElasticError(
+                    f"admission failed: {admitted.get('reason', admitted)}"
+                )
+            raise ElasticError(f"unexpected hello reply: {reply}")
+        except ElasticError as e:
+            if "connection closed" not in str(e):
+                raise
+            # server went away mid-wait (generation turnover): re-announce
+            if time.monotonic() >= deadline:
+                raise
+            deadline = time.monotonic() + hello_timeout
+            time.sleep(0.5)
+        except (OSError, ValueError) as e:
+            if time.monotonic() >= deadline:
+                raise ElasticError(f"elastic hello failed: {e}") from None
+            time.sleep(0.5)
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+# ------------------------------------------------------------- argv rewrite
+def rewrite_argv(
+    argv: List[str],
+    dp: Optional[int] = None,
+    resume: Optional[str] = None,
+    strip: Tuple[str, ...] = ("--faults", "--inject-nan"),
+) -> List[str]:
+    """The next generation's training argv: `--dp` rescaled to the new
+    world, `--resume` pointing at the generation snapshot (replacing any
+    prior resume), and injected faults STRIPPED — a fault plan belongs to
+    the generation it was injected into; a peer_dead that re-fired after
+    the recovery would kill the fleet it just healed. Everything else
+    (shard path, vocab, geometry, telemetry dirs) carries over verbatim;
+    geometry flags that differ from the checkpoint config are ignored by
+    the resume path anyway (the checkpoint is authoritative)."""
+    value_flags = {"--dp", "--resume", "--faults"}
+    out: List[str] = []
+    replaced = set()
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        base, eq, _ = tok.partition("=")
+        takes_value = base in value_flags and not eq
+        if base in strip:
+            i += 2 if takes_value and i + 1 < len(argv) else 1
+            continue
+        if base == "--dp" and dp is not None:
+            out += ["--dp", str(dp)]
+            replaced.add(base)
+            i += 1 if eq else 2
+            continue
+        if base == "--resume" and resume is not None:
+            out += ["--resume", resume]
+            replaced.add(base)
+            i += 1 if eq else 2
+            continue
+        out.append(tok)
+        i += 1
+    if dp is not None and "--dp" not in replaced:
+        out += ["--dp", str(dp)]
+    if resume is not None and "--resume" not in replaced:
+        out += ["--resume", resume]
+    return out
+
+
+# --------------------------------------------------------------- controller
+class ElasticController:
+    """Per-process driver of the elastic protocol, owned by the CLI.
+
+    rank 0 hosts the rendezvous server; every rank goes through `startup()`
+    before the first jax touch, `mark_running()` when the loop starts,
+    `grow_pending` as the heartbeat's elastic column, and
+    `remesh_and_exec()` from the SyncTimeout / GrowRequested handlers —
+    which replaces the process image on success and returns False when the
+    caller must fall back to PR 5's abort-to-requeue.
+    """
+
+    def __init__(
+        self,
+        mode: str,
+        argv: List[str],
+        rank: int,
+        world: int,
+        gen: int,
+        dp: int,
+        elastic_addr: str,
+        jax_host: str,
+        jax_port0: int,
+        ckpt_dir: str,
+        sync_deadline: float,
+        step_deadline: float = 0.0,
+        join_window: Optional[float] = None,
+        hello_timeout: float = 60.0,
+        admit_timeout: float = 3600.0,
+        log_fn: Optional[Callable[[Dict], None]] = None,
+    ):
+        self.mode = mode
+        self.argv = list(argv)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.gen = int(gen)
+        self.dp = int(dp)
+        self.addr = elastic_addr
+        self.jax_host = jax_host
+        self.jax_port0 = int(jax_port0)
+        self.ckpt_dir = ckpt_dir
+        self.sync_deadline = float(sync_deadline)
+        self.step_deadline = float(step_deadline)
+        # the shrink round must outlast detection skew across survivors:
+        # one survivor detects at its next bounded collective (~sync
+        # deadline) while another, wedged inside a synchronous dispatch,
+        # only detects when its step watchdog fires (~step deadline) — the
+        # window must cover the spread between the two legs
+        self.join_window = (
+            float(join_window) if join_window is not None
+            else max(10.0, 2.0 * self.sync_deadline + self.step_deadline)
+        )
+        self.hello_timeout = float(hello_timeout)
+        self.admit_timeout = float(admit_timeout)
+        self.log_fn = log_fn
+        self.server: Optional[ElasticServer] = None
+
+    # ------------------------------------------------------------ creation
+    @classmethod
+    def from_env(
+        cls,
+        mode: str,
+        argv: List[str],
+        dp: int,
+        ckpt_dir: str,
+        sync_deadline: float,
+        step_deadline: float = 0.0,
+        env=os.environ,
+        log_fn=None,
+    ) -> Optional["ElasticController"]:
+        """None when the multi-process env contract is absent (elastic is
+        meaningless single-process; the CLI warns separately)."""
+        from ..parallel import multihost as mh
+
+        coord = env.get(mh.ENV_COORDINATOR)
+        world = int(env.get(mh.ENV_NUM_PROCS, "1") or 1)
+        if not coord or world <= 1:
+            return None
+        rank = int(env.get(mh.ENV_PROC_ID, "0") or 0)
+        gen = int(env.get(mh.ENV_ELASTIC_GEN, "0") or 0)
+        host, port = _split_addr(coord)
+        port0 = int(env.get(mh.ENV_ELASTIC_PORT0, "") or (port - gen))
+        eaddr = env.get(mh.ENV_ELASTIC_COORD) or f"{host}:{port0 + 1000}"
+        return cls(
+            mode=mode, argv=argv, rank=rank, world=world, gen=gen, dp=dp,
+            elastic_addr=eaddr, jax_host=host, jax_port0=port0,
+            ckpt_dir=ckpt_dir, sync_deadline=sync_deadline,
+            step_deadline=step_deadline, log_fn=log_fn,
+        )
+
+    # ------------------------------------------------------------- startup
+    def startup(self) -> None:
+        """Run BEFORE jax.distributed.initialize. Rank 0 binds the
+        rendezvous; other ranks hello — and a rejoiner blocks here until a
+        sync boundary admits it, then execs into the grown generation
+        (this call never returns for an admitted rejoiner)."""
+        if self.rank == 0:
+            self.server = ElasticServer(
+                self.addr, world=self.world, ckpt_dir=self.ckpt_dir,
+                jax_host=self.jax_host, jax_port0=self.jax_port0,
+                mode=self.mode, gen=self.gen,
+                join_window=self.join_window, log_fn=self.log_fn,
+            )
+            self.server.start()
+            self.server.bound.wait(timeout=10.0)
+            if self.server.bind_error:
+                raise ElasticError(
+                    f"elastic rendezvous failed to bind {self.addr}: "
+                    f"{self.server.bind_error}"
+                )
+            return
+        admitted = startup_hello(
+            self.addr, self.rank, self.gen,
+            hello_timeout=self.hello_timeout,
+            admit_timeout=self.admit_timeout,
+        )
+        if admitted is not None:
+            self._note({
+                "event": "peer_rejoin", "gen": admitted["gen"],
+                "rank": admitted["rank"], "world": admitted["world"],
+            })
+            self._exec(admitted)  # never returns
+
+    def mark_running(self) -> None:
+        if self.server is not None:
+            self.server.mark_running()
+
+    def grow_pending(self) -> float:
+        if self.server is None:
+            return 0.0
+        return self.server.grow_pending()
+
+    # ------------------------------------------------------------ recovery
+    def remesh_and_exec(
+        self,
+        kind: str,
+        step: Optional[int],
+        manifest_path: Optional[str] = None,
+        hub=None,
+        flight=None,
+        metrics_dir: Optional[str] = None,
+    ) -> bool:
+        """The shrink/grow recovery: rendezvous into the next generation
+        and replace this process image. Returns False (caller falls back to
+        abort-to-requeue) when the round ends 'late'/'abort', the snapshot
+        is missing, or the rendezvous is unreachable."""
+        gen = self.gen + 1
+        t0 = time.monotonic()
+        try:
+            decision = rendezvous(
+                self.addr, self.rank, gen, kind,
+                timeout=self.join_window + 2.0 * self.sync_deadline + 30.0,
+            )
+        except ElasticError as e:
+            self._note({
+                "event": "remesh_failed", "kind": kind, "gen": gen,
+                "reason": str(e),
+            })
+            print(f"elastic: {e}; falling back to abort-to-requeue",
+                  file=sys.stderr)
+            return False
+        agree_wall = time.monotonic() - t0
+        if decision.get("status") != "go" or not decision.get("resume"):
+            self._note({
+                "event": "remesh_failed", "kind": kind, "gen": gen,
+                "reason": decision.get("reason", decision.get("status")),
+            })
+            print(
+                f"elastic: generation {gen} not formed "
+                f"({decision.get('reason', decision.get('status'))}); "
+                "falling back to abort-to-requeue",
+                file=sys.stderr,
+            )
+            return False
+        new_world = int(decision["world"])
+        if self.dp * new_world % self.world:
+            self._note({
+                "event": "remesh_failed", "kind": kind, "gen": gen,
+                "reason": f"dp {self.dp} not rescalable "
+                          f"{self.world}->{new_world}",
+            })
+            return False
+        record = {
+            "event": "remesh",
+            "kind": kind,
+            "gen": int(decision["gen"]),
+            "from_world": self.world,
+            "to_world": new_world,
+            "at_step": step,
+            "rank": int(decision["rank"]),
+            "agree_wall_s": round(agree_wall, 3),
+            "snapshot_wall_s": decision.get("snapshot_wall_s"),
+            "resume": decision["resume"],
+            "rejoined": decision.get("rejoined", []),
+            "mesh_size": None,  # the new generation logs the realized size
+        }
+        if hub is not None:
+            try:
+                hub(dict(record))  # counts w2v_remesh_total
+                if decision.get("rejoined"):
+                    hub({"event": "peer_rejoin",
+                         "ranks": decision["rejoined"], "gen": gen})
+            except Exception:  # noqa: BLE001
+                pass
+        if flight is not None and metrics_dir:
+            try:
+                flight.ring.instant("remesh", args={
+                    "kind": kind, "gen": gen, "to_world": new_world,
+                })
+                flight.dump(
+                    metrics_dir, reason=f"remesh_{kind}",
+                    extra={"failure_step": step, "remesh": record},
+                    filename=f"flight_remesh_g{gen}.json",
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        if manifest_path:
+            from ..obs.manifest import append_manifest_event
+
+            append_manifest_event(manifest_path, "mesh_events", record)
+        self._exec(decision)  # never returns
+        return True  # pragma: no cover — unreachable
+
+    # ---------------------------------------------------------------- exec
+    def _exec(self, decision: Dict) -> None:
+        """Replace this process image with the next generation's: same pid,
+        same scheduler allocation, fresh jax runtime. The only sound way to
+        change the process set of a jax.distributed job — the coordination
+        service has no member removal — and the reason the elastic path
+        never shows a 75/76 to the scheduler."""
+        from ..parallel import multihost as mh
+
+        new_world = int(decision["world"])
+        new_dp = self.dp * new_world // self.world
+        argv = rewrite_argv(self.argv, dp=new_dp, resume=decision["resume"])
+        env = dict(os.environ)
+        env.update(mh.generation_env(
+            decision["coordinator"], new_world, int(decision["rank"]),
+            int(decision["gen"]),
+        ))
+        env[mh.ENV_ELASTIC_COORD] = self.addr
+        env[mh.ENV_ELASTIC_PORT0] = str(self.jax_port0)
+        env["W2V_ELASTIC_EXEC_T"] = repr(time.monotonic())
+        cmd = [sys.executable, "-m", "word2vec_tpu.cli"] + argv
+        self._note({
+            "event": "remesh_exec", "gen": int(decision["gen"]),
+            "rank": int(decision["rank"]), "world": new_world, "dp": new_dp,
+        })
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execve(sys.executable, cmd, env)
+
+    def _note(self, rec: Dict) -> None:
+        if self.log_fn is not None:
+            try:
+                self.log_fn(dict(rec))
+            except Exception:  # noqa: BLE001
+                pass

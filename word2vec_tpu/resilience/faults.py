@@ -14,6 +14,19 @@ WHEN, parsed from a compact spec string:
                             host: uncatchable, no cleanup — survivors of a
                             multi-process run must abort via the bounded
                             collectives / watchdog instead of hanging)
+    peer_rejoin@25          SIGKILL this process at boundary 25, like
+                            peer_dead — the distinct kind tells the chaos
+                            HARNESS (benchmarks/multiproc.py --chaos
+                            elastic) to relaunch the victim afterwards, so
+                            the elastic grow path (announce -> sync-boundary
+                            admission, resilience/elastic.py) is exercised;
+                            in-process delivery is identical to peer_dead
+    sync_timeout@25         raise resilience.watchdog.SyncTimeout at
+                            boundary 25 — a dead-peer detection without
+                            needing a real fleet; also the repro for the
+                            single-host hole (a SyncTimeout with
+                            num_processes == 1 must fail fast with a
+                            structured error, not pretend a peer was lost)
     ckpt_oserror:times=2    the next 2 checkpoint writes raise OSError
 
 Tokens are comma-separated; `@k` pins the optimizer-step boundary at (or
@@ -47,7 +60,10 @@ import time
 from typing import Dict, List, Optional
 
 #: fault kinds delivered at optimizer-step boundaries by the trainers
-STEP_KINDS = ("nan", "stall", "hang", "sigterm", "peer_dead")
+STEP_KINDS = (
+    "nan", "stall", "hang", "sigterm", "peer_dead", "peer_rejoin",
+    "sync_timeout",
+)
 #: fault kinds delivered at named injection points via raise_if_active()
 #: (oom: an XLA RESOURCE_EXHAUSTED-shaped allocation failure — the serve
 #: batch executor's injection point; the server must fail the affected
@@ -222,12 +238,21 @@ class FaultPlan:
                 time.sleep(f.secs)
             elif f.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
-            elif f.kind == "peer_dead":
+            elif f.kind in ("peer_dead", "peer_rejoin"):
                 # a LOST host, not an evicted one: SIGKILL is uncatchable,
                 # so no cooperative stop, no final checkpoint, no collective
                 # farewell — exactly what the survivors' bounded collectives
-                # and step watchdog must turn into a bounded abort
+                # and step watchdog must turn into a bounded abort (or, with
+                # --elastic, into a shrink-remesh). peer_rejoin differs only
+                # in what the harness does next: it relaunches the victim.
                 os.kill(os.getpid(), signal.SIGKILL)
+            elif f.kind == "sync_timeout":
+                from .watchdog import SyncTimeout
+
+                raise SyncTimeout(
+                    f"injected sync_timeout fault at step {state.step}",
+                    f.secs,
+                )
 
     # ---------------------------------------------------- event delivery
     def fire_event(self, kind: str, where: str = "") -> bool:

@@ -79,6 +79,34 @@ class SyncTimeout(RuntimeError):
         )
 
 
+#: message fragments of the distributed runtime's peer-death errors. A lost
+#: host surfaces in TWO flavors: a collective that silently never completes
+#: (the hang SyncTimeout bounds) — and, when the peer died mid-transfer, an
+#: IMMEDIATE error out of the data plane ("Gloo AllGather failed: ...
+#: Connection reset by peer") or the coordination service ("Task N
+#: heartbeat timeout"). The second flavor must be routed into the same
+#: peer-loss handling as the first: left uncaught it crashes the survivor
+#: with a raw XlaRuntimeError, whose teardown then wedges in the
+#: distributed shutdown barrier until the coordination service's fatal
+#: error poller SIGABRTs the process (observed live in the elastic drill).
+_PEER_FAILURE_FRAGMENTS = (
+    "gloo",
+    "connection reset by peer",
+    "heartbeat timeout",
+    "coordination service",
+    "socket closed",
+    "connection refused",
+    "peer closed",
+)
+
+
+def is_peer_failure(exc: BaseException) -> bool:
+    """Does this exception look like the distributed runtime reporting a
+    dead/unreachable peer (as opposed to a genuine program error)?"""
+    msg = str(exc).lower()
+    return any(f in msg for f in _PEER_FAILURE_FRAGMENTS)
+
+
 # ------------------------------------------------------ process-wide deadline
 # Host-side collectives (multihost.global_agree_* / global_heartbeat) consult
 # this instead of threading a deadline through every call chain — the same
@@ -133,7 +161,17 @@ def bounded_call(fn: Callable, what: str = "collective",
     if deadline is None:
         deadline = _SYNC_DEADLINE
     if not deadline:
-        return fn()
+        try:
+            return fn()
+        except Exception as e:
+            # even unbounded, a peer-death ERROR (vs hang) out of the
+            # runtime is a SyncTimeout-equivalent — same recovery path
+            if is_peer_failure(e):
+                raise SyncTimeout(
+                    f"{what} failed on a peer error "
+                    f"({str(e).splitlines()[0][:160]})", 0.0
+                ) from e
+            raise
     out: Dict = {}
 
     def run():
@@ -148,7 +186,13 @@ def bounded_call(fn: Callable, what: str = "collective",
     if t.is_alive():
         raise SyncTimeout(what, deadline)
     if "error" in out:
-        raise out["error"]
+        err = out["error"]
+        if isinstance(err, Exception) and is_peer_failure(err):
+            raise SyncTimeout(
+                f"{what} failed on a peer error "
+                f"({str(err).splitlines()[0][:160]})", deadline
+            ) from err
+        raise err
     return out.get("value")
 
 
@@ -410,7 +454,19 @@ class PeerAgreement:
     (`set_sync_deadline` / `--sync-deadline`) the collective raises
     SyncTimeout out of `check`, which the trainer lets propagate — the CLI
     converts it into checkpoint-where-safe + EXIT_PREEMPTED on every
-    surviving host. Without a deadline the behavior is PR 4's (block).
+    surviving host (or, with --elastic, into a shrink-remesh). Without a
+    deadline the behavior is PR 4's (block).
+
+    The heartbeat row is now 5 columns: (process id, stop flag, step,
+    step-time p50 ms, elastic flag). The elastic column is the GROW channel
+    of elastic training (resilience/elastic.py): the rendezvous-hosting
+    process sets it when a restarted host has announced itself, and since
+    every process reads the same allgather rows, the whole fleet raises
+    GrowRequested at the SAME sync boundary — the rejoiner is admitted at a
+    reconciliation point, never mid-interval. A requested stop takes
+    precedence over a pending grow (preemption beats admission).
+    `inspect()` keeps accepting 4-column rows so synthetic-fleet tests and
+    recorded heartbeats from older runs still parse.
     """
 
     def __init__(
@@ -422,6 +478,7 @@ class PeerAgreement:
         straggler_min_ms: float = 50.0,
         log_fn=None,
         flight=None,
+        elastic_fn: Optional[Callable[[], float]] = None,
     ):
         self.handler = handler
         self.every = max(1, int(agree_every))
@@ -434,11 +491,17 @@ class PeerAgreement:
         #: the fleet's last agreed state and the cross-host trace merge can
         #: attribute tracks to hosts
         self.flight = flight
+        #: elastic grow channel: a callable returning nonzero when THIS
+        #: process wants the fleet to grow-remesh at this boundary (the
+        #: rendezvous host polls its pending-rejoin list; everyone else
+        #: contributes 0 and reads the verdict from the allgather rows)
+        self.elastic_fn = elastic_fn
         self._warned: set = set()
 
     def check(self, step: int) -> bool:
         """The trainers' stop_check: heartbeat + agreed stop verdict at the
-        cadence, False (no collective) off it."""
+        cadence, False (no collective) off it. Raises GrowRequested when
+        the fleet-agreed elastic column is set and no stop is pending."""
         if step % self.every != 0:
             return False
         import jax
@@ -449,26 +512,39 @@ class PeerAgreement:
         p50 = 0.0
         if self.step_time_fn is not None:
             p50 = float(self.step_time_fn() or 0.0)
+        grow = 0.0
+        if self.elastic_fn is not None:
+            grow = float(self.elastic_fn() or 0.0)
         rows = multihost.global_heartbeat([
             float(jax.process_index()),
             1.0 if self.handler.requested else 0.0,
             float(step),
             p50,
+            grow,
         ])
         if self.flight is not None:
             self.flight.note_heartbeat(np.asarray(rows).tolist(), step)
         self.inspect(rows, step)
-        return bool(rows[:, 1].max() > 0)
+        stop = bool(rows[:, 1].max() > 0)
+        if not stop and rows.shape[1] >= 5 and rows[:, 4].max() > 0:
+            # every process sees the same rows, so every process raises at
+            # this same boundary — the grow-remesh is fleet-synchronous
+            from .elastic import GrowRequested
+
+            raise GrowRequested(step=step)
+        return stop
 
     def inspect(self, rows, step: int) -> None:
-        """Straggler / desync detection over one heartbeat's [P, 4] rows
-        (public so tests can feed synthetic fleets)."""
+        """Straggler / desync detection over one heartbeat's [P, 4-or-5]
+        rows (public so tests can feed synthetic fleets; the elastic
+        column, when present, is not inspected here)."""
         import numpy as np
 
+        rows = np.asarray(rows)
         p50s = rows[:, 3]
         med = float(np.median(p50s))
         bar = max(self.straggler_min_ms, self.straggler_factor * med)
-        for pid_f, _flag, peer_step, p50 in rows:
+        for pid_f, _flag, peer_step, p50 in rows[:, :4]:
             pid = int(pid_f)
             if med > 0 and p50 > bar and ("straggler", pid) not in self._warned:
                 self._warned.add(("straggler", pid))

@@ -131,6 +131,13 @@ class Trainer:
     #: was re-routed to kernel='pair' (BAND_DEGENERACY_r5.md); the CLI
     #: lands it in the run manifest
     kernel_decision: Optional[Dict] = None
+    #: elastic grow channel (resilience/elastic.py): a callable returning
+    #: nonzero when this process wants the fleet to admit a rejoining host
+    #: at the next agreement boundary. None in production; the CLI wires
+    #: the rendezvous host's pending-rejoin poll here BEFORE
+    #: install_shutdown, which threads it into PeerAgreement's heartbeat
+    #: row (sharded multi-process runs only — single-chip has no fleet).
+    elastic_poll = None
 
     def __init__(
         self,
@@ -595,7 +602,7 @@ class Trainer:
             dev_metrics, at_step = pending_obs
             pending_obs = None
             with self.phases.span("device_wait"):
-                m = jax.device_get(dev_metrics)
+                m = self._device_get(dev_metrics)
             self._observe_step(m, at_step)
 
         for epoch in range(state.epoch, cfg.iters):
@@ -625,7 +632,7 @@ class Trainer:
                 drain_obs()
                 pending_obs = (metrics, state.step)
                 if log_every and state.step % log_every == 0:
-                    m = jax.device_get(metrics)
+                    m = self._device_get(metrics)
                     loss = float(m["loss_sum"]) / max(1.0, float(m["pairs"]))
                     loss_hist.append(loss)
                     if not np.isfinite(loss) and not self._warned_nonfinite:
@@ -687,7 +694,7 @@ class Trainer:
         wall = time.perf_counter() - t0
         final_loss = float("nan")
         if last_metrics is not None:
-            m = jax.device_get(last_metrics)
+            m = self._device_get(last_metrics)
             final_loss = float(m["loss_sum"]) / max(1.0, float(m["pairs"]))
         report = TrainReport(
             words_per_sec=state.words_done / max(wall, 1e-9),
@@ -753,7 +760,7 @@ class Trainer:
             pending = None
             with self.phases.span("device_wait"):
                 # blocks only on an already-queued chunk
-                m = jax.device_get(metrics)
+                m = self._device_get(metrics)
             self._note_metrics(
                 m, at_step, at_epoch, at_alpha, at_words, t0, loss_hist,
                 do_log, real_steps,
@@ -994,6 +1001,17 @@ class Trainer:
         jax.device_put / asarray calls are; PhaseRecorder locks)."""
         with self.phases.span("h2d"):
             return jnp.asarray(np_chunk)
+
+    def _device_get(self, x):
+        """Every blocking metrics fetch funnels through here. Single-chip:
+        a plain jax.device_get. ShardedTrainer overrides it with a
+        deadline-bounded fetch in multi-process mode: a fetched value
+        blocks on the step's collectives, so a dead peer would otherwise
+        surface as an unbounded hang HERE — outside the bounded
+        agree/heartbeat/sync channels — and only the step watchdog's
+        os._exit(76) could end it, which is exactly the exit the elastic
+        path must avoid."""
+        return jax.device_get(x)
 
     def _log(self, rec: Dict) -> None:
         """One log record, routed to the run's sink AND the flight

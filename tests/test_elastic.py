@@ -267,6 +267,119 @@ def test_grow_admission_at_boundary(tmp_path):
         srv.stop()
 
 
+def _park_raw_waiter(addr, rank):
+    """Announce over a raw socket and leave the connection parked (the
+    caller owns it — close it to simulate a waiter crash)."""
+    host, port = addr.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=5.0)
+    s.sendall(json.dumps({"op": "hello", "rank": rank, "gen": 0}).encode()
+              + b"\n")
+    buf = b""
+    while not buf.endswith(b"\n"):
+        buf += s.recv(4096)
+    assert json.loads(buf)["status"] == "wait"
+    return s
+
+
+def test_grow_round_waits_for_slow_leader(tmp_path):
+    """The grow-path race: every rank joins the grow round immediately
+    after the collective, but rank 0 first writes the grow-boundary
+    checkpoint — routinely longer than GRACE. The world-1 grace shortcut
+    must NOT fire in a grow round, or the round decides without rank 0 and
+    declares the (alive) rendezvous host dead."""
+    srv, addr, ck = _server(tmp_path, world=2, window=10.0)
+    srv.GRACE = 0.3  # shrink the shortcut so the race window is cheap
+    try:
+        srv.mark_running()
+        parked = _park_raw_waiter(addr, rank=2)
+        # rank 1 joins the grow round at once; rank 0 is "writing the
+        # checkpoint" for well past GRACE before its own join lands
+        t1, r1 = _join_async(addr, 1, 1, kind="grow")
+        time.sleep(4 * srv.GRACE)
+        t0, r0 = _join_async(addr, 0, 1, kind="grow")
+        t0.join(30)
+        t1.join(30)
+        d0, d1 = r0["decision"], r1["decision"]
+        assert d0["status"] == "go", d0  # NOT "late": rank 0 made the round
+        assert d0["members"] == [0, 1]
+        assert d0["world"] == 3 and d0["rejoined"] == [2]
+        assert d0["rank"] == 0 and d1["rank"] == 1
+        parked.close()
+    finally:
+        srv.stop()
+
+
+def test_dead_waiter_dropped_from_grow_decision(tmp_path):
+    """A rejoiner that announced and then crashed while parked must not be
+    counted into new_world — the fleet would exec into a generation with a
+    rank that never starts. The decision probes parked connections and
+    drops the dead ones BEFORE computing the world."""
+    srv, addr, _ = _server(tmp_path, world=2, window=5.0)
+    try:
+        srv.mark_running()
+        dead = _park_raw_waiter(addr, rank=2)
+        live = _park_raw_waiter(addr, rank=3)
+        dead.close()  # crashed while parked: OS sends FIN
+        time.sleep(0.2)
+        t0, r0 = _join_async(addr, 0, 1, kind="grow")
+        t1, r1 = _join_async(addr, 1, 1, kind="grow")
+        t0.join(30)
+        t1.join(30)
+        d0 = r0["decision"]
+        assert d0["status"] == "go"
+        assert d0["world"] == 3  # 2 members + the LIVE waiter only
+        assert d0["rejoined"] == [3]
+        assert srv.world == 3
+        # the live waiter got its admission on the parked connection
+        buf = b""
+        live.settimeout(5.0)
+        while not buf.endswith(b"\n"):
+            buf += live.recv(4096)
+        admit = json.loads(buf)
+        assert admit["status"] == "admit" and admit["rank"] == 2
+        live.close()
+    finally:
+        srv.stop()
+
+
+def test_startup_hello_bounded_against_flapping_server():
+    """A server that keeps accepting and dropping connections must not let
+    startup_hello loop forever by resetting its deadline on every retry:
+    the re-announce count is capped."""
+    port = free_port()
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(8)
+    stop = threading.Event()
+
+    def flap():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(2.0)
+                conn.recv(1024)  # consume the hello, then drop the conn
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    threading.Thread(target=flap, daemon=True).start()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(ElasticError, match="dropped the connection"):
+            startup_hello(f"127.0.0.1:{port}", 2, 0,
+                          hello_timeout=20.0, admit_timeout=20.0)
+        # bounded by the retry cap, far inside a single hello window
+        assert time.monotonic() - t0 < 15.0
+    finally:
+        stop.set()
+        srv.close()
+
+
 def test_shrink_mode_rejects_rejoin(tmp_path):
     srv, addr, _ = _server(tmp_path, world=2, mode="shrink")
     try:
@@ -405,6 +518,43 @@ def test_remesh_reshard_resume_byte_parity(table_layout, tmp_path):
     p1, p2 = t1.export_params(s1), t2.export_params(s2)
     for k in p1:
         assert np.array_equal(np.asarray(p1[k]), np.asarray(p2[k])), k
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_remesh_checkpoint_dir_requires_state(tmp_path):
+    """remesh(checkpoint_dir=...) without a state to import into would
+    load the checkpoint and silently discard it — that must raise, not
+    quietly degrade to a specs-only remesh."""
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    cfg, vocab, corpus = _tiny_setup()
+    t = ShardedTrainer(cfg, vocab, corpus, dp=2)
+    with pytest.raises(ValueError, match="state"):
+        t.remesh(dp=2, checkpoint_dir=os.path.join(tmp_path, "ck"))
+
+
+def test_is_peer_failure_requires_runtime_type():
+    """The peer-death fragments are broad ('gloo', 'connection refused');
+    only an exception raised by the jax/XLA runtime itself may match — an
+    auxiliary socket failing with the same words stays a program error
+    (it must not trigger a shrink-remesh/rollback)."""
+    from word2vec_tpu.resilience.watchdog import is_peer_failure
+
+    class FakeXlaRuntimeError(Exception):
+        pass
+
+    FakeXlaRuntimeError.__module__ = "jaxlib.xla_extension"
+    assert is_peer_failure(
+        FakeXlaRuntimeError("Gloo AllGather failed: Connection reset by "
+                            "peer [127.0.0.1]:43331")
+    )
+    assert is_peer_failure(FakeXlaRuntimeError("Task 2 heartbeat timeout"))
+    assert not is_peer_failure(RuntimeError("connection refused"))
+    assert not is_peer_failure(OSError("[Errno 111] Connection refused"))
+    assert not is_peer_failure(ConnectionResetError(
+        "metrics sink: socket closed"
+    ))
+    assert not is_peer_failure(FakeXlaRuntimeError("unrelated XLA error"))
 
 
 @pytest.mark.filterwarnings("ignore::UserWarning")

@@ -471,7 +471,9 @@ class ShardedTrainer(Trainer):
         `checkpoint_dir`, tables and counters are instead re-shard-loaded
         from the newest GOOD checkpoint through the existing integrity
         chain (io/checkpoint.load_checkpoint: sha256 verify, quarantine,
-        .old fallback) — the elastic shrink semantics.
+        .old fallback) — the elastic shrink semantics; it requires a
+        `state` to import into (ValueError otherwise — a load with
+        nowhere to land would be silently discarded).
 
         NOTE: the process-count and the jax global device set cannot change
         inside a live process (the coordination service has no member
@@ -482,6 +484,14 @@ class ShardedTrainer(Trainer):
         host_params = None
         ck_state = None
         if checkpoint_dir is not None:
+            if state is None:
+                raise ValueError(
+                    "remesh(checkpoint_dir=...) re-shard-loads the "
+                    "checkpoint tables into a live state and needs the "
+                    "`state` to import them into — without it the loaded "
+                    "params would be silently discarded. Pass state=, or "
+                    "omit checkpoint_dir for a specs-only remesh."
+                )
             from ..io.checkpoint import load_checkpoint
 
             ck_state, _cfg, _vocab = load_checkpoint(checkpoint_dir)

@@ -24,7 +24,12 @@ The protocol, per failure leg:
                   generation g+1; the round closes when all current members
                   joined (a transient wedge — world unchanged), or world-1
                   joined plus a short grace, or the join window expires.
-                  Whoever did not join is declared dead.
+                  Whoever did not join is declared dead. The grace
+                  shortcut applies to SHRINK rounds only: in a grow round
+                  the whole fleet is alive and the laggard is rank 0
+                  itself, writing the grow-boundary checkpoint before it
+                  joins — shrinking the deadline there would declare the
+                  rendezvous host dead (see GROW step 3).
     3. snapshot — the server walks the shared checkpoint dir's integrity
                   chain (io/checkpoint: sha256 verify, .old fallback) and
                   copies the newest GOOD checkpoint to `<dir>.elastic_g<g>`
@@ -53,11 +58,17 @@ The protocol, per failure leg:
                   same sync boundary — admission lands where replicas
                   reconcile anyway, never mid-interval.
     3. checkpoint + remesh — the fleet (still intact!) writes a collective
-                  checkpoint, joins generation g+1, and the decision now
-                  includes the waiters: everyone (fleet members on their
-                  join reply, waiters on their parked hello connection)
-                  gets its new rank/world/coordinator and execs into the
-                  grown generation, resuming from the snapshot.
+                  checkpoint, joins generation g+1 (each join carries
+                  kind="grow", which disables the world-1 grace shortcut:
+                  rank 0 joins only after its checkpoint write, which can
+                  far exceed the grace), and the decision now includes the
+                  waiters — each probed for liveness first, so a rejoiner
+                  that crashed while parked is dropped rather than counted
+                  into a world with a rank that never starts: everyone
+                  (fleet members on their join reply, waiters on their
+                  parked hello connection) gets its new
+                  rank/world/coordinator and execs into the grown
+                  generation, resuming from the snapshot.
 
 Failure containment: if rank 0 (the rendezvous host) is the one that dies,
 or no integrity-verified checkpoint exists yet, or the round ends degenerate,
@@ -132,6 +143,28 @@ def _recv(sock: socket.socket) -> Dict:
 def _split_addr(addr: str) -> Tuple[str, int]:
     host, _, port = addr.rpartition(":")
     return host, int(port)
+
+
+def _conn_alive(conn: socket.socket) -> bool:
+    """Liveness probe for a parked connection. A waiter that crashed after
+    announcing leaves a half-open socket — its OS sent FIN/RST, so a
+    non-blocking recv returns EOF (b'') or raises; an alive waiter never
+    sends after the hello, so the recv raises BlockingIOError. A stray
+    readable byte still means the peer is alive (and is harmless to
+    consume: the server only ever SENDS on a parked connection)."""
+    try:
+        conn.setblocking(False)
+        chunk = conn.recv(1)
+    except (BlockingIOError, InterruptedError):
+        return True
+    except OSError:
+        return False
+    finally:
+        try:
+            conn.setblocking(True)
+        except OSError:
+            pass
+    return bool(chunk)
 
 
 # ----------------------------------------------------------- checkpoint side
@@ -337,6 +370,7 @@ class ElasticServer(threading.Thread):
     def _handle_join(self, conn: socket.socket, msg: Dict) -> None:
         rank = int(msg.get("rank", -1))
         gen = int(msg.get("gen", 0))
+        kind = str(msg.get("kind", ""))
         with self._lock:
             if gen <= self.gen:
                 # the round already decided without this member: it was
@@ -359,11 +393,14 @@ class ElasticServer(threading.Thread):
                     "gen": gen,
                     "members": {},
                     "opened": time.monotonic(),
+                    "grow": False,
                 }
                 threading.Thread(
                     target=self._run_round, args=(self._round,),
                     name="elastic-round", daemon=True,
                 ).start()
+            if kind == "grow":
+                self._round["grow"] = True
             old = self._round["members"].get(rank)
             self._round["members"][rank] = conn
         if old is not None:
@@ -382,9 +419,20 @@ class ElasticServer(threading.Thread):
             with self._lock:
                 n = len(rnd["members"])
                 world = self.world
+                # In a grow round (any join carried kind="grow", or a
+                # rejoiner is parked) the whole fleet is alive and the
+                # missing member is typically rank 0 ITSELF, still writing
+                # the grow-boundary checkpoint before it joins — routinely
+                # longer than GRACE for real table sizes. Shrinking the
+                # deadline would decide without rank 0, declare the
+                # rendezvous host dead, and hand rank 0 of the next
+                # generation to a host that cannot bind the stable
+                # W2V_ELASTIC_COORD address. The grace shortcut is a
+                # SHRINK-round optimization only.
+                grow = rnd.get("grow", False) or bool(self._waiters)
             if n >= world:
                 break  # everyone alive: a transient wedge, world unchanged
-            if n >= world - 1 and not grace_applied:
+            if n >= world - 1 and not grace_applied and not grow:
                 deadline = min(deadline, now + self.GRACE)
                 grace_applied = True
             if now >= deadline:
@@ -404,6 +452,24 @@ class ElasticServer(threading.Thread):
                 if self._round is rnd:
                     self._round = None
             return
+        # Drop waiters that died while parked BEFORE they are counted: a
+        # crashed rejoiner baked into new_world would make the fleet exec
+        # into a generation with a rank that never starts, wedging the next
+        # jax.distributed initialize. (The failed _send at reply time is
+        # too late — new_world has already gone out to the members.)
+        live_waiters = []
+        for old_rank, conn in waiters:
+            if _conn_alive(conn):
+                live_waiters.append((old_rank, conn))
+                continue
+            self._note({
+                "event": "waiter_dead", "rank": old_rank, "gen": gen,
+            })
+            try:
+                conn.close()
+            except OSError:
+                pass
+        waiters = live_waiters
         resume = snapshot_checkpoint(self.ckpt_dir, gen)
         if resume is None:
             # nothing integrity-verified to resume from: the generation
@@ -487,6 +553,16 @@ class ElasticServer(threading.Thread):
 
 
 # ------------------------------------------------------------------ clients
+#: re-announce attempts a rejoiner gets when the rendezvous drops its
+#: connection mid-handshake or mid-park. Each attempt opens a fresh hello
+#: window (a legitimately parked rejoiner may wait far past hello_timeout
+#: before a generation turnover forces it to re-announce), so the TOTAL
+#: wait is bounded by _MAX_REANNOUNCE x (hello_timeout + admit_timeout)
+#: rather than looping forever against a server that keeps accepting and
+#: closing connections.
+_MAX_REANNOUNCE = 6
+
+
 def _connect(addr: str, overall_deadline: float) -> socket.socket:
     host, port = _split_addr(addr)
     while True:
@@ -536,9 +612,11 @@ def startup_hello(addr: str, rank: int, gen: int, hello_timeout: float,
     ElasticError on a reject or an unreachable rendezvous. A connection
     that dies mid-wait (the fleet's rank 0 exec'd between decision and
     reply, or a shrink re-formed the server) is retried transparently —
-    the new generation's server re-parks the announce.
+    the new generation's server re-parks the announce — up to
+    _MAX_REANNOUNCE times, so the total wait stays bounded.
     """
     deadline = time.monotonic() + hello_timeout
+    reannounces = 0
     while True:
         sock = _connect(addr, deadline)
         try:
@@ -564,8 +642,14 @@ def startup_hello(addr: str, rank: int, gen: int, hello_timeout: float,
             if "connection closed" not in str(e):
                 raise
             # server went away mid-wait (generation turnover): re-announce
-            if time.monotonic() >= deadline:
-                raise
+            # on a fresh hello window, but only _MAX_REANNOUNCE times —
+            # never an unbounded loop against a flapping server
+            reannounces += 1
+            if reannounces >= _MAX_REANNOUNCE:
+                raise ElasticError(
+                    f"elastic hello: rendezvous at {addr} dropped the "
+                    f"connection {reannounces} times; giving up"
+                ) from None
             deadline = time.monotonic() + hello_timeout
             time.sleep(0.5)
         except (OSError, ValueError) as e:

@@ -89,6 +89,9 @@ class SyncTimeout(RuntimeError):
 #: with a raw XlaRuntimeError, whose teardown then wedges in the
 #: distributed shutdown barrier until the coordination service's fatal
 #: error poller SIGABRTs the process (observed live in the elastic drill).
+#: Matching is fragment AND type: is_peer_failure also requires the
+#: exception to come from the jax/XLA runtime (_from_distributed_runtime),
+#: so an unrelated socket error sharing a fragment stays a program error.
 _PEER_FAILURE_FRAGMENTS = (
     "gloo",
     "connection reset by peer",
@@ -100,11 +103,31 @@ _PEER_FAILURE_FRAGMENTS = (
 )
 
 
+def _from_distributed_runtime(exc: BaseException) -> bool:
+    """Was this exception raised by the jax/XLA runtime itself
+    (XlaRuntimeError and friends), rather than application code? The
+    fragments above are deliberately broad ('gloo', 'connection refused'),
+    so the TYPE must vouch for the source: an auxiliary socket failing with
+    'Connection refused' in a sink or server must not be reclassified as a
+    peer loss and trigger a shrink-remesh/rollback."""
+    for klass in type(exc).__mro__:
+        mod = (getattr(klass, "__module__", "") or "").split(".", 1)[0]
+        if mod in ("jax", "jaxlib"):
+            return True
+        if "xlaruntimeerror" in klass.__name__.lower():
+            return True
+    return False
+
+
 def is_peer_failure(exc: BaseException) -> bool:
     """Does this exception look like the distributed runtime reporting a
-    dead/unreachable peer (as opposed to a genuine program error)?"""
+    dead/unreachable peer (as opposed to a genuine program error)? Both
+    the message (a known peer-death fragment) and the type (the jax/XLA
+    runtime raised it) must agree."""
     msg = str(exc).lower()
-    return any(f in msg for f in _PEER_FAILURE_FRAGMENTS)
+    if not any(f in msg for f in _PEER_FAILURE_FRAGMENTS):
+        return False
+    return _from_distributed_runtime(exc)
 
 
 # ------------------------------------------------------ process-wide deadline

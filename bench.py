@@ -279,6 +279,22 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     S, _ = cfg.chunk_geometry(batcher.steps_per_epoch(), cap=cfg.chunk_cap)
     alphas = jnp.full((S,), cfg.init_alpha, jnp.float32)
 
+    # Derived-signal plane (obs/signals.py): the same windowed engine the
+    # CLI wires, fed at chunk boundaries — the record banks `signals`
+    # (windowed throughput/step-time stats) and, with --slo, the rule
+    # states under `slo`. Window auto = one chunk, so every dispatch is a
+    # window (the bench's natural cadence).
+    from word2vec_tpu.obs.signals import SignalEngine
+    from word2vec_tpu.obs.slo import SloEvaluator, parse_slo
+
+    slo_rules = parse_slo(args.slo)
+    signals = SignalEngine(
+        window=args.signal_window or S,
+        phases=phases,
+        flight=flight,
+        slo=SloEvaluator(slo_rules) if slo_rules else None,
+    )
+
     from word2vec_tpu.ops import resident as res
 
     use_resident = bool(args.resident) and res.corpus_fits(corpus)
@@ -341,6 +357,9 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     load_start = os.getloadavg()[0] if hasattr(os, "getloadavg") else None
     t0 = time.perf_counter()
     t_chunk = t0
+    # prime the window clock at the measurement start so even a one-chunk
+    # --smoke epoch closes a window (the trainers' first boundary opens)
+    signals.on_boundary(0, 0)
     for chunk_words, dispatch in phases.timed_iter(dispatches(), "batcher_wait"):
         with phases.span("dispatch"):
             params, m = dispatch(params, steps)
@@ -355,6 +374,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         now = time.perf_counter()
         flight.note_step(steps, t_chunk, now - t_chunk, kind="chunk", steps=S)
         t_chunk = now
+        signals.on_boundary(steps, words)
         if qprobe is not None and qprobe.due(steps):
             with phases.span("quality_probe"):
                 qprobe.probe(params, steps)
@@ -364,6 +384,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         jax.block_until_ready(params)
     dt = time.perf_counter() - t0
     wps = words / dt
+    signals.finish(steps, words)
     def sum_device(xs):
         return float(sum(float(np.sum(jax.device_get(x))) for x in xs))
 
@@ -468,6 +489,11 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "trace_summary": trace_summary,
         "cost_attribution": cost_attribution,
         "health": health,
+        # the signal plane's windowed view of the measured epoch (and the
+        # SLO rule states when --slo was set): fleet-aggregatable evidence
+        # in the same record as the raw number
+        "signals": signals.report(),
+        "slo": signals.slo.summary() if signals.slo else None,
         "manifest": obs_manifest.manifest_dict(
             cfg, vocab_size=len(vocab), plan_resolution=plan_res,
             include_config=False,
@@ -738,6 +764,16 @@ def build_parser() -> argparse.ArgumentParser:
                     "one NaN divergence past the mid-epoch checkpoint; the "
                     "idle-watchdog cost itself is banked by "
                     "benchmarks/watchdog_overhead.py)")
+    ap.add_argument("--slo", default="", metavar="RULES",
+                    help="SLO rules evaluated over the measured epoch's "
+                    "derived-signal windows (obs/slo.py grammar, e.g. "
+                    "'throughput_wps<0.8*baseline:for=3'); the banked "
+                    "record carries the rule states under 'slo'. The "
+                    "derived signals themselves bank under 'signals' "
+                    "regardless")
+    ap.add_argument("--signal-window", type=int, default=0, metavar="STEPS",
+                    help="optimizer steps per derived-signal window "
+                    "(0 = auto: one chunk)")
     ap.add_argument("--trace", default="", metavar="DIR",
                     help="export the measured epoch's span timeline as "
                     "Chrome-trace JSON to DIR/trace.json (obs/trace.py; "
@@ -912,8 +948,11 @@ def main() -> None:
         ("--quality-every", args.quality_every),
         ("--autotune", args.autotune), ("--plan-cache", args.plan_cache),
         ("--measure-steps", args.measure_steps), ("--text8", args.text8),
+        ("--signal-window", args.signal_window),
     ]:
         child_cmd += [flag, str(val)]
+    if args.slo:
+        child_cmd += ["--slo", args.slo]
     if args.faults:
         child_cmd += ["--faults", args.faults]
     if args.trace:

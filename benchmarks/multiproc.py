@@ -188,6 +188,108 @@ def _run_chaos(args, result, tmp, procs, logs, victim, t0) -> None:
     print(json.dumps(result))
 
 
+def _run_signals(args, result, tmp, procs, logs, straggler, t0) -> None:
+    """Fleet signal-plane drill (ISSUE 11 acceptance): N real
+    jax.distributed processes share one metrics dir; an injected stall
+    stretch slows ONE rank; the drill asserts (a) fleet.json names that
+    host as the straggler, (b) the --slo throughput rule escalates
+    warn -> breach on rank 0's metrics stream, and (c) the SloEvent is on
+    the signal ring of the flight.json the end-of-drill preemption dumps."""
+    import json as _json
+
+    from word2vec_tpu.obs.fleet import validate_fleet_doc
+    from word2vec_tpu.resilience.shutdown import EXIT_PREEMPTED
+
+    result["chaos"] = "signals"
+    result["straggler_rank"] = straggler
+
+    def tail(r):
+        logs[r].seek(0)
+        return logs[r].read().strip().splitlines()[-10:]
+
+    def fail(msg, ranks=()):
+        result["error"] = msg
+        result["log_tails"] = [tail(r) for r in ranks]
+        print(_json.dumps(result))
+
+    deadline = time.time() + args.timeout
+    for r, p in enumerate(procs):
+        try:
+            p.wait(timeout=max(1.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            return fail(f"signals drill hang (> {args.timeout:.0f}s)",
+                        range(len(procs)))
+    result["wall_s"] = round(time.perf_counter() - t0, 1)
+    result["rcs"] = [p.returncode for p in procs]
+    # the injected sigterm@30 preempts the WHOLE fleet cooperatively
+    if any(rc != EXIT_PREEMPTED for rc in result["rcs"]):
+        return fail(
+            f"expected every rank to exit {EXIT_PREEMPTED} (the injected "
+            f"SIGTERM preemption), got {result['rcs']}", range(len(procs)),
+        )
+    mdir = os.path.join(tmp, "msig")
+    # (a) fleet.json: schema-valid, every host present, straggler named
+    try:
+        with open(os.path.join(mdir, "fleet.json")) as f:
+            doc = _json.load(f)
+        counts = validate_fleet_doc(doc)
+    except (OSError, ValueError) as e:
+        return fail(f"fleet.json invalid/missing: {e}", [0])
+    result["fleet"] = {
+        "hosts": doc["hosts"],
+        "windows": counts["windows"],
+        "straggler": doc.get("straggler"),
+    }
+    if counts["hosts"] != len(procs):
+        return fail(f"fleet.json saw {doc['hosts']}, want {len(procs)} "
+                    "hosts", [0])
+    if not doc.get("straggler") or doc["straggler"]["host"] != straggler:
+        return fail(
+            f"fleet.json straggler {doc.get('straggler')} does not name "
+            f"the injected rank {straggler}", [0, straggler],
+        )
+    # (b) warn -> breach escalation on rank 0's metrics stream
+    try:
+        with open(os.path.join(mdir, "metrics.jsonl")) as f:
+            recs = [_json.loads(line) for line in f]
+    except (OSError, ValueError) as e:
+        return fail(f"metrics.jsonl unreadable: {e}", [0])
+    slo = [r for r in recs if str(r.get("event", "")).startswith("slo_")]
+    result["slo_events"] = [
+        {"event": r["event"], "window": r.get("window"),
+         "value": r.get("value"), "threshold": r.get("threshold")}
+        for r in slo
+    ]
+    warns = [r for r in slo if r["event"] == "slo_warn"]
+    breaches = [r for r in slo if r["event"] == "slo_breach"]
+    if not warns or not breaches:
+        return fail(f"expected warn AND breach SloEvents, got {slo}", [0])
+    if warns[0].get("window") > breaches[0].get("window"):
+        return fail(f"escalation out of order: {slo}", [0])
+    # (c) the SloEvent is in the flight dump the preemption wrote
+    try:
+        with open(os.path.join(mdir, "flight.json")) as f:
+            flight = _json.load(f)
+    except (OSError, ValueError) as e:
+        return fail(f"flight.json unreadable: {e}", [0])
+    ring_events = [r.get("event") for r in flight.get("signals", [])]
+    result["flight"] = {
+        "reason": flight.get("reason"),
+        "signal_ring_events": sorted(
+            {e for e in ring_events if isinstance(e, str)}
+        ),
+    }
+    if "slo_breach" not in ring_events:
+        return fail(
+            "flight.json signal ring carries no slo_breach: "
+            f"{ring_events[-10:]}", [0],
+        )
+    result["ok"] = True
+    print(_json.dumps(result))
+
+
 def _manifest(tmp, rank=0):
     try:
         with open(os.path.join(tmp, f"m{rank}", "manifest.json")) as f:
@@ -496,7 +598,14 @@ def main() -> None:
                     "with the step/sync deadlines, and assert the "
                     "survivors exit within them instead of hanging; the "
                     "special value 'elastic' runs the elastic shrink/grow "
-                    "drill instead (survivors must remesh and CONTINUE)")
+                    "drill instead (survivors must remesh and CONTINUE); "
+                    "the special value 'signals' runs the fleet signal-"
+                    "plane drill (obs/signals.py): repeated stalls slow "
+                    "--chaos-rank, every rank publishes windowed signal "
+                    "rows into ONE shared metrics dir, and the drill "
+                    "asserts fleet.json names the straggler host, the "
+                    "--slo throughput rule escalates warn->breach, and "
+                    "the SloEvent lands in rank 0's flight.json")
     ap.add_argument("--elastic-mode", choices=["shrink", "shrink+grow"],
                     default="shrink+grow",
                     help="--chaos elastic: shrink runs the kill->remesh leg "
@@ -565,6 +674,7 @@ def main() -> None:
 
         # --- multi-process run -------------------------------------------
         elastic = args.chaos == "elastic"
+        signals_drill = args.chaos == "signals"
         victim = None
         if args.chaos:
             victim = (
@@ -601,8 +711,36 @@ def main() -> None:
                     "--chunk-steps", "1",
                     "--step-deadline", str(args.step_deadline),
                     "--sync-deadline", str(args.sync_deadline),
-                    "--metrics-dir", f"m{r}",
+                    # signals drill: ONE shared metrics dir — each rank's
+                    # signals_p<r>.jsonl is a distinct file (the PR 6
+                    # trace_p<i>.json discipline) and rank 0 merges them
+                    "--metrics-dir", "msig" if signals_drill else f"m{r}",
                 ]
+                if signals_drill:
+                    extra += [
+                        "--signal-window", "5",
+                        # baseline from the first 2 clean windows; the
+                        # injected stall stretch must drop throughput below
+                        # 60% of it for 2 consecutive windows -> breach
+                        "--slo",
+                        "throughput_wps<0.6*baseline:for=2:baseline=2",
+                        "--checkpoint-dir", f"ck{r}",
+                        "--checkpoint-every", "10",
+                    ]
+                    if r == victim:
+                        # the injected straggler: a 0.25s stall at every
+                        # boundary in steps 10..26 — long enough to span
+                        # several windows, slow enough to never trip the
+                        # step watchdog
+                        extra += ["--faults", ",".join(
+                            f"stall@{s}:secs=0.25" for s in range(10, 27)
+                        )]
+                    elif r == 0:
+                        # the drill's flight trigger: a SIGTERM fault well
+                        # after the breach preempts the fleet cooperatively
+                        # (rc 75 everywhere) and rank 0 dumps flight.json
+                        # with the SloEvents on its signal ring
+                        extra += ["--faults", "sigterm@30"]
                 if elastic:
                     extra += [
                         "--elastic", args.elastic_mode,
@@ -623,7 +761,7 @@ def main() -> None:
                         "--checkpoint-dir", f"ck{r}",
                         "--checkpoint-every", "5",
                     ]
-                if r == victim:
+                if r == victim and not signals_drill:
                     kind = (
                         "peer_rejoin" if args.elastic_mode == "shrink+grow"
                         else "peer_dead"
@@ -648,6 +786,9 @@ def main() -> None:
         if elastic:
             _run_elastic(args, result, tmp, procs, logs, victim,
                          cmds, envs, dp, t0)
+            return
+        if signals_drill:
+            _run_signals(args, result, tmp, procs, logs, victim, t0)
             return
         if args.chaos:
             _run_chaos(args, result, tmp, procs, logs, victim, t0)

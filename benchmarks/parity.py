@@ -299,12 +299,32 @@ def main() -> None:
         ]
 
         if not args.skip_reference:
-            exe = build(tmp)
-            subprocess.run(
-                [exe, *common, "-output", "vec_ref.txt", "-threads", "1"],
-                cwd=tmp, check=True, capture_output=True,
-            )
-            result["reference"] = evaluate(os.path.join(tmp, "vec_ref.txt"))
+            # A missing/unbuildable reference degrades to a structured
+            # error instead of killing the harness: our side still trains
+            # and scores, so absolute-floor gates (and environments without
+            # /root/reference mounted) keep working — the same shape the
+            # reference's own cbow+hs latent bug already produces.
+            try:
+                exe = build(tmp)
+                subprocess.run(
+                    [exe, *common, "-output", "vec_ref.txt", "-threads", "1"],
+                    cwd=tmp, check=True, capture_output=True,
+                )
+                result["reference"] = evaluate(os.path.join(tmp, "vec_ref.txt"))
+            except (subprocess.CalledProcessError, OSError) as e:
+                from measure_baseline import REFERENCE
+
+                missing = not os.path.exists(
+                    os.path.join(REFERENCE, "Word2Vec.cpp")
+                )
+                result["reference"] = {
+                    "error": (
+                        f"reference source tree {REFERENCE} not present in "
+                        "this environment"
+                        if missing else
+                        f"reference build/run failed: {e}"
+                    ),
+                }
 
         subprocess.run(
             [

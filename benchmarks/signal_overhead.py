@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Measure the derived-signal plane's overhead on the CPU drill shape.
+
+The signal-plane contract (obs/signals.py) is the same standing one as
+trace/watchdog/quality before it: the per-boundary beat (`on_boundary`) is
+one clock read + an integer compare off the window edge, with zero device
+fetches; the window close (once per `window` steps) is host-side float math
+plus one small row publish. This harness pins the <1% wall number instead
+of a hope — the watchdog/trace A/B discipline: train the same synthetic
+shape with the engine attached (window 50, an SLO rule, a fleet aggregator
+writing rows+fleet.json into a temp metrics dir — the FULL production
+wiring) and detached, alternating reps, median wall; then time one beat
+against the run's own p50 step time.
+
+One JSON line to stdout (bank as benchmarks/SIGNAL_OVERHEAD_cpu.json):
+    python benchmarks/signal_overhead.py [--tokens 200000] [--reps 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=200_000)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--batch-rows", type=int, default=64)
+    ap.add_argument("--window", type=int, default=50)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.obs.fleet import FleetAggregator
+    from word2vec_tpu.obs.signals import SignalEngine
+    from word2vec_tpu.obs.slo import SloEvaluator, parse_slo
+    from word2vec_tpu.train import Trainer
+    from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=5, word_dim=args.dim,
+        window=5, batch_rows=args.batch_rows, max_sentence_len=192,
+        min_count=1, iters=1, seed=0,
+        chunk_steps=1,  # per-step boundaries: the worst case for beat count
+    )
+    vocab = zipf_vocab(71000, 17_000_000)
+    flat = np.concatenate(zipf_corpus_ids(vocab, args.tokens, seed=0))
+    ids = [flat[i:i + 1000] for i in range(0, len(flat), 1000)]
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    trainer = Trainer(cfg, vocab, corpus)
+    tmp = tempfile.mkdtemp(prefix="w2v_signal_overhead_")
+
+    def make_engine():
+        return SignalEngine(
+            window=args.window,
+            phases=trainer.phases,
+            flight=trainer.flight,
+            metrics_dir=tmp,
+            host=0,
+            slo=SloEvaluator(
+                parse_slo("throughput_wps<0.5*baseline:for=3")
+            ),
+            aggregator=FleetAggregator(tmp, window_steps=args.window),
+        )
+
+    def timed_run(wired: bool):
+        trainer.signals = make_engine() if wired else None
+        t0 = time.perf_counter()
+        _, rep = trainer.train(state=trainer.init_state(), log_every=0)
+        if trainer.signals is not None:
+            trainer.signals.close()
+        return time.perf_counter() - t0, rep
+
+    timed_run(True)  # warmup: compile out of the measurement
+    base_walls, wired_walls, steps, windows = [], [], 0, 0
+    for i in range(args.reps):
+        # ORDER-FAIR alternation: on this host the second run of any
+        # back-to-back pair is systematically slower (allocator/frequency
+        # drift), enough to swamp a sub-1% effect — measured both ways at
+        # ±20% with a fixed order. Flipping which leg goes first per rep
+        # cancels the bias instead of hoping it away.
+        for wired in ((False, True) if i % 2 == 0 else (True, False)):
+            w, rep = timed_run(wired)
+            if wired:
+                wired_walls.append(w)
+                windows = (rep.signals or {}).get("windows", 0)
+            else:
+                base_walls.append(w)
+                steps = rep.steps
+
+    # per-beat microcost against the run's own step time (the only
+    # per-boundary work; window closes amortize over `window` steps)
+    _, rep = trainer.train(state=trainer.init_state(), log_every=0)
+    step_durs_ms = sorted(
+        e["dur"] / 1e3
+        for e in trainer.flight.ring.events()
+        if e.get("ph") == "X" and e["name"] == "step"
+    )
+    p50_step_ms = step_durs_ms[len(step_durs_ms) // 2]
+    probe = SignalEngine(window=10_000_000)  # beat cost only, never closes
+    n = 100_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        probe.on_boundary(i, i * 100)
+    per_beat_us = 1e6 * (time.perf_counter() - t0) / n
+
+    # window-close microcost, measured directly with the FULL production
+    # wiring (phases snapshot + row publish + SLO evaluate + fleet
+    # aggregate + fleet.json rewrite): window=1 makes every boundary a
+    # close. This is the honest per-window number — the wall A/B above is
+    # bistable +/-20% on the shared 1-core bench host (runs straddle zero),
+    # so the microcosts are what the in-suite contract test enforces.
+    tmp2 = tempfile.mkdtemp(prefix="w2v_signal_close_")
+    closer = SignalEngine(
+        window=1, phases=trainer.phases, flight=trainer.flight,
+        metrics_dir=tmp2, host=0,
+        slo=SloEvaluator(parse_slo("throughput_wps<0.5*baseline:for=3")),
+        aggregator=FleetAggregator(tmp2, window_steps=1),
+    )
+    n_close = 200
+    t0 = time.perf_counter()
+    for i in range(1, n_close + 1):
+        closer.on_boundary(i, i * 100)
+    per_close_ms = 1e3 * (time.perf_counter() - t0) / n_close
+    closer.close()
+
+    base = statistics.median(base_walls)
+    wired = statistics.median(wired_walls)
+    overhead_pct = 100.0 * (wired - base) / base
+    # min-wall overhead: the noise-robust same-work estimator — host
+    # contention only ever ADDS time, so the minima are the cleanest
+    # observation of each leg on a shared host
+    min_overhead_pct = 100.0 * (min(wired_walls) - min(base_walls)) / min(
+        base_walls
+    )
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": f"derived-signal plane overhead "
+                  f"({args.tokens // 1000}k zipf, {dev.platform})",
+        "value": round(overhead_pct, 2),
+        "unit": "% wall",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "steps_per_run": steps,
+        "windows_per_run": windows,
+        "signal_window_steps": args.window,
+        "reps": args.reps,
+        "base_wall_s": [round(w, 3) for w in base_walls],
+        "wired_wall_s": [round(w, 3) for w in wired_walls],
+        "median_base_s": round(base, 3),
+        "median_wired_s": round(wired, 3),
+        "min_overhead_pct": round(min_overhead_pct, 2),
+        "p50_step_ms": round(p50_step_ms, 3),
+        "beat_cost_us": round(per_beat_us, 3),
+        "beat_cost_pct_of_step": round(
+            100.0 * per_beat_us / (1e3 * p50_step_ms), 4
+        ),
+        "close_cost_ms": round(per_close_ms, 3),
+        # one close amortizes over `window` steps: its share of window wall
+        "close_cost_pct_of_window": round(
+            100.0 * per_close_ms / (args.window * p50_step_ms), 4
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

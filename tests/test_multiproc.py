@@ -93,6 +93,39 @@ def test_kill_one_of_n_survivors_exit_within_deadline():
     assert result["survivor_flights"].get("0"), result
 
 
+def test_signal_plane_three_proc_drill():
+    """Fleet signal-plane acceptance (ISSUE 11, obs/signals.py +
+    obs/fleet.py + obs/slo.py): 3 real jax.distributed processes share one
+    metrics dir; repeated stall faults slow rank 2. The drill must show
+    (a) fleet.json naming the injected straggler host, (b) the --slo
+    throughput rule escalating warn -> breach on the injected slowdown,
+    and (c) the SloEvent present on the flight.json signal ring — with
+    every rank exiting EXIT_PREEMPTED from the end-of-drill SIGTERM fault
+    (a breach itself must NEVER exit: observe, don't actuate)."""
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "multiproc.py"),
+            "--procs", "3", "--devices-per-proc", "2",
+            "--tokens", "120000", "--iters", "3",
+            "--chaos", "signals",
+            "--step-deadline", "10", "--sync-deadline", "10",
+            "--timeout", "300",
+        ],
+        capture_output=True, text=True, timeout=420,
+        env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result.get("ok"), result
+    assert result["rcs"] == [75, 75, 75], result
+    assert result["fleet"]["straggler"]["host"] == 2, result
+    events = [e["event"] for e in result["slo_events"]]
+    assert "slo_warn" in events and "slo_breach" in events, result
+    assert events.index("slo_warn") < events.index("slo_breach"), result
+    assert "slo_breach" in result["flight"]["signal_ring_events"], result
+
+
 def _elastic_drill(mode: str, timeout: int):
     out = subprocess.run(
         [

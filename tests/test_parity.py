@@ -36,6 +36,29 @@ pytestmark = [
     ),
 ]
 
+# The reference SOURCE tree (/root/reference/{main,Word2Vec}.cpp) is mounted
+# in the original measurement environment but absent from plain containers —
+# there every delta-vs-reference cell fails at the g++ build step, never on
+# parity itself (the drift the PR 10 review flagged as "8 pre-existing
+# test_parity failures"). benchmarks/parity.py now degrades a missing
+# reference to a structured {"error": ...} record, which fixes the cells
+# that only need OUR side (cbow+hs below); the cells that genuinely compare
+# against the reference are xfail(strict=False) so they read clean here and
+# still run-and-pass wherever the source is mounted.
+_REFERENCE = "/root/reference"
+_REFERENCE_MISSING = not os.path.exists(
+    os.path.join(_REFERENCE, "Word2Vec.cpp")
+)
+needs_reference = pytest.mark.xfail(
+    condition=_REFERENCE_MISSING,
+    reason=(
+        f"C++ reference source tree {_REFERENCE} is not mounted in this "
+        "environment: the cell fails at the reference build/run step, not "
+        "on parity (benchmarks/parity.py records reference.error instead)"
+    ),
+    strict=False,
+)
+
 
 def run_parity(*extra):
     out = subprocess.run(
@@ -72,6 +95,7 @@ MATRIX = [
     MATRIX,
     ids=lambda v: v if isinstance(v, str) else ("-".join(v) or "auto"),
 )
+@needs_reference
 def test_eval_score_parity_with_reference(model, method, extra):
     result = run_parity("--model", model, "--train-method", method, *extra)
     ref, ours = result["reference"], result["ours"]
@@ -91,6 +115,7 @@ def test_eval_score_parity_with_reference(model, method, extra):
     assert result["ours"]["cos_margin"] > 0.3, result
 
 
+@needs_reference
 def test_full_budget_margin_delta_vs_reference():
     """Regression gate PAST the spearman tie ceiling (VERDICT r3 item 8).
 
@@ -114,6 +139,7 @@ def test_full_budget_margin_delta_vs_reference():
     assert abs(result["delta_margin"]) < 0.02, result
 
 
+@needs_reference
 def test_graded_similarity_parity_with_reference():
     """The r5 tie-ceiling-free axis (VERDICT r4 weak item 5): both sides
     train on the graded-overlap pair corpus and are scored by Spearman vs
@@ -136,6 +162,7 @@ def test_graded_similarity_parity_with_reference():
     assert abs(result["delta_spearman_graded"]) < 0.103, result
 
 
+@needs_reference
 def test_analogy_parity_with_reference():
     """The Google-analogy half of the BASELINE accuracy gate: train both
     implementations on the planted compositional-grid corpus

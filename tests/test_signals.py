@@ -1,0 +1,600 @@
+"""Fleet signal plane (obs/signals.py + obs/slo.py + obs/fleet.py).
+
+Pins the ISSUE-11 contracts: signal math (EWMA/percentile/slope), windowed
+derivation with zero added device fetches and <1% overhead, deterministic
+cross-host fleet merge under skewed wall clocks with straggler attribution,
+SLO parse negatives + ok->warn->breach escalation, MetricsHub sink-failure
+isolation, the Prometheus cumulative histograms, and the flight recorder's
+signal ring.
+"""
+
+import json
+import os
+import statistics
+import time
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.obs.export import MetricsHub, PrometheusTextfile
+from word2vec_tpu.obs.fleet import (
+    FleetAggregator, fleet_doc, merge_rows, validate_fleet_doc,
+)
+from word2vec_tpu.obs.flight import FlightRecorder
+from word2vec_tpu.obs.signals import (
+    Histogram, Signal, SignalBus, SignalEngine, ewma, percentile, slope,
+)
+from word2vec_tpu.obs.slo import (
+    SloError, SloEvaluator, SloRule, parse_slo,
+)
+from word2vec_tpu.train import Trainer
+from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+
+def _setup(**kw):
+    kw.setdefault("iters", 2)
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        batch_rows=4, max_sentence_len=16, min_count=1, seed=9, **kw,
+    )
+    vocab = zipf_vocab(40, 4000)
+    ids = zipf_corpus_ids(vocab, 3000, seed=5)
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    return cfg, vocab, corpus
+
+
+# ------------------------------------------------------------- signal math
+class TestSignalMath:
+    def test_ewma_converges_to_constant(self):
+        assert ewma([5.0] * 20) == pytest.approx(5.0)
+
+    def test_ewma_weights_recent(self):
+        # a step from 0 to 10 pulls the EWMA most of the way, not halfway
+        v = ewma([0.0] * 10 + [10.0] * 10, alpha=0.3)
+        assert 9.0 < v <= 10.0
+
+    def test_ewma_empty(self):
+        assert ewma([]) == 0.0
+
+    def test_percentile_nearest_rank(self):
+        xs = [float(i) for i in range(1, 11)]
+        assert percentile(xs, 0.5) == 5.0
+        assert percentile(xs, 0.9) == 9.0
+        assert percentile([], 0.5) == 0.0
+
+    def test_slope_exact_line(self):
+        pts = [(w, 2.0 * w + 1.0) for w in range(10)]
+        assert slope(pts) == pytest.approx(2.0)
+
+    def test_slope_degenerate(self):
+        assert slope([]) == 0.0
+        assert slope([(1, 5.0)]) == 0.0
+        assert slope([(1, 5.0), (1, 9.0)]) == 0.0  # no x spread
+
+    def test_signal_ring_stats(self):
+        s = Signal("x", ring=4)
+        for w, v in enumerate([1.0, 2.0, 3.0, 4.0, 5.0]):
+            s.observe(w, v)
+        st = s.stats()
+        assert st["n"] == 4  # ring-bounded: oldest evicted
+        assert st["last"] == 5.0
+        assert st["slope_per_window"] == pytest.approx(1.0)
+
+    def test_histogram_cumulative(self):
+        h = Histogram(buckets=(0.01, 0.1))
+        for v in (0.005, 0.05, 0.5, 0.005):
+            h.observe(v)
+        rec = h.to_record()
+        assert rec["counts"] == [2, 3, 4]  # cumulative le counts, +Inf last
+        assert rec["count"] == 4
+        assert rec["sum"] == pytest.approx(0.56)
+
+
+# ------------------------------------------------------------------ engine
+class TestSignalEngine:
+    def test_windows_close_and_throughput(self, tmp_path):
+        rows = []
+        eng = SignalEngine(window=10, log_fn=rows.append,
+                           metrics_dir=str(tmp_path), host=3)
+        words = 0
+        for step in range(1, 31):
+            words += 50
+            eng.on_boundary(step, words)
+        eng.finish(30, words)
+        sig_rows = [r for r in rows if r.get("event") == "signals"]
+        assert len(sig_rows) == 3  # two full windows + the tail
+        assert all(r["host"] == 3 for r in sig_rows)
+        for r in sig_rows:
+            assert r["signal_throughput_wps"] > 0
+            assert r["window_words"] == r["window_steps"] * 50
+        # window ids derive from the shared step counter, not a clock
+        assert [r["window"] for r in sig_rows] == [0, 1, 2]
+        # the per-host row file is the fleet aggregator's input
+        path = tmp_path / "signals_p3.jsonl"
+        disk = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [r["window"] for r in disk] == [0, 1, 2]
+        # every row carries the cumulative step-time histogram
+        assert sig_rows[-1]["step_time_seconds_hist"]["count"] > 0
+        eng.close()
+
+    def test_report_shape_and_tail_window(self):
+        eng = SignalEngine(window=100)
+        words = 0
+        for step in range(1, 31):  # shorter than one window
+            words += 10
+            eng.on_boundary(step, words)
+        assert eng.report() is None  # nothing closed yet
+        eng.finish(30, words)
+        rep = eng.report()
+        assert rep["windows"] == 1
+        assert "throughput_wps" in rep["signals"]
+        assert rep["fleet_health"]["verdict"] == "ok"
+
+    def test_quality_harvested_from_hub_records(self):
+        rows = []
+        eng = SignalEngine(window=5, log_fn=rows.append)
+        eng({"step": 3, "quality_analogy_accuracy": 0.75,
+             "quality_spearman": 0.9})
+        words = 0
+        for step in range(1, 12):
+            words += 10
+            eng.on_boundary(step, words)
+        sig = [r for r in rows if r.get("event") == "signals"]
+        assert sig and sig[-1]["signal_quality_planted"] == 0.75
+
+    def test_heartbeat_derives_straggler_skew(self):
+        rows = []
+        eng = SignalEngine(window=5, log_fn=rows.append, host=0)
+        # (pid, stop, step, p50_ms, elastic): host 2 is 6x the median
+        eng.note_heartbeat(
+            [[0, 0, 5, 10.0, 0], [1, 0, 5, 12.0, 0], [2, 0, 5, 60.0, 0]], 5
+        )
+        words = 0
+        for step in range(1, 12):
+            words += 10
+            eng.on_boundary(step, words)
+        sig = [r for r in rows if r.get("event") == "signals"]
+        assert sig[-1]["signal_straggler_skew"] == pytest.approx(5.0)
+        assert sig[-1]["straggler_host"] == 2
+
+    def test_own_rows_not_reharvested(self):
+        eng = SignalEngine(window=5)
+        eng({"event": "signals", "signal_quality_planted": 0.1,
+             "quality_spearman": 0.1})
+        words = 0
+        for step in range(1, 12):
+            words += 10
+            eng.on_boundary(step, words)
+        eng.finish(11, words)
+        assert "quality_planted" not in eng.report()["signals"]
+
+    def test_serve_mode_windows_by_epoch_seconds(self, tmp_path):
+        rows = []
+        eng = SignalEngine(window_s=10.0, log_fn=rows.append,
+                           metrics_dir=str(tmp_path), host=77)
+        eng.observe_serve(
+            {"serve_qps": 100.0, "serve_p99_ms": 12.0,
+             "serve_cache_hit_rate": 0.5}, now=1000.0)
+        eng.observe_serve(
+            {"serve_qps": 120.0, "serve_p99_ms": 15.0,
+             "serve_cache_hit_rate": 0.6}, now=1012.0)  # next window
+        sig = [r for r in rows if r.get("event") == "signals"]
+        assert len(sig) == 1
+        assert sig[0]["window"] == 100  # 1000 // 10
+        assert sig[0]["signal_serve_qps"] == 100.0
+        assert sig[0]["signal_cache_hit"] == 0.5
+        assert sig[0]["mode"] == "serve"
+        eng.close()
+
+
+class TestSignalBus:
+    def test_subscribe_publish_unsubscribe(self):
+        bus = SignalBus()
+        got = []
+        un = bus.subscribe("throughput_wps", got.append)
+        bus.publish("throughput_wps", {"value": 1.0})
+        un()
+        bus.publish("throughput_wps", {"value": 2.0})
+        assert got == [{"value": 1.0}]
+
+    def test_raising_subscriber_detached_not_fatal(self):
+        bus = SignalBus()
+        good = []
+
+        def bad(_):
+            raise RuntimeError("boom")
+
+        bus.subscribe("s", bad)
+        bus.subscribe("s", good.append)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            bus.publish("s", {"v": 1})
+            bus.publish("s", {"v": 2})
+        assert len(good) == 2
+        assert any("detaching" in str(x.message) for x in w)
+
+    def test_engine_publishes_per_signal_topics(self):
+        eng = SignalEngine(window=5)
+        got = []
+        eng.bus.subscribe("throughput_wps", got.append)
+        words = 0
+        for step in range(1, 12):
+            words += 10
+            eng.on_boundary(step, words)
+        assert got and got[0]["value"] > 0
+
+
+# ----------------------------------------------------------------- SLO
+class TestSloParse:
+    def test_literal_and_relative(self):
+        r1, r2 = parse_slo("serve_p99_ms>250:for=2,throughput_wps<0.8*baseline")
+        assert (r1.signal, r1.op, r1.factor, r1.relative) == (
+            "serve_p99_ms", ">", 250.0, False)
+        assert r1.for_n == 2
+        assert (r2.signal, r2.relative, r2.factor) == (
+            "throughput_wps", True, 0.8)
+
+    def test_json_file_form(self, tmp_path):
+        p = tmp_path / "rules.json"
+        p.write_text(json.dumps([
+            "throughput_wps<0.5*baseline:for=4",
+            {"rule": "serve_p99_ms>100"},
+        ]))
+        rules = parse_slo(str(p))
+        assert len(rules) == 2 and rules[0].for_n == 4
+
+    @pytest.mark.parametrize("spec,fragment", [
+        ("bogus@x", "expected <signal><op><threshold>"),
+        ("a<1,qps>>5", "rule 2"),
+        ("qps<banana", "not a number"),
+        ("qps<0.8*peak", "baseline"),
+        ("qps<1:for=0", "must be >= 1"),
+        ("qps<1:hold=3", "unknown option"),
+        ("qps<1:for", "key=value"),
+        ("9bad<1", "bad signal name"),
+    ])
+    def test_parse_negatives_name_clause_and_offset(self, spec, fragment):
+        with pytest.raises(SloError) as ei:
+            parse_slo(spec)
+        msg = str(ei.value)
+        assert fragment in msg
+        assert "at offset" in msg  # the fault-spec contract
+
+    def test_offset_points_at_the_clause(self):
+        with pytest.raises(SloError) as ei:
+            parse_slo("a<1,b<2,c<x")
+        assert "rule 3 ('c<x') at offset 8" in str(ei.value)
+
+    def test_empty_spec_is_no_rules(self):
+        assert parse_slo("") == []
+        assert parse_slo("  ") == []
+
+
+class TestSloEvaluate:
+    def test_ok_warn_breach_recovered(self):
+        ev = SloEvaluator(parse_slo("tp<0.8*baseline:for=3:baseline=2"))
+        events = []
+        # two baseline windows (median 100), then degrade
+        for w, v in enumerate([100.0, 100.0, 50.0, 50.0, 50.0, 50.0, 100.0]):
+            events += ev.evaluate({"tp": v}, w)
+        kinds = [e["event"] for e in events]
+        assert kinds == ["slo_warn", "slo_breach", "slo_recovered"]
+        warn, breach, rec = events
+        assert warn["window"] == 2 and warn["threshold"] == pytest.approx(80.0)
+        assert breach["streak"] == 3
+        assert rec["from"] == "breach"
+        s = ev.summary()
+        assert s["state"] == "ok" and s["breaches_total"] == 1
+
+    def test_breach_counted_once_per_episode(self):
+        ev = SloEvaluator(parse_slo("tp<10:for=1"))
+        n = 0
+        for w in range(5):
+            n += sum(1 for e in ev.evaluate({"tp": 1.0}, w)
+                     if e["event"] == "slo_breach")
+        assert n == 1
+
+    def test_missing_signal_is_pending_not_breach(self):
+        ev = SloEvaluator(parse_slo("serve_p99_ms>10"))
+        assert ev.evaluate({"tp": 1.0}, 0) == []
+        assert ev.summary()["state"] == "ok"
+
+    def test_greater_than_direction(self):
+        ev = SloEvaluator(parse_slo("p99>100:for=2"))
+        out = []
+        for w, v in enumerate([50.0, 150.0, 150.0]):
+            out += ev.evaluate({"p99": v}, w)
+        assert [e["event"] for e in out] == ["slo_warn", "slo_breach"]
+
+    def test_breach_counter_in_prometheus(self, tmp_path):
+        prom = PrometheusTextfile(str(tmp_path / "m.prom"))
+        prom({"step": 1, "loss": 1.0})
+        assert "w2v_slo_breaches_total 0.0" in prom.render()  # from zero
+        prom({"event": "slo_breach", "rule": "tp<1", "value": 0.5,
+              "threshold": 1.0})
+        assert "w2v_slo_breaches_total 1.0" in prom.render()
+
+
+# ------------------------------------------------------------- fleet merge
+def _host_rows(host, windows, p50_ms, wps, clock0=0.0):
+    """Synthetic per-host rows: clock0 skews wall-derived fields to prove
+    the merge never keys on them."""
+    rows = []
+    for w in windows:
+        rows.append({
+            "event": "signals", "window": w, "host": host,
+            "step": (w + 1) * 10,
+            "window_wall_s": round(0.5 + clock0, 4),
+            "signal_throughput_wps": wps,
+            "signal_step_time_p50_ms": p50_ms,
+        })
+    return rows
+
+
+class TestFleetMerge:
+    def test_three_hosts_skewed_clocks_deterministic(self):
+        # three hosts whose wall clocks disagree by hours — rows merge by
+        # window id; input order must never change the output
+        rows = (
+            _host_rows(0, [0, 1, 2], p50_ms=10.0, wps=1000.0, clock0=0.0)
+            + _host_rows(1, [0, 1, 2], p50_ms=11.0, wps=950.0, clock0=3600.0)
+            + _host_rows(2, [0, 1, 2], p50_ms=40.0, wps=400.0, clock0=-7200.0)
+        )
+        import random
+
+        m1 = merge_rows(list(rows))
+        shuffled = list(rows)
+        random.Random(3).shuffle(shuffled)
+        m2 = merge_rows(shuffled)
+        assert m1 == m2
+        assert [w["window"] for w in m1] == [0, 1, 2]
+        for w in m1:
+            assert w["hosts"] == [0, 1, 2]
+            assert w["throughput_wps"] == pytest.approx(2350.0)
+            # straggler attribution: host 2 at ~3.6x the median
+            assert w["straggler"]["host"] == 2
+            assert w["straggler"]["vs_median"] == pytest.approx(40 / 11.0,
+                                                                rel=1e-3)
+
+    def test_partial_windows_merge_with_present_hosts(self):
+        rows = (_host_rows(0, [0, 1], 10.0, 100.0)
+                + _host_rows(1, [1], 10.0, 100.0))
+        m = merge_rows(rows)
+        assert [w["hosts"] for w in m] == [[0], [0, 1]]
+
+    def test_single_host_names_no_straggler(self):
+        m = merge_rows(_host_rows(0, [0], 50.0, 100.0))
+        assert "straggler" not in m[0]
+
+    def test_doc_straggler_attribution_and_schema(self):
+        rows = (
+            _host_rows(0, [0, 1, 2], 10.0, 1000.0)
+            + _host_rows(1, [0, 1, 2], 30.0, 400.0)
+        )
+        doc = fleet_doc(merge_rows(rows), window_steps=10)
+        counts = validate_fleet_doc(doc)
+        assert counts["hosts"] == 2 and counts["windows"] == 3
+        assert doc["straggler"]["host"] == 1
+        assert doc["straggler"]["windows_worst"] == 3
+
+    def test_validate_negatives(self):
+        with pytest.raises(ValueError, match="schema"):
+            validate_fleet_doc({"schema": 99})
+        doc = fleet_doc(merge_rows(_host_rows(0, [0, 1], 1.0, 1.0)))
+        doc["windows"][1]["window"] = 0  # break monotonicity
+        with pytest.raises(ValueError, match="increasing"):
+            validate_fleet_doc(doc)
+
+    def test_aggregator_incremental_and_gauge_record(self, tmp_path):
+        for host, p50 in ((0, 10.0), (1, 45.0)):
+            with open(tmp_path / f"signals_p{host}.jsonl", "w") as f:
+                for r in _host_rows(host, [0, 1], p50, 500.0):
+                    f.write(json.dumps(r) + "\n")
+        agg = FleetAggregator(str(tmp_path), window_steps=10)
+        rec = agg.aggregate()
+        assert rec["event"] == "fleet"
+        assert rec["fleet_hosts"] == 2
+        assert rec["fleet_throughput_wps"] == pytest.approx(1000.0)
+        assert rec["fleet_straggler_host"] == 1
+        doc = json.loads((tmp_path / "fleet.json").read_text())
+        validate_fleet_doc(doc)
+        # interval throttle: an immediate re-run is skipped (the <1%
+        # contract: re-merging at every fast window close would dominate),
+        # but force=True — the run-end pass — always merges the tail
+        with open(tmp_path / "signals_p0.jsonl", "a") as f:
+            f.write(json.dumps(_host_rows(0, [2], 10.0, 500.0)[0]) + "\n")
+        assert agg.aggregate() is None
+        rec2 = agg.aggregate(force=True)
+        assert rec2["fleet_window"] == 2
+
+    def test_watch_renders_fleet_doc(self):
+        from word2vec_tpu.obs.watch import render
+
+        doc = fleet_doc(merge_rows(
+            _host_rows(0, [0, 1], 10.0, 1000.0)
+            + _host_rows(1, [0, 1], 40.0, 300.0)
+        ), window_steps=10)
+        out = render(doc, slo={"state": "warn", "breaches_total": 0,
+                               "warns_total": 1,
+                               "rules": [{"rule": "tp<1", "state": "warn"}]})
+        assert "straggler" in out and "host 1" in out
+        assert "throughput_wps" in out and "warn" in out
+
+
+# -------------------------------------------------- hub sink isolation
+class TestSinkIsolation:
+    def test_poisoned_sink_warns_detaches_run_survives(self):
+        good = []
+        calls = {"n": 0}
+
+        def poisoned(rec):
+            calls["n"] += 1
+            raise OSError("disk full")
+
+        hub = MetricsHub(poisoned, good.append)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            hub({"step": 1})
+            hub({"step": 2})
+        assert calls["n"] == 1  # detached after the first raise
+        assert len(good) == 2  # the healthy sink saw everything
+        assert any("detached" in str(x.message) for x in w)
+        assert hub.sinks == [good.append] or len(hub.sinks) == 1
+
+    def test_slow_sink_detached(self):
+        good = []
+
+        def slow(rec):
+            time.sleep(0.05)
+
+        hub = MetricsHub(slow, good.append, slow_sink_s=0.01)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            hub({"step": 1})
+            hub({"step": 2})
+        assert len(good) == 2
+        assert len(hub.sinks) == 1
+        assert any("wedged or blocking" in str(x.message) for x in w)
+
+    def test_detached_sink_still_closed(self):
+        closed = []
+
+        class Bad:
+            def __call__(self, rec):
+                raise RuntimeError("x")
+
+            def close(self):
+                closed.append(True)
+
+        hub = MetricsHub(Bad())
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            hub({"a": 1})
+        hub.close()
+        assert closed == [True]
+
+    def test_poisoned_sink_does_not_kill_training_step(self):
+        """Regression: a raising sink on the hub must not abort train()."""
+        cfg, vocab, corpus = _setup(iters=1, chunk_steps=1)
+
+        def poisoned(rec):
+            raise OSError("sink down")
+
+        hub = MetricsHub(poisoned)
+        t = Trainer(cfg, vocab, corpus, log_fn=hub)
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            state, rep = t.train(log_every=1)
+        assert rep.steps > 0  # the run completed despite the sink
+
+
+# ------------------------------------------- trainer integration + pins
+class TestTrainerIntegration:
+    def test_trainer_report_carries_signals(self):
+        cfg, vocab, corpus = _setup(chunk_steps=1)
+        t = Trainer(cfg, vocab, corpus)
+        t.signals = SignalEngine(window=8, phases=t.phases, flight=t.flight)
+        state, rep = t.train(log_every=0)
+        assert rep.signals is not None
+        assert rep.signals["windows"] >= rep.steps // 8
+        sig = rep.signals["signals"]
+        assert sig["throughput_wps"]["last"] > 0
+        assert "step_time_p50_ms" in sig
+        assert rep.signals["fleet_health"]["verdict"] == "ok"
+        # signal rows landed on the flight recorder's dedicated ring
+        snap = t.flight.snapshot("test")
+        assert [r for r in snap["signals"] if r.get("event") == "signals"]
+
+    def test_signals_add_no_device_get(self, monkeypatch):
+        """Dispatch-count pin: the signal plane consumes host-side state
+        only — same fetch bound tests/test_obs.py pins without it."""
+        cfg, vocab, corpus = _setup(chunk_steps=1)
+        t = Trainer(cfg, vocab, corpus)
+        t.signals = SignalEngine(window=8, phases=t.phases, flight=t.flight)
+        calls = {"n": 0}
+        real = jax.device_get
+
+        def counted(x):
+            calls["n"] += 1
+            return real(x)
+
+        monkeypatch.setattr(jax, "device_get", counted)
+        state, rep = t.train(log_every=0)
+        assert calls["n"] <= rep.steps + 2
+        assert rep.signals["windows"] > 0
+
+    def test_signal_overhead_contract(self, tmp_path):
+        """Satellite acceptance: the signal plane costs <1% of wall. Two
+        microcosts against the run's own p50 step time — the per-boundary
+        beat (the only per-step work) and the full window close (phases
+        snapshot + publish + SLO + fleet aggregate), which amortizes over
+        `window` steps. The banked artifact is
+        benchmarks/SIGNAL_OVERHEAD_cpu.json (signal_overhead.py)."""
+        from word2vec_tpu.obs.fleet import FleetAggregator
+        from word2vec_tpu.obs.slo import SloEvaluator, parse_slo
+
+        cfg, vocab, corpus = _setup(chunk_steps=1)
+        t = Trainer(cfg, vocab, corpus)
+        state, rep = t.train(log_every=0)
+        step_ms = sorted(
+            e["dur"] / 1e3 for e in t.flight.ring.events()
+            if e.get("ph") == "X" and e["name"] == "step"
+        )
+        p50_s = statistics.median(step_ms) / 1e3
+        eng = SignalEngine(window=10_000_000)  # never closes: beat cost only
+        n = 20_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            eng.on_boundary(i, i * 100)
+        per_beat = (time.perf_counter() - t0) / n
+        assert per_beat < 0.01 * p50_s, (
+            f"one boundary beat costs {per_beat * 1e6:.2f}us vs p50 step "
+            f"{p50_s * 1e3:.2f}ms"
+        )
+        # full-wiring close cost, amortized over the default 50-step window
+        closer = SignalEngine(
+            window=1, phases=t.phases, flight=t.flight,
+            metrics_dir=str(tmp_path), host=0,
+            slo=SloEvaluator(parse_slo("throughput_wps<0.5*baseline:for=3")),
+            aggregator=FleetAggregator(str(tmp_path), window_steps=1),
+        )
+        n = 100
+        t0 = time.perf_counter()
+        for i in range(1, n + 1):
+            closer.on_boundary(i, i * 100)
+        per_close = (time.perf_counter() - t0) / n
+        closer.close()
+        assert per_close < 0.01 * 50 * p50_s, (
+            f"one window close costs {per_close * 1e3:.2f}ms vs 50-step "
+            f"window of p50 {p50_s * 1e3:.2f}ms steps"
+        )
+
+    def test_slo_breach_lands_in_flight_dump(self, tmp_path):
+        """The acceptance leg: an SloEvent must be present in flight.json."""
+        fl = FlightRecorder()
+        eng = SignalEngine(
+            window=5, flight=fl,
+            slo=SloEvaluator(parse_slo("throughput_wps<0.5*baseline:for=1:baseline=1")),
+        )
+        words = 0
+        for step in range(1, 7):  # baseline window
+            words += 1000
+            eng.on_boundary(step, words)
+        time.sleep(0.02)
+        for step in range(7, 17):  # collapse: same words, more wall
+            words += 1
+            time.sleep(0.002)
+            eng.on_boundary(step, words)
+        eng.finish(16, words)
+        path = fl.dump(str(tmp_path), reason="test")
+        doc = json.load(open(path))
+        events = [r.get("event") for r in doc["signals"]]
+        assert "slo_breach" in events
+        # and on the log-record ring, for the JSONL-less reader
+        assert any(
+            r.get("event") == "slo_breach" for r in doc["log_records"]
+        )

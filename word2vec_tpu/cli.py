@@ -352,6 +352,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "floor before it arms (early training legitimately "
                         "scores low; the relative-drop check is always "
                         "armed since it needs an established peak)")
+    p.add_argument("--slo", metavar="RULES", default="",
+                   help="declarative SLO rules over the derived signals "
+                        "(obs/slo.py): comma-separated "
+                        "'<signal><op><threshold>[:for=N][:baseline=N]' "
+                        "clauses, e.g. "
+                        "'throughput_wps<0.8*baseline:for=5', or a path to "
+                        "a .json rule list. Evaluated per signal window, "
+                        "escalating ok -> warn -> breach with structured "
+                        "SloEvents on the metrics stream + flight ring and "
+                        "a w2v_slo_breaches_total counter. A breach is a "
+                        "log + event, NEVER an exit (observe, don't "
+                        "actuate). Implies the signal plane on")
+    p.add_argument("--signal-window", type=int, default=0, metavar="STEPS",
+                   help="optimizer steps per derived-signal window "
+                        "(obs/signals.py; 0 = auto: 50). Each closed "
+                        "window emits one w2v_signal_* row (throughput, "
+                        "step-time p50/p90, input-bound ratio, straggler "
+                        "skew, quality) into the metrics stream and "
+                        "signals_p<rank>.jsonl; rank 0 merges all hosts' "
+                        "rows by window id into fleet.json + w2v_fleet_* "
+                        "gauges. On by default with --metrics-dir or "
+                        "--prom-textfile; windows add zero device fetches")
     p.add_argument("--divergence-budget", type=int, default=8,
                    help="consecutive non-finite-loss steps before the run "
                         "aborts with a structured DivergenceError instead "
@@ -480,6 +502,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             fault_plan.faults.append(Fault("nan", step=0))
     except (ValueError, OSError) as e:
         print(f"error: bad --faults spec: {e}", file=sys.stderr)
+        return 1
+    # SLO rules: same fail-in-milliseconds contract as the fault spec (the
+    # parse errors name clause + offset, obs/slo.py)
+    from .obs.slo import SloError, parse_slo
+
+    try:
+        slo_rules = parse_slo(args.slo)
+    except SloError as e:
+        print(f"error: bad --slo spec: {e}", file=sys.stderr)
+        return 1
+    if args.signal_window < 0:
+        print("error: --signal-window must be >= 0", file=sys.stderr)
         return 1
     if args.checkpoint_keep < 0:
         print("error: --checkpoint-keep must be >= 0", file=sys.stderr)
@@ -1116,6 +1150,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         # PeerAgreement heartbeat row install_shutdown wires below, so the
         # whole fleet admits a rejoiner at the same sync boundary
         trainer.elastic_poll = elastic_ctl.grow_pending
+    # Derived-signal plane (obs/signals.py): on for instrumented runs
+    # (--metrics-dir / --prom-textfile) and whenever SLO rules are set.
+    # EVERY rank writes its per-window row file into args.metrics_dir
+    # (distinct signals_p<rank>.jsonl names — the trace_p<i>.json
+    # discipline); rank 0 additionally merges the fleet view. Wired BEFORE
+    # install_shutdown so the PeerAgreement heartbeat can feed the
+    # straggler_skew signal; registered on the hub so the quality probe's
+    # gauge records feed quality_planted with zero new plumbing.
+    sig_engine = None
+    if slo_rules or args.metrics_dir or args.prom_textfile:
+        from .obs.fleet import FleetAggregator
+        from .obs.signals import SignalEngine
+        from .obs.slo import SloEvaluator
+
+        sig_window = args.signal_window or 50
+        sig_engine = SignalEngine(
+            window=sig_window,
+            phases=trainer.phases,
+            flight=trainer.flight,
+            log_fn=hub,
+            metrics_dir=args.metrics_dir,
+            host=jax.process_index(),
+            slo=SloEvaluator(slo_rules) if slo_rules else None,
+            aggregator=(
+                FleetAggregator(args.metrics_dir, window_steps=sig_window)
+                if args.metrics_dir and is_primary else None
+            ),
+        )
+        trainer.signals = sig_engine
+        hub.add(sig_engine)  # hub.close() also closes the row file
+        if not args.quiet and slo_rules:
+            print(
+                f"slo: {len(slo_rules)} rule(s) over {sig_window}-step "
+                f"windows: {[str(r) for r in slo_rules]}"
+            )
     trainer.install_shutdown(handler)
 
     # On-demand diagnostics: SIGUSR1 dumps the flight recorder + all-thread
@@ -1457,6 +1526,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             summary["interrupted"] = report.interrupted
         if report.recoveries:
             summary["recoveries"] = len(report.recoveries)
+        if report.signals:
+            # the signal plane's one-line verdict: did the run stay inside
+            # its SLOs, and who lagged (obs/signals.FleetHealth)
+            fh = report.signals.get("fleet_health") or {}
+            summary["fleet_health"] = fh.get("verdict")
+            if fh.get("straggler_host") is not None:
+                summary["straggler_host"] = fh.get("straggler_host")
+            slo_rep = report.signals.get("slo")
+            if slo_rep:
+                summary["slo_state"] = slo_rep.get("state")
+                summary["slo_breaches"] = slo_rep.get("breaches_total")
         if log_fn is not None:
             log_fn(summary)
 
@@ -1475,6 +1555,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             # restart (train._resume_skip) — recorded so the manifest shows
             # data was re-trained, not resumed
             end_fields["resume_fallback"] = trainer.resume_fallback
+        if report.signals:
+            # the SLO summary + fleet-health verdict land where how the run
+            # started already is — one manifest read answers "did it hold
+            # its SLOs" (obs/slo.SloEvaluator.summary)
+            if report.signals.get("slo"):
+                end_fields["slo"] = report.signals["slo"]
+            end_fields["fleet_health"] = report.signals.get("fleet_health")
         update_manifest(manifest_path, end_fields)
 
     if preempted:

@@ -25,12 +25,28 @@ manifests, exporters, tracing, flight recorder, quality probes.
     obs.tracediff — `python -m word2vec_tpu.obs.tracediff A.json B.json`:
                     attribute a step-time delta between two traces to named
                     spans; also the trace_summary bench.py banks
+    obs.signals   — derived-signal plane (SignalEngine): windowed time
+                    series (EWMA/p50/p90/slope) over streams that already
+                    exist — throughput, step time, input-bound ratio,
+                    straggler skew, quality, serve qps/p99 — plus the
+                    control-ready SignalBus and the fleet-health verdict
+    obs.slo       — declarative SLO rules (`--slo
+                    'throughput_wps<0.8*baseline:for=5'`) evaluated per
+                    window: ok -> warn -> breach escalation, structured
+                    SloEvents, w2v_slo_breaches_total — observe, never exit
+    obs.fleet     — cross-host aggregation: per-host signal rows merged BY
+                    WINDOW ID into fleet.json + w2v_fleet_* gauges with
+                    worst-straggler host attribution; also the standalone
+                    `python -m word2vec_tpu.obs.fleet` replica aggregator
+    obs.watch     — `python -m word2vec_tpu.obs.watch --dir DIR`: terminal
+                    dashboard tailing fleet.json
 
 Drivers (train.Trainer, parallel.ShardedTrainer, cli.py, bench.py) all
 route through here; utils/logging.py keeps the individual log sinks.
 """
 
 from .export import MetricsHub, prometheus_textfile
+from .fleet import FleetAggregator, merge_rows, validate_fleet_doc
 from .flight import FlightRecorder
 from .health import DivergenceError, HealthMonitor, health_record
 from .manifest import manifest_dict, write_manifest
@@ -38,11 +54,23 @@ from .phases import PhaseRecorder
 from .quality import (
     ProbeSet, QualityAlert, QualityProbe, QualitySentinel, score_table,
 )
+from .signals import FleetHealth, SignalBus, SignalEngine
+from .slo import SloError, SloEvaluator, SloRule, parse_slo
 from .trace import TraceRing, chrome_trace_doc, merge_traces, write_trace
 
 __all__ = [
     "MetricsHub",
     "prometheus_textfile",
+    "FleetAggregator",
+    "merge_rows",
+    "validate_fleet_doc",
+    "FleetHealth",
+    "SignalBus",
+    "SignalEngine",
+    "SloError",
+    "SloEvaluator",
+    "SloRule",
+    "parse_slo",
     "FlightRecorder",
     "DivergenceError",
     "HealthMonitor",

@@ -54,6 +54,10 @@ EVENT_COUNTERS = {
     # a numeric mesh_size record at every generation start.)
     "remesh": "w2v_remesh_total",
     "peer_rejoin": "w2v_peer_rejoin_total",
+    # SLO breaches (obs/slo.py): a rule that stayed breached for its `for=`
+    # budget of consecutive windows. A breach is a log + event, never an
+    # exit — but a dashboard must be able to alert on increase() from zero.
+    "slo_breach": "w2v_slo_breaches_total",
 }
 
 #: event kinds whose NUMERIC fields also land as gauges. Mesh topology
@@ -61,14 +65,33 @@ EVENT_COUNTERS = {
 #: continuous signal that only changes at remesh boundaries, so it rides
 #: the event channel (one record per generation, rendered as a labelled
 #: line by the console sink) but must still be scrapeable as a gauge.
-GAUGE_EVENTS = ("mesh",)
+#: "signals" rows (obs/signals.py, one per closed window: w2v_signal_*)
+#: and "fleet" rows (obs/fleet.py rank-0 aggregation: w2v_fleet_*) are the
+#: signal plane's continuous outputs and ride the same channel.
+GAUGE_EVENTS = ("mesh", "signals", "fleet")
+
+#: seconds one sink call may take before the hub detaches it as wedged —
+#: generous (a prom textfile rewrite is microseconds; a hung NFS mount or
+#: a blocking network sink is what this catches)
+SLOW_SINK_S = 5.0
 
 
 class MetricsHub:
-    """Fan out one log record to every registered sink; close them once."""
+    """Fan out one log record to every registered sink; close them once.
 
-    def __init__(self, *sinks: Optional[Callable[[Dict], None]]):
+    Sink failures are ISOLATED: a sink that raises, or whose single call
+    exceeds `slow_sink_s` wall seconds, is warned about and DETACHED — the
+    hub sits inside the training step loop and the serve batch path, and a
+    full disk or a wedged remote sink must degrade telemetry, never kill
+    the work it observes (regression-pinned with a poisoned sink in
+    tests/test_signals.py). A detached sink is still closed by close(), so
+    a half-written file gets its flush."""
+
+    def __init__(self, *sinks: Optional[Callable[[Dict], None]],
+                 slow_sink_s: float = SLOW_SINK_S):
         self._sinks: List[Callable[[Dict], None]] = []
+        self._detached: List[Callable[[Dict], None]] = []
+        self.slow_sink_s = float(slow_sink_s)
         for s in sinks:
             self.add(s)
 
@@ -83,15 +106,44 @@ class MetricsHub:
             self._sinks.append(sink)
         return sink
 
+    def _detach(self, sink, why: str) -> None:
+        import warnings
+
+        try:
+            self._sinks.remove(sink)
+        except ValueError:
+            return
+        self._detached.append(sink)
+        warnings.warn(
+            f"metrics sink {sink!r} detached: {why}. Telemetry from this "
+            "sink stops here; the run continues.",
+            stacklevel=3,
+        )
+
     def __call__(self, record: Dict) -> None:
-        for s in self._sinks:
-            s(record)
+        for s in list(self._sinks):
+            t0 = time.perf_counter()
+            try:
+                s(record)
+            except Exception as e:  # noqa: BLE001 — see class docstring
+                self._detach(s, f"raised {e!r}")
+                continue
+            if (
+                self.slow_sink_s
+                and time.perf_counter() - t0 > self.slow_sink_s
+            ):
+                self._detach(
+                    s,
+                    f"one call took > {self.slow_sink_s:g}s "
+                    "(wedged or blocking sink)",
+                )
 
     def close(self) -> None:
-        """Flush/close every sink that supports it. Best-effort: a sink
+        """Flush/close every sink that supports it — detached sinks
+        included (their files deserve a flush). Best-effort: a sink
         failing to close must not mask a training result that is already
         computed (the failure is warned, not raised)."""
-        for s in self._sinks:
+        for s in self._sinks + self._detached:
             close = getattr(s, "close", None)
             if close is None:
                 continue
@@ -127,6 +179,27 @@ class PrometheusTextfile:
         self._counters: Dict[str, float] = {
             name: 0.0 for name in EVENT_COUNTERS.values()
         }
+        # real cumulative histograms (name -> {"le", "counts", "sum",
+        # "count"}): the latest cumulative state per metric — the feeder
+        # (obs/signals.Histogram, serve/metrics.ServeStats) accumulates;
+        # this sink only renders _bucket/_sum/_count. A p99-as-gauge cannot
+        # be aggregated across replicas; bucket counts can be summed.
+        self._hists: Dict[str, Dict] = {}
+
+    @staticmethod
+    def _is_hist(key: str, val) -> bool:
+        return (
+            key.endswith("_hist")
+            and isinstance(val, dict)
+            and isinstance(val.get("le"), list)
+            and isinstance(val.get("counts"), list)
+            and len(val["counts"]) == len(val["le"]) + 1
+            and "sum" in val
+            and "count" in val
+        )
+
+    def _set_hist(self, key: str, val: Dict) -> None:
+        self._hists[_metric_name(key[: -len("_hist")])] = val
 
     def __call__(self, record: Dict) -> None:
         if "event" in record:
@@ -139,6 +212,10 @@ class PrometheusTextfile:
                 dirty = True
             if record["event"] in GAUGE_EVENTS:
                 for key, val in record.items():
+                    if self._is_hist(key, val):
+                        self._set_hist(key, val)
+                        dirty = True
+                        continue
                     if key == "event" or isinstance(val, bool) or not (
                         isinstance(val, (int, float))
                     ):
@@ -161,6 +238,9 @@ class PrometheusTextfile:
                             (("phase", str(phase)),),
                             sv,
                         )
+                continue
+            if self._is_hist(key, val):
+                self._set_hist(key, val)
                 continue
             if isinstance(val, bool) or not isinstance(val, (int, float)):
                 continue
@@ -203,6 +283,20 @@ class PrometheusTextfile:
                     lines.append(f"{name}{{{lbl}}} {self._fmt(value)}")
                 else:
                     lines.append(f"{name} {self._fmt(value)}")
+        for name, hist in self._hists.items():
+            lines.append(f"# HELP {name} word2vec_tpu latency histogram")
+            lines.append(f"# TYPE {name} histogram")
+            for bound, count in zip(hist["le"], hist["counts"]):
+                lines.append(
+                    f'{name}_bucket{{le="{float(bound):g}"}} '
+                    f"{self._fmt(float(count))}"
+                )
+            lines.append(
+                f'{name}_bucket{{le="+Inf"}} '
+                f"{self._fmt(float(hist['counts'][-1]))}"
+            )
+            lines.append(f"{name}_sum {self._fmt(float(hist['sum']))}")
+            lines.append(f"{name}_count {self._fmt(float(hist['count']))}")
         for name, value in self._counters.items():
             lines.append(f"# HELP {name} word2vec_tpu event counter")
             lines.append(f"# TYPE {name} counter")
@@ -221,7 +315,7 @@ class PrometheusTextfile:
         os.replace(tmp, self.path)
 
     def close(self) -> None:
-        if self._gauges or any(self._counters.values()):
+        if self._gauges or self._hists or any(self._counters.values()):
             self._write()
 
 

@@ -73,6 +73,10 @@ class FlightRecorder:
         #: in every dump so a failure artifact shows the quality trajectory
         #: that led there, not just the perf timeline
         self.quality: collections.deque = collections.deque(maxlen=32)
+        #: last-N derived-signal rows and SLO events (obs/signals.py /
+        #: obs/slo.py): a failure artifact carries the windowed signal
+        #: trajectory — and any warn/breach escalation — that led there
+        self.signals: collections.deque = collections.deque(maxlen=64)
         #: the last step boundary observed (None before any)
         self.last_step: Optional[int] = None
 
@@ -117,6 +121,12 @@ class FlightRecorder:
         with self._lock:
             self.quality.append(dict(row))
 
+    def note_signal(self, row: Dict) -> None:
+        """One derived-signal window row or SLO event (obs/signals.py):
+        the bounded signal ring every flight.json dump carries."""
+        with self._lock:
+            self.signals.append(dict(row))
+
     def log_record(self, rec: Dict) -> None:
         """One log record (sink-compatible: the trainers' _log feeds this
         alongside the run's MetricsHub)."""
@@ -133,6 +143,7 @@ class FlightRecorder:
             counters = list(self.counters)
             records = list(self.records)
             quality = list(self.quality)
+            signals = list(self.signals)
         snap: Dict = {
             "event": "flight",
             "reason": reason,
@@ -147,6 +158,7 @@ class FlightRecorder:
             "counters": counters,
             "log_records": records,
             "quality": quality,
+            "signals": signals,
         }
         if extra:
             snap.update(extra)

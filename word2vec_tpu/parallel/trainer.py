@@ -811,12 +811,18 @@ class ShardedTrainer(Trainer):
         every = max(1, cfg.dp_sync_every // cfg.micro_steps)
         since = state.step - (self._last_sync_step or 0)
         if self.dp * self.sp > 1 and cfg.dp_sync_every and since >= every:
-            state.params = self._run_sync(state.params)
+            # own span: the sync wait is FLEET time (blocked on the slowest
+            # replica), so it must land on the timeline and stay out of the
+            # host-attributable overhead the signal plane derives
+            # (obs/signals._host_overhead_ms)
+            with self.phases.span("replica_sync"):
+                state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
 
     def _finalize(self, state: TrainState) -> None:
         if self.dp * self.sp > 1 and self._last_sync_step != state.step:
-            state.params = self._run_sync(state.params)
+            with self.phases.span("replica_sync"):
+                state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
 
     def _probe_params(self, state: TrainState) -> Params:
@@ -865,6 +871,13 @@ class ShardedTrainer(Trainer):
                 # restarted host at the SAME sync boundary (cli.py wires
                 # trainer.elastic_poll before calling install_shutdown)
                 elastic_fn=self.elastic_poll,
+                # fleet-skew feed: the same heartbeat rows derive the
+                # straggler_skew signal (obs/signals.py — cli.py wires
+                # trainer.signals before calling install_shutdown)
+                signals=self.signals,
+                # the heartbeat wait is fleet time: span it so it lands on
+                # the timeline and outside host-attributable overhead
+                phases=self.phases,
             ).check
         else:
             self.stop_check = handler.make_stop_check(process_count=1)

@@ -502,6 +502,8 @@ class PeerAgreement:
         log_fn=None,
         flight=None,
         elastic_fn: Optional[Callable[[], float]] = None,
+        signals=None,
+        phases=None,
     ):
         self.handler = handler
         self.every = max(1, int(agree_every))
@@ -509,6 +511,17 @@ class PeerAgreement:
         self.straggler_factor = float(straggler_factor)
         self.straggler_min_ms = float(straggler_min_ms)
         self.log_fn = log_fn
+        #: derived-signal plane (obs/signals.SignalEngine): every
+        #: heartbeat's rows also feed the straggler_skew signal — the
+        #: fleet-skew view a control loop can subscribe to, where the
+        #: one-shot straggler WARNING above is for humans. Duck-typed:
+        #: anything with .note_heartbeat(rows, step).
+        self.signals = signals
+        #: phase recorder (obs/phases.PhaseRecorder): the heartbeat
+        #: allgather runs under an "agree" span — it is FLEET wait (blocked
+        #: on the slowest peer), so it belongs on the timeline and outside
+        #: the host-attributable overhead the signal plane derives
+        self.phases = phases
         #: flight recorder (obs/flight.py): every heartbeat's (pid, stop,
         #: step, p50) rows land on the timeline, so a peer-loss dump shows
         #: the fleet's last agreed state and the cross-host trace merge can
@@ -538,15 +551,24 @@ class PeerAgreement:
         grow = 0.0
         if self.elastic_fn is not None:
             grow = float(self.elastic_fn() or 0.0)
-        rows = multihost.global_heartbeat([
-            float(jax.process_index()),
-            1.0 if self.handler.requested else 0.0,
-            float(step),
-            p50,
-            grow,
-        ])
+        import contextlib
+
+        agree_span = (
+            self.phases.span("agree") if self.phases is not None
+            else contextlib.nullcontext()
+        )
+        with agree_span:
+            rows = multihost.global_heartbeat([
+                float(jax.process_index()),
+                1.0 if self.handler.requested else 0.0,
+                float(step),
+                p50,
+                grow,
+            ])
         if self.flight is not None:
             self.flight.note_heartbeat(np.asarray(rows).tolist(), step)
+        if self.signals is not None:
+            self.signals.note_heartbeat(np.asarray(rows).tolist(), step)
         self.inspect(rows, step)
         stop = bool(rows[:, 1].max() > 0)
         if not stop and rows.shape[1] >= 5 and rows[:, 4].max() > 0:

@@ -25,6 +25,15 @@ LAT_SAMPLES = 8192
 #: sliding QPS window seconds
 QPS_WINDOW_S = 30.0
 
+#: serve-latency histogram bucket bounds, seconds (le-style; +Inf implicit).
+#: Cumulative bucket counts are the AGGREGATABLE latency form: per-replica
+#: p99 gauges cannot be merged, but bucket counts sum across a fleet —
+#: exactly what obs/fleet.py's replica aggregation needs.
+LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5,
+)
+
 
 def percentile(samples: List[float], q: float) -> float:
     """Nearest-rank percentile over an unsorted sample list (0 <= q <= 1).
@@ -55,6 +64,12 @@ class ServeStats:
         self._done_ts: collections.deque = collections.deque()
         #: per-op request counts ({"neighbors": n, ...})
         self.by_op: Dict[str, int] = {}
+        # cumulative latency histogram (obs/signals.Histogram): monotonic
+        # per-bucket totals + _sum/_count, rendered by the Prometheus sink
+        # as w2v_serve_latency_seconds_{bucket,sum,count}
+        from ..obs.signals import Histogram
+
+        self._hist = Histogram(buckets=LATENCY_BUCKETS)
 
     # ------------------------------------------------------------ feeding
     def observe_request(self, op: str, dur_s: float, error: bool = False):
@@ -66,6 +81,7 @@ class ServeStats:
                 self.errors_total += 1
             else:
                 self._lat.append(dur_s)
+                self._hist.observe(dur_s)
             self._done_ts.append(now)
             cutoff = now - QPS_WINDOW_S
             while self._done_ts and self._done_ts[0] < cutoff:
@@ -114,6 +130,9 @@ class ServeStats:
                 "serve_p90_ms": 1e3 * percentile(lat, 0.90),
                 "serve_p99_ms": 1e3 * percentile(lat, 0.99),
                 "serve_uptime_s": now - self.t_start,
+                # the aggregatable latency form (see LATENCY_BUCKETS):
+                # rendered as a real cumulative Prometheus histogram
+                "serve_latency_seconds_hist": self._hist.to_record(),
             }
             for op, n in self.by_op.items():
                 rec[f"serve_requests_{op}"] = n

@@ -89,6 +89,7 @@ class _MemoryProm(PrometheusTextfile):
         self.path = ""
         self._gauges = {}
         self._counters = {name: 0.0 for name in EVENT_COUNTERS.values()}
+        self._hists = {}
 
     def _write(self) -> None:  # no file behind it
         pass
@@ -107,6 +108,12 @@ class ServeConfig:
     request_timeout_s: float = 30.0
     drain_deadline_s: float = 10.0
     stats_every_s: float = 5.0
+    #: derived-signal window seconds (obs/signals.py serve mode): each
+    #: closed wall-clock window emits one serve_qps/serve_p99_ms/cache_hit
+    #: signal row into signals_p<pid>.jsonl under metrics_dir — the
+    #: standalone fleet aggregator (python -m word2vec_tpu.obs.fleet)
+    #: merges replica rows by epoch-derived window id. 0 disables.
+    signal_window_s: float = 10.0
     metrics_dir: Optional[str] = None
     prom_textfile: Optional[str] = None
     trace_dir: Optional[str] = None
@@ -184,6 +191,21 @@ class EmbeddingServer:
 
             self.hub.add(jsonl_logger(
                 os.path.join(self.cfg.metrics_dir, "serve_metrics.jsonl")))
+        # derived-signal plane, serve mode (obs/signals.py): windowed
+        # serve_qps / serve_p99_ms / cache_hit rows for the replica fleet
+        # aggregator, keyed on epoch seconds (replicas share no step
+        # counter; NTP-grade alignment is enough for aggregation)
+        self.signals = None
+        if self.cfg.signal_window_s:
+            from ..obs.signals import SignalEngine
+
+            self.signals = SignalEngine(
+                window_s=self.cfg.signal_window_s,
+                metrics_dir=self.cfg.metrics_dir,
+                host=os.getpid(),
+                flight=self.flight,
+                log_fn=self.hub,
+            )
         for rec in self.cfg.startup_records or []:
             self.hub(dict(rec))
         self.port: Optional[int] = None
@@ -614,6 +636,14 @@ class EmbeddingServer:
             self.hub(rec)
         except Exception:  # noqa: BLE001 — a sink must not kill serving
             pass
+        if self.signals is not None:
+            try:
+                self.signals.observe_serve(rec)
+                if final:
+                    self.signals.finish()
+                    self.signals.close()
+            except Exception:  # noqa: BLE001 — signals must not kill serving
+                pass
 
     async def _stats_loop(self) -> None:
         every = max(0.05, self.cfg.stats_every_s)

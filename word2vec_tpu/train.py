@@ -75,6 +75,12 @@ class TrainReport:
     #: auto-recovery events, attached by resilience.Supervisor when the run
     #: rolled back and retried past a DivergenceError
     recoveries: Optional[List[Dict]] = None
+    #: derived-signal plane report (obs/signals.SignalEngine.report): per-
+    #: signal windowed stats (throughput/step-time/input-bound/straggler/
+    #: quality), SLO rule states, and the bus-fed fleet-health verdict.
+    #: None unless a driver wired trainer.signals (cli.py does with
+    #: --metrics-dir or --slo)
+    signals: Optional[Dict] = None
 
 
 class Trainer:
@@ -138,6 +144,14 @@ class Trainer:
     #: install_shutdown, which threads it into PeerAgreement's heartbeat
     #: row (sharded multi-process runs only — single-chip has no fleet).
     elastic_poll = None
+    #: derived-signal plane (obs/signals.SignalEngine) — None unless a
+    #: driver wires one (cli.py: --metrics-dir / --slo / --prom-textfile).
+    #: Beaten from _check_stop at every step/chunk boundary: on_boundary is
+    #: one clock read + an integer compare off the window edge, with ZERO
+    #: device fetches (pinned by tests/test_signals.py). Wire BEFORE
+    #: install_shutdown so the multi-process heartbeat can feed it.
+    #: Duck-typed: anything with .on_boundary(step, words)/.finish/.report.
+    signals = None
 
     def __init__(
         self,
@@ -434,6 +448,10 @@ class Trainer:
         the boundary it wedges — exactly like a real mid-loop stall."""
         if self.watchdog is not None:
             self.watchdog.beat(state.step)
+        if self.signals is not None:
+            # derived-signal window accounting (obs/signals.py): host-side
+            # ints/clocks only — the boundary stays device-fetch-free
+            self.signals.on_boundary(state.step, state.words_done)
         if self.fault_plan is not None:
             self.fault_plan.on_step(state, self)
         if self.quality_probe is not None and self.quality_probe.due(
@@ -707,6 +725,7 @@ class Trainer:
             phases=self.phases.report(),
             health=self._health.summary(),
             interrupted=interrupted,
+            signals=self._finish_signals(state),
         )
         return state, report
 
@@ -847,6 +866,7 @@ class Trainer:
             phases=self.phases.report(),
             health=self._health.summary() if self._health else None,
             interrupted=interrupted,
+            signals=self._finish_signals(state),
         )
 
     def _build_chunk_fn(self):
@@ -1001,6 +1021,15 @@ class Trainer:
         jax.device_put / asarray calls are; PhaseRecorder locks)."""
         with self.phases.span("h2d"):
             return jnp.asarray(np_chunk)
+
+    def _finish_signals(self, state: TrainState) -> Optional[Dict]:
+        """Close the signal plane's partial tail window and return the
+        TrainReport.signals payload (per-signal stats + SLO states + the
+        fleet-health verdict) — None when no engine is wired."""
+        if self.signals is None:
+            return None
+        self.signals.finish(state.step, state.words_done)
+        return self.signals.report()
 
     def _device_get(self, x):
         """Every blocking metrics fetch funnels through here. Single-chip:

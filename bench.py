@@ -200,10 +200,10 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         corpus = PackedCorpus.from_flat(flat, cfg.max_sentence_len)
         corpus_name = "text8"
     else:
-        vocab = zipf_vocab(71000, 17_000_000)
+        vocab = zipf_vocab(args.vocab, 17_000_000)
         # flat-stream cache: sweep scripts invoke bench many times and the
         # 17M-token weighted draw costs ~20s host time per run
-        cache = f"/tmp/w2v_zipf_{args.tokens}_s0.npy"
+        cache = f"/tmp/w2v_zipf_{args.vocab}_{args.tokens}_s0.npy"
         if os.path.exists(cache):
             flat = np.load(cache)
         else:
@@ -675,6 +675,12 @@ def build_parser() -> argparse.ArgumentParser:
     # number is steady-state (at 2M tokens the epoch is ~48 steps and compile-
     # adjacent fixed costs dominate: 1.5M w/s there vs 3.6M at 20M, measured)
     ap.add_argument("--tokens", type=int, default=17_000_000)
+    ap.add_argument("--vocab", type=int, default=71000,
+                    help="synthetic zipf vocabulary size (the flagship "
+                    "71k unless shrunk; interpret-mode pallas_fused "
+                    "smokes shrink it — the interpreter materializes the "
+                    "HBM-resident [V, 2, d] slab per grid step, so CPU "
+                    "canary cost scales with V)")
     ap.add_argument("--dim", type=int, default=300)
     ap.add_argument("--model", choices=["sg", "cbow"], default="sg")
     ap.add_argument("--train-method", choices=["ns", "hs"], default="ns",
@@ -701,13 +707,18 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--hs-tail-slots", type=int, default=-1,
                     help="two-tier hs tail compaction bound "
                          "(config.hs_tail_slots)")
-    ap.add_argument("--band-backend", choices=["xla", "pallas", "pallas_oa"],
+    ap.add_argument("--band-backend",
+                    choices=["xla", "pallas", "pallas_oa", "pallas_fused"],
                     default="xla",
                     help="band step compute: XLA chain, the fused Pallas "
-                    "kernel (ops/pallas_band.py), or the XLA chain with "
+                    "kernel (ops/pallas_band.py), the XLA chain with "
                     "the Pallas overlap-add kernel replacing the "
                     "layout-copy chain (pallas_oa, ops/pallas_overlap.py; "
-                    "composes with --fused/--table-dtype/--sr/--neg-scope)")
+                    "composes with --fused/--table-dtype/--sr/--neg-scope), "
+                    "or the fully-fused step over the unified slab "
+                    "(pallas_fused, ops/pallas_step.py; requires "
+                    "--table-layout unified, row negative scope; composes "
+                    "with --table-dtype/--sr)")
     ap.add_argument("--table-dtype", choices=["float32", "bfloat16"],
                     default="float32",
                     help="storage dtype of the [V, d] tables (A/B lever: "
@@ -931,7 +942,8 @@ def main() -> None:
     child_cmd += ["--cpu"] if force_cpu else []
     child_cmd += ["--fallback-reason", platform_note] if platform_note else []
     for flag, val in [
-        ("--tokens", args.tokens), ("--dim", args.dim),
+        ("--tokens", args.tokens), ("--vocab", args.vocab),
+        ("--dim", args.dim),
         ("--model", args.model), ("--train-method", args.train_method),
         ("--window", args.window), ("--negative", args.negative),
         ("--batch-rows", args.batch_rows), ("--max-len", args.max_len),

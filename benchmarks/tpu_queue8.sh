@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-12 TPU measurement queue — the fully-fused Pallas train step
+# (ISSUE 12, band_backend='pallas_fused').
+#
+# The tunnel has been dead since round 5, so queues 5/7/8 coexist: this one
+# is ordered so a SHORT window banks the decision this round actually made.
+#
+#   Tier 1 — the A/B trio that decides the tentpole at the banked 30.4x
+#            config: unified/xla (the r7 chain) vs unified/pallas_oa (the
+#            best predicted chain) vs unified/pallas_fused. The cost model
+#            predicts the fused step ~11% over pallas_oa and ~36% over the
+#            unified chain at the flagship shape (program-gap tail
+#            collapses 9 -> 3 programs + the inter-op round-trips
+#            disappear, minus ~1.6 ms of in-kernel DMA rows —
+#            tune/cost_model.py PROGRAM_GAP_MS / DMA_SEC_PER_ROW;
+#            sensitivity pinned by the r12 counterfactual-flip test).
+#            CPU interpret evidence: benchmarks/COST_ATTRIB_r12.
+#   Tier 2 — --trace step-span exports of fused vs chain so
+#            `python -m word2vec_tpu.obs.tracediff` attributes the
+#            dispatch/program-gap delta WITH SIGN from banked artifacts
+#            (the PR 6 pattern; the fused step's whole claim lives in the
+#            dispatch span delta).
+#   Tier 3 — the fused planner-candidate stacks: pallas_fused x
+#            {kp16, bf16sr, chunk-cap 96}, and an --autotune probe that
+#            must be free to pick (or reject) the fused backend.
+#
+# Forwarding-audit markers (the r4 lesson): an item banks ONLY a record
+# whose realized plan carries the requested band_backend/layout — bench.py's
+# outer->inner re-exec once dropped a flag and banked the XLA path under a
+# pallas label. The plan JSON carries band_backend before table_layout
+# (TunePlan field order), and "platform" precedes "plan" in bench.py's
+# record, so one basic-regex grep covers each marker.
+#
+# Usage: nohup bash benchmarks/tpu_queue8.sh >/dev/null 2>&1 &
+cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/TPU_R8
+. benchmarks/tpu_queue_lib.sh
+
+B='python bench.py --probe-retries 1'
+TPU='"platform": "tpu"'
+# realized-backend markers: "band_backend" rides inside the record's "plan"
+UNI='"platform": "tpu".*"band_backend": "xla".*"table_layout": "unified"'
+UNI_OA='"platform": "tpu".*"band_backend": "pallas_oa".*"table_layout": "unified"'
+FUSED='"platform": "tpu".*"band_backend": "pallas_fused".*"table_layout": "unified"'
+FUSED_KP16='"platform": "tpu".*"shared_negatives": 16.*"band_backend": "pallas_fused".*"table_layout": "unified"'
+FUSED_BF16SR='"platform": "tpu".*"band_backend": "pallas_fused".*"table_layout": "unified".*"table_dtype": "bfloat16".*"stochastic_rounding": true'
+
+# --- tier 1: the backend A/B that decides the tentpole ------------------------
+run_item unified_xla          900 "$UNI"    $B --table-layout unified
+run_item unified_pallas_oa    900 "$UNI_OA" $B --table-layout unified --band-backend pallas_oa
+run_item unified_fused        900 "$FUSED"  $B --table-layout unified --band-backend pallas_fused
+
+# --- tier 2: tracediff artifacts (fused dispatch-delta attribution) -----------
+# diffing these attributes the program-gap collapse to the dispatch span
+# with sign (obs/tracediff.py; the r12 test pins the sign convention):
+run_item unified_xla_tracedump   900 "$UNI"   $B --table-layout unified --trace benchmarks/TPU_R8/trace_chain
+run_item unified_fused_tracedump 900 "$FUSED" $B --table-layout unified --band-backend pallas_fused --trace benchmarks/TPU_R8/trace_fused
+
+# --- tier 3: fused planner-candidate stacks -----------------------------------
+# fused x KP width (the kp16 win was 100% dispatch — if the fused step
+# already deleted the tail, the kp16 stack tells us what is left):
+run_item fused_kp16           900 "$FUSED_KP16" $B --table-layout unified --band-backend pallas_fused --kp 16
+# fused x bf16+SR (halved slab bytes compose with the in-kernel gathers):
+run_item fused_bf16sr         900 "$FUSED_BF16SR" $B --table-layout unified --band-backend pallas_fused --table-dtype bfloat16 --sr 1
+# fused x deeper scan megasteps (dispatch overhead amortization on top of
+# the in-step program-gap collapse — the two tails are different):
+run_item fused_c96            900 "$FUSED" $B --table-layout unified --band-backend pallas_fused --chunk-cap 96
+# the planner's own verdict (probe mode persists the winner under the
+# schema-3 key that now carries the configured band_backend):
+run_item autotune_probe_fused 1800 "$TPU" $B --autotune probe --table-layout unified --band-backend pallas_fused
+
+echo "$(date -u +%FT%TZ) QUEUE8 COMPLETE after $FAILED_PROBES failed probes total" >> "$LOG"

@@ -1,0 +1,475 @@
+"""config.band_backend='pallas_fused' (ops/pallas_step.py): the fully-fused
+train step over the unified [V, 2, d] slab must reproduce the unified XLA
+chain's step — the ISSUE 12 tentpole, at the `pallas_oa` bar.
+
+Pinning layers:
+
+  * scatter-kernel unit — fused_slab_scatter vs `.at[].add(sorted)` is
+    BITWISE on random sorted ids with heavy duplication, in f32 AND bf16
+    (sequential RMW = XLA's left-to-right duplicate accumulation), and
+    skips -1 padding rows.
+  * step-level — pallas_fused vs the unified XLA backend across the
+    support grid: sg/cbow x scatter_mean x clip (engaged and not) in f32
+    is BITWISE; bf16 tables ± stochastic rounding match exactly (the SR
+    cast runs in the shared tail on the split step's stream indices);
+    bf16 COMPUTE matches exactly too (bf16-operand dots reduce
+    identically). loss_sum is rtol-class (the kernel accumulates loss
+    partials per chunk across the grid — ops/pallas_step.py docstring);
+    pairs / clip_engaged stay exact.
+  * trajectory — a multi-step chunked run stays bitwise (the aliased
+    in-kernel scatter leaves no stale state between steps).
+  * Mosaic — both kernels AOT-export for TPU at the flagship geometry,
+    and so does the whole resident chunk-runner program.
+  * rejections — config and step-level errors name the SPECIFIC
+    incompatible lever and a supported alternative (the r12 error-message
+    contract), for the new fused rejections and the audited pallas_oa
+    ones.
+  * tracing — the fused step still emits exactly one dispatch span per
+    dispatch (PhaseRecorder stays meaningful), and tracediff attributes a
+    fused-vs-xla dispatch delta with sign (the PR 6 injected-delta
+    pattern).
+
+Runs through the Pallas interpreter on the CPU test backend; the same code
+compiles to Mosaic on chip (cbow's center logit is the one documented
+interpret/Mosaic form difference — ops/pallas_step.py docstring).
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from word2vec_tpu import compat
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.negative import build_alias_table
+from word2vec_tpu.models.params import init_params
+from word2vec_tpu.ops import banded
+from word2vec_tpu.ops.band_step import make_band_train_step
+from word2vec_tpu.ops.pallas_step import fused_grad_core, fused_slab_scatter
+from word2vec_tpu.ops.tables import DeviceTables
+
+V, D = 60, 16
+
+
+def _export_for_tpu(fn, *args):
+    """Cross-platform AOT export for platforms=["tpu"], or SKIP when this
+    host's jaxlib has no TPU lowering path at all (the
+    tests/test_pallas_band.py helper's classification)."""
+    try:
+        return compat.export.export(jax.jit(fn), platforms=["tpu"])(*args)
+    except Exception as e:  # noqa: BLE001 — classified below
+        msg = str(e).lower()
+        environmental = (
+            "unknown backend" in msg
+            or "no tpu" in msg
+            or "tpu backend" in msg
+            or "unsupported platform" in msg
+            or "cannot lower" in msg and "tpu" in msg
+            or isinstance(e, NotImplementedError)
+        )
+        if environmental:
+            pytest.skip(f"no TPU lowering path on this host: {e}")
+        raise
+
+
+def _tables():
+    counts = np.arange(2 * V, V, -1).astype(np.float64)
+    at = build_alias_table(counts**0.75 / np.sum(counts**0.75))
+    return DeviceTables(
+        jnp.ones(V, jnp.float32),
+        jnp.asarray(at.accept),
+        jnp.asarray(at.alias),
+        None,
+        None,
+        None,
+    )
+
+
+def _cfg(**kw):
+    base = dict(
+        model="sg", train_method="ns", negative=3, word_dim=D,
+        window=3, min_count=1, subsample_threshold=0,
+        compute_dtype="float32", shared_negatives=8,
+        max_sentence_len=40, band_chunk=10, table_layout="unified",
+    )
+    base.update(kw)
+    return Word2VecConfig(**base)
+
+
+def _tokens():
+    rng = np.random.default_rng(4)
+    tokens = jnp.asarray(rng.integers(0, V, size=(6, 40)).astype(np.int32))
+    # padding exercises the invalid-slot masking on both paths
+    return tokens.at[2, 30:].set(-1)
+
+
+def _ab(cfg):
+    """(xla unified step, pallas_fused step) outputs on identical inputs."""
+    tokens, key, alpha = _tokens(), jax.random.key(9), jnp.float32(0.03)
+    params = init_params(cfg, V, jax.random.key(1))
+    pa, ma = jax.jit(make_band_train_step(cfg, _tables(), fused=True))(
+        dict(params), tokens, key, alpha
+    )
+    cfg_b = dataclasses.replace(cfg, band_backend="pallas_fused")
+    pb, mb = jax.jit(make_band_train_step(cfg_b, _tables(), fused=True))(
+        dict(params), tokens, key, alpha
+    )
+    return pa, ma, pb, mb
+
+
+def _assert_params_bitwise(pa, pb):
+    for k in pa:
+        np.testing.assert_array_equal(
+            np.asarray(pa[k]), np.asarray(pb[k]), err_msg=k
+        )
+
+
+# ------------------------------------------------------- scatter kernel
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_scatter_bitwise_matches_sorted_scatter_add(dtype):
+    """Sequential in-kernel RMW over sorted rows = XLA's sorted scatter-add
+    duplicate order, bitwise — including bf16 accumulation (the table-dtype
+    add happens in the kernel exactly as the XLA scatter applies it)."""
+    rng = np.random.default_rng(0)
+    n = 700  # heavy duplication over a 40-row slab
+    idx = np.sort(rng.integers(0, 40, size=n)).astype(np.int32)
+    emb = jnp.asarray(rng.normal(size=(40, 2, 8)).astype(np.float32)).astype(
+        dtype
+    )
+    vals = jnp.asarray(
+        rng.normal(size=(n, 2, 8)).astype(np.float32)
+    ).astype(dtype)
+    ref = emb.at[jnp.asarray(idx)].add(vals, indices_are_sorted=True)
+    got = fused_slab_scatter(
+        emb, jnp.asarray(idx), vals, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_fused_scatter_skips_padding_rows():
+    rng = np.random.default_rng(1)
+    emb = jnp.asarray(rng.normal(size=(10, 2, 4)).astype(np.float32))
+    idx = jnp.asarray(np.array([2, 3, -1, -1], np.int32))
+    vals = jnp.asarray(rng.normal(size=(4, 2, 4)).astype(np.float32))
+    got = fused_slab_scatter(emb, idx, vals, interpret=True)
+    ref = emb.at[idx[:2]].add(vals[:2], indices_are_sorted=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+# ------------------------------------------------------------- band step
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+@pytest.mark.parametrize("scatter_mean", [False, True])
+def test_pallas_fused_step_matches_xla_bitwise(scatter_mean, model):
+    """The tentpole bar: f32 parameters bitwise vs the unified XLA chain
+    (the contraction/overlap-add/scatter orders are reproduced by
+    construction — ops/pallas_step.py docstring); pairs exact, loss
+    rtol-class."""
+    pa, ma, pb, mb = _ab(_cfg(model=model, scatter_mean=scatter_mean))
+    _assert_params_bitwise(pa, pb)
+    assert float(ma["pairs"]) == float(mb["pairs"])
+    np.testing.assert_allclose(
+        float(ma["loss_sum"]), float(mb["loss_sum"]), rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_pallas_fused_with_row_clip_matches_xla(model):
+    """clip shares the tail code exactly; pin at a tau tight enough that
+    the trust region actually engages (an un-engaged clip pin is vacuous)."""
+    pa, ma, pb, mb = _ab(
+        _cfg(model=model, scatter_mean=True, clip_row_update=0.0002)
+    )
+    _assert_params_bitwise(pa, pb)
+    assert float(ma["clip_engaged"]) == float(mb["clip_engaged"])
+    assert float(ma["clip_engaged"]) > 0.0  # the regime is real
+
+
+@pytest.mark.parametrize("sr", [False, True])
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_pallas_fused_bf16_tables_match_xla(model, sr):
+    """bf16 storage ± destination-grid SR: the SR cast runs in the shared
+    tail on the split step's exact per-plane stream indices (0=in, 1=out,
+    2=negatives), and the in-kernel scatter accumulates in bf16 exactly as
+    the XLA scatter does — exact match, like pallas_oa."""
+    pa, _, pb, _ = _ab(
+        _cfg(model=model, scatter_mean=True, dtype="bfloat16",
+             stochastic_rounding=sr)
+    )
+    _assert_params_bitwise(pa, pb)
+
+
+@pytest.mark.parametrize("model", ["sg", "cbow"])
+def test_pallas_fused_matches_xla_bf16_compute(model):
+    """Default compute dtype (bf16 operands, f32 accumulation): bf16
+    operand dots reduce identically chunked or full, so the match stays
+    exact here too."""
+    pa, _, pb, _ = _ab(_cfg(model=model, compute_dtype="bfloat16"))
+    _assert_params_bitwise(pa, pb)
+
+
+def test_pallas_fused_multi_step_trajectory_stays_bitwise():
+    """Three sequential steps through the aliased in-kernel scatter: no
+    stale-buffer or cross-step state divergence."""
+    cfg = _cfg()
+    cfg_b = dataclasses.replace(cfg, band_backend="pallas_fused")
+    tokens, alpha = _tokens(), jnp.float32(0.03)
+    params_a = dict(init_params(cfg, V, jax.random.key(1)))
+    params_b = dict(params_a)
+    step_a = jax.jit(make_band_train_step(cfg, _tables(), fused=True))
+    step_b = jax.jit(make_band_train_step(cfg_b, _tables(), fused=True))
+    for i in range(3):
+        key = jax.random.fold_in(jax.random.key(7), i)
+        params_a, _ = step_a(params_a, tokens, key, alpha)
+        params_b, _ = step_b(params_b, tokens, key, alpha)
+    _assert_params_bitwise(params_a, params_b)
+
+
+# ------------------------------------------------------------ Mosaic pass
+@pytest.mark.parametrize("is_cbow", [False, True], ids=["sg", "cbow"])
+def test_fused_grad_core_lowers_to_mosaic(is_cbow):
+    """Cross-platform AOT export runs the REAL Mosaic TPU pass on the CPU
+    host at the flagship chunk geometry (in-kernel DMA gathers, the lagged
+    overlap-add, the flush-phase reductions), so compiler incompatibilities
+    surface in CI instead of burning a tunnel window."""
+    Vv, d, B, KP, W, S, L = 1000, 300, 2, 64, 5, 118, 192
+    C, _ = banded._geom(L, W, S)
+    fn = functools.partial(
+        fused_grad_core, W=W, K=5, L=L, cdt=jnp.bfloat16,
+        is_cbow=is_cbow, cbow_mean=True, interpret=False,
+    )
+    exp = _export_for_tpu(
+        lambda *a: fn(*a),
+        jnp.zeros((Vv, 2, d), jnp.float32),
+        jnp.zeros((B, C, S), jnp.int32),
+        jnp.zeros((B, C, S + 2 * W), jnp.int32),
+        jnp.zeros((B, C, S), jnp.float32),
+        jnp.zeros((B, C, S), jnp.float32),
+        jnp.zeros((B, KP), jnp.int32),
+        jnp.float32(0.025),
+    )
+    assert len(exp.mlir_module_serialized) > 0
+
+
+def test_fused_scatter_lowers_to_mosaic():
+    Vv, d, N = 1000, 300, 2 * 192
+    fn = functools.partial(fused_slab_scatter, interpret=False)
+    exp = _export_for_tpu(
+        lambda e, i, v: fn(e, i, v),
+        jnp.zeros((Vv, 2, d), jnp.float32),
+        jnp.zeros((N,), jnp.int32),
+        jnp.zeros((N, 2, d), jnp.float32),
+    )
+    assert len(exp.mlir_module_serialized) > 0
+
+
+def test_full_chunk_runner_lowers_to_mosaic_with_pallas_fused():
+    """The whole bench-path program with band_backend='pallas_fused' —
+    resident batch assembly, the fused step inside lax.scan, the aliased
+    scatter — must lower for TPU, not just the kernels in isolation."""
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.ops import resident as res
+
+    Vv, d = 1000, 300
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=5, word_dim=d,
+        window=5, min_count=1, subsample_threshold=1e-4,
+        batch_rows=64, max_sentence_len=192,
+        band_backend="pallas_fused", table_layout="unified", chunk_steps=4,
+    )
+    t = _tables()
+    t = dataclasses.replace(t, keep_probs=jnp.ones(Vv, jnp.float32))
+    rng = np.random.default_rng(0)
+    corpus = PackedCorpus.from_flat(
+        rng.integers(0, Vv, size=60_000).astype(np.int32),
+        cfg.max_sentence_len,
+    )
+    params = init_params(cfg, Vv, jax.random.key(0))
+    fn = res.make_resident_chunk_runner(cfg, t)
+    corpus_dev = {
+        k: jnp.asarray(v) for k, v in res.corpus_arrays(corpus).items()
+    }
+    order = jnp.arange(corpus.num_rows, dtype=jnp.int32)
+    alphas = jnp.full((4,), 0.025, jnp.float32)
+    exp = _export_for_tpu(
+        fn, params, corpus_dev, order, jax.random.key(7), 0, 9999, alphas
+    )
+    assert len(exp.mlir_module_serialized) > 0
+
+
+# ------------------------------------------------------------- rejections
+def test_pallas_fused_requires_unified_layout_and_names_alternative():
+    with pytest.raises(ValueError) as e:
+        _cfg(table_layout="split", band_backend="pallas_fused")
+    msg = str(e.value)
+    assert "table_layout='unified'" in msg      # the fix
+    assert "pallas_oa" in msg                   # the split-table alternative
+
+
+def test_pallas_fused_rejects_batch_scope_and_names_alternative():
+    with pytest.raises(ValueError) as e:
+        _cfg(band_backend="pallas_fused", negative_scope="batch",
+             shared_negatives=256)
+    msg = str(e.value)
+    assert "negative_scope='row'" in msg
+    assert "pallas_oa" in msg
+
+
+def test_pallas_fused_config_rejections_name_the_lever():
+    """The r12 error-message contract: hs / pair rejections name the
+    specific lever that routed the config away from the ns band kernel."""
+    with pytest.raises(ValueError, match="train_method='hs'"):
+        Word2VecConfig(
+            train_method="hs", negative=0, min_count=1,
+            band_backend="pallas_fused", table_layout="unified",
+        )
+    with pytest.raises(ValueError, match="kernel='pair'"):
+        Word2VecConfig(
+            negative=3, min_count=1, kernel="pair",
+            band_backend="pallas_fused", table_layout="unified",
+        )
+    # audit of the existing backends' rejections (same contract)
+    with pytest.raises(ValueError, match="train_method='hs'"):
+        Word2VecConfig(
+            train_method="hs", negative=0, min_count=1,
+            band_backend="pallas_oa",
+        )
+    with pytest.raises(ValueError, match="kernel='pair'"):
+        Word2VecConfig(
+            negative=3, min_count=1, kernel="pair", band_backend="pallas",
+        )
+
+
+def test_unified_pallas_rejection_names_pallas_fused():
+    """unified x the split-gather 'pallas' kernel now points at the fused
+    kernel built FOR the unified slab."""
+    with pytest.raises(ValueError, match="pallas_fused"):
+        _cfg(band_backend="pallas")
+
+
+def test_pallas_fused_rejects_mesh_axes_naming_lever_and_alternative():
+    cfg = _cfg(band_backend="pallas_fused")
+    for axes, lever in (
+        ({"tp_axis": "model"}, "tensor parallelism"),
+        ({"sp_axis": "seq"}, "sequence parallelism"),
+        ({"dp_axis": "data"}, "data-parallel sharding"),
+    ):
+        with pytest.raises(ValueError) as e:
+            make_band_train_step(cfg, _tables(), fused=True, **axes)
+        assert lever in str(e.value)
+        assert "band_backend='xla'" in str(e.value)  # the alternative
+
+
+def test_pallas_fused_requires_fused_params():
+    """Defense in depth for direct callers: split params reach a loud
+    error naming the layout requirement, not a KeyError mid-trace."""
+    cfg = _cfg(band_backend="pallas_fused")
+    with pytest.raises(ValueError, match="unified"):
+        make_band_train_step(cfg, _tables(), fused=False)
+
+
+def test_pallas_fused_requires_chunked_representation():
+    # L=12 with band_chunk=0 resolves dense — nothing to chunk the grid
+    # over, and a silently-dense run would bank a mislabeled A/B
+    cfg = _cfg(max_sentence_len=12, band_chunk=0,
+               band_backend="pallas_fused")
+    step = make_band_train_step(cfg, _tables(), fused=True)
+    with pytest.raises(ValueError, match="chunked band"):
+        step(
+            dict(init_params(cfg, V, jax.random.key(1))),
+            jnp.zeros((2, 12), jnp.int32), jax.random.key(0),
+            jnp.float32(0.03),
+        )
+
+
+def test_pallas_fused_rejected_by_sharded_factories():
+    """shard_map cannot host pallas_call (parallel/trainer._reject_pallas):
+    the sharded step factories must fail up front, naming the mesh as the
+    incompatible lever and the xla backend as the alternative."""
+    from word2vec_tpu.parallel.mesh import make_mesh
+    from word2vec_tpu.parallel.trainer import (
+        make_sharded_chunk, make_sharded_step,
+    )
+
+    cfg = _cfg(band_backend="pallas_fused")
+    t = _tables()
+    for factory in (make_sharded_step, make_sharded_chunk):
+        with pytest.raises(ValueError) as e:
+            factory(cfg, t, make_mesh(1, 1))
+        assert "single-chip" in str(e.value)
+        assert "band_backend='xla'" in str(e.value)
+
+
+# ---------------------------------------------------------------- trainer
+def test_trainer_end_to_end_with_pallas_fused():
+    """--band-backend pallas_fused reachable end-to-end: a short training
+    run through the chunked Trainer path produces finite tables, a report,
+    and — the tracing satellite — exactly one dispatch span per dispatched
+    chunk on the flight timeline (PhaseRecorder stays meaningful)."""
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.data.vocab import Vocab
+    from word2vec_tpu.obs import tracediff
+    from word2vec_tpu.train import Trainer
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=D, window=2,
+        min_count=1, subsample_threshold=0, iters=1, batch_rows=4,
+        max_sentence_len=24, band_chunk=8, chunk_steps=0,
+        band_backend="pallas_fused", table_layout="unified",
+    )
+    rng = np.random.default_rng(3)
+    sents = [[f"w{j}" for j in rng.integers(0, 30, size=20)] for _ in range(80)]
+    vocab = Vocab.build(sents, min_count=1)
+    corpus = PackedCorpus.pack(vocab.encode_corpus(sents), cfg.max_sentence_len)
+    tr = Trainer(cfg, vocab, corpus)
+    state, report = tr.train(log_every=0)
+    assert report.total_words == corpus.num_tokens
+    for k, v in state.params.items():
+        assert np.all(np.isfinite(np.asarray(v).astype(np.float32))), k
+    # one dispatch span per dispatched chunk — the whole fused step is a
+    # single host-side dispatch, same as the XLA chain's contract
+    evs = tr.flight.ring.events()
+    dispatches = [e for e in evs
+                  if e.get("ph") == "X" and e["name"] == "dispatch"]
+    chunks = [e for e in evs if e.get("ph") == "X" and e["name"] == "chunk"]
+    assert len(chunks) >= 1
+    assert len(dispatches) == len(chunks)
+    s = tracediff.summarize(evs)
+    assert s["steps"] == report.steps
+    assert s["spans"]["dispatch"]["count"] == len(dispatches)
+
+
+def test_tracediff_attributes_fused_dispatch_delta_with_sign():
+    """Tracing satellite (the PR 6 injected-delta pattern): a fused-vs-xla
+    pair of traces whose only difference is a shorter dispatch span must
+    attribute the delta to `dispatch` with a negative xla->fused sign —
+    tracediff and input_bound_ratio consumers stay meaningful for the
+    fused backend."""
+    from word2vec_tpu.obs import tracediff
+    from word2vec_tpu.obs.trace import chrome_trace_doc
+
+    def doc(dispatch_us):
+        evs = []
+        for k in range(5):
+            ts = k * 1000.0
+            evs.append({"name": "step", "ph": "X", "ts": ts, "dur": 1000.0,
+                        "tid": 0, "args": {"step": k + 1}})
+            evs.append({"name": "dispatch", "ph": "X", "ts": ts,
+                        "dur": dispatch_us, "tid": 0})
+            evs.append({"name": "batcher_wait", "ph": "X",
+                        "ts": ts + dispatch_us, "dur": 100.0, "tid": 0})
+        return chrome_trace_doc(evs)
+
+    xla, fused = doc(700.0), doc(300.0)  # the program-gap tail collapses
+    d = tracediff.diff(xla, fused)
+    assert d["top_attribution"] == "dispatch"
+    top = d["spans"][0]
+    assert top["span"] == "dispatch"
+    assert top["delta_ms_per_step"] == pytest.approx(-0.4)
+    # the reverse comparison flips the sign
+    assert tracediff.diff(fused, xla)["spans"][0][
+        "delta_ms_per_step"
+    ] == pytest.approx(0.4)

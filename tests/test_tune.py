@@ -122,6 +122,71 @@ def test_pallas_oa_drops_exactly_the_copy_term():
     )
 
 
+def test_fused_step_drops_interop_roundtrips_and_programs():
+    """ISSUE 12: band_backend='pallas_fused' collapses the intermediates
+    term to the token-order grad stack crossing HBM twice (the band
+    planes, the gathered row stack and the overlap-add never leave VMEM),
+    the program chain to ~3, and the XLA scatter rows to the negative tail
+    — paying per-row in-kernel DMAs instead."""
+    B, L, W = 256, 192, 5
+    xla = step_hbm_bytes(_cfg(table_layout="unified"), 71000)
+    fu = step_hbm_bytes(
+        _cfg(table_layout="unified", band_backend="pallas_fused"), 71000
+    )
+    assert fu["layout_copies"] == 0.0
+    assert fu["intermediates"] == 4.0 * B * L * 300 * 4  # grad stack x2 I/O
+    assert fu["intermediates"] < xla["intermediates"]
+    assert fu["table_io"] == xla["table_io"]
+    assert fu["programs"] == 3.0 and xla["programs"] == 9.0
+    assert fu["scatter_rows"] == 256 * 64  # only the negative tail scatter
+    # gathers (centers + slab rows + negatives) + 2 RMW DMAs per scatter row
+    C = -(-L // (128 - 2 * W))  # auto chunks fill a 128-lane slab
+    assert fu["dma_rows"] == B * L + B * C * 128 + 256 * 64 + 2 * B * L
+    assert xla["dma_rows"] == 0.0
+
+
+def test_planner_ranks_fused_above_oa_iff_dma_rows_stay_cheap():
+    """ISSUE 12 counterfactual flip: the fused step outranks pallas_oa at
+    the flagship shape because its program-gap + round-trip savings exceed
+    what it pays in in-kernel DMA rows. Counterfactually pricing those
+    DMAs at 3x the measured XLA scatter anchor must flip the ordering —
+    the model may not hardcode a fused preference, and the flip names the
+    exact sensitivity the tpu_queue8.sh A/B resolves."""
+    oa_cfg = _cfg(table_layout="unified", band_backend="pallas_oa")
+    fu_cfg = _cfg(table_layout="unified", band_backend="pallas_fused")
+    oa_wps = cost_model.predicted_words_per_sec(oa_cfg, 71000, *V5E)
+    fu_wps = cost_model.predicted_words_per_sec(fu_cfg, 71000, *V5E)
+    assert fu_wps > oa_wps
+    # and the predicted delta is material (the queue-entry justification)
+    assert fu_wps > 1.05 * oa_wps
+    orig = cost_model.DMA_SEC_PER_ROW
+    try:
+        cost_model.DMA_SEC_PER_ROW = 3 * cost_model.SCATTER_SEC_PER_ROW
+        oa_slow = cost_model.predicted_words_per_sec(oa_cfg, 71000, *V5E)
+        fu_slow = cost_model.predicted_words_per_sec(fu_cfg, 71000, *V5E)
+        assert oa_slow > fu_slow
+    finally:
+        cost_model.DMA_SEC_PER_ROW = orig
+
+
+def test_fused_attribution_rows_carry_the_new_terms():
+    """bench.py's cost_attribution must name the fused-step sub-terms so a
+    banked record says how much of the predicted step the program-gap tail
+    and the in-kernel DMAs carry."""
+    est = cost_model.predict(
+        _cfg(table_layout="unified", band_backend="pallas_fused"),
+        71000, *V5E,
+    )
+    rows = {r["term"]: r for r in cost_model.attribution_rows(est, {})}
+    assert rows["program_gap"]["predicted_ms"] == round(
+        est.program_gap_ms, 4
+    )
+    assert rows["program_gap"]["programs"] == 3.0
+    assert rows["kernel_dma"]["predicted_ms"] == round(est.dma_ms, 4)
+    assert rows["kernel_dma"]["dma_rows"] == est.dma_rows
+    assert est.step_ms > est.program_gap_ms + est.dma_ms
+
+
 def test_planner_ranks_pallas_oa_above_xla_iff_copy_term_dominates():
     """The ordering the planner's pruning relies on (ISSUE 2): pallas_oa
     beats xla exactly because the strided layout copies cost ~7x their raw
@@ -208,12 +273,14 @@ def test_attribution_rows_carry_the_per_layout_scatter_term():
 # -------------------------------------------------------------- plan cache
 def _key(cfg, device="cpu", platform="cpu", vocab=71000, dim=None):
     """plan_key from a config, the way resolve_plan derives it (the key
-    carries the CONFIGURED table layout + KP width since schema 2)."""
+    carries the CONFIGURED table layout + KP width since schema 2 and the
+    CONFIGURED band backend since schema 3)."""
     return plan_cache.plan_key(
         device, platform, kernel_route(cfg), vocab,
         dim if dim is not None else cfg.word_dim,
         table_layout=cfg.table_layout,
         shared_negatives=cfg.shared_negatives,
+        band_backend=cfg.band_backend,
     )
 
 
@@ -308,16 +375,16 @@ def test_plan_cache_round_trips_the_backend_field(tmp_path):
 def test_vocab_size_bucketing_makes_near_vocabs_share_plans():
     k1 = plan_cache.plan_key(
         "TPU v5 lite", "tpu", "band-ns", 71290, 300,
-        table_layout="split", shared_negatives=64,
+        table_layout="split", shared_negatives=64, band_backend="xla",
     )
     k2 = plan_cache.plan_key(
         "TPU v5 lite", "tpu", "band-ns", 71000, 300,
-        table_layout="split", shared_negatives=64,
+        table_layout="split", shared_negatives=64, band_backend="xla",
     )
     assert k1 == k2
     assert plan_cache.plan_key(
         "TPU v5 lite", "tpu", "band-ns", 50000, 300,
-        table_layout="split", shared_negatives=64,
+        table_layout="split", shared_negatives=64, band_backend="xla",
     ) != k1
 
 
@@ -385,6 +452,76 @@ def test_candidate_grid_offers_pallas_oa_on_tpu():
         cfg, 71000, {"platform": "tpu", "allow_pallas": False}
     )
     assert {p.band_backend for p in sharded} == {"xla"}
+
+
+def test_candidate_grid_offers_pallas_fused_on_tpu_unified_only():
+    """ISSUE 12: the TPU band-ns grid carries pallas_fused candidates, and
+    every one of them pairs the unified layout with the row negative scope
+    and a chunked shape — the combinations config validation rejects never
+    reach a probe."""
+    from word2vec_tpu.ops.banded import resolve_chunk
+
+    cfg = _cfg(chunk_steps=0)
+    grid = candidate_grid(cfg, 71000, {"platform": "tpu"})
+    fused = [p for p in grid if p.band_backend == "pallas_fused"]
+    assert fused, "no pallas_fused candidates on the TPU grid"
+    for plan in fused:
+        assert plan.table_layout == "unified", plan
+        assert plan.negative_scope == "row", plan
+        applied = cfg.apply_plan(plan)  # must not raise
+        assert resolve_chunk(
+            applied.max_sentence_len, applied.window, applied.band_chunk
+        ) > 0, plan
+    # off-TPU: no fused candidates
+    cpu_grid = candidate_grid(cfg, 71000, {"platform": "cpu"})
+    assert all(p.band_backend != "pallas_fused" for p in cpu_grid)
+
+
+def test_plan_cache_key_separates_band_backend(tmp_path):
+    """ISSUE 12 satellite (the PR 7 plan-key lesson, schema 3): a plan
+    probed under the xla or pallas_oa chain must NEVER be served to a
+    band_backend='pallas_fused' run — the configured backend is a key
+    dimension, so the lookup refuses (misses) instead of mislabeling."""
+    path = str(tmp_path / "plans.json")
+    cfg_xla = _cfg(table_layout="unified")
+    fp = config_fingerprint(cfg_xla)
+    plan_cache.store(
+        _key(cfg_xla, "TPU v5 lite", "tpu"),
+        {"plan": cfg_xla.current_plan().to_json(), "fingerprint": fp},
+        path,
+    )
+    cfg_fused = _cfg(table_layout="unified", band_backend="pallas_fused")
+    # the fingerprint is backend-independent (the backend lives in the
+    # KEY), so only the key separation protects this lookup — it must miss
+    assert config_fingerprint(cfg_fused) == fp
+    assert plan_cache.lookup(
+        _key(cfg_fused, "TPU v5 lite", "tpu"), fp, path
+    ) is None
+    # the chain-configured problem still hits its own plan
+    assert plan_cache.lookup(
+        _key(cfg_xla, "TPU v5 lite", "tpu"), fp, path
+    ) is not None
+
+
+def test_plan_cache_round_trips_pallas_fused(tmp_path):
+    """A pallas_fused plan survives store -> lookup -> from_json -> apply
+    with backend AND layout intact (a dropped field would re-run the XLA
+    chain under a pallas_fused label — the forwarding-audit failure
+    mode)."""
+    path = str(tmp_path / "plans.json")
+    cfg = _cfg(table_layout="unified", band_backend="pallas_fused")
+    key = _key(cfg, "TPU v5 lite", "tpu")
+    fp = config_fingerprint(cfg)
+    plan = TunePlan(
+        band_backend="pallas_fused", table_layout="unified",
+        band_chunk=96, chunk_cap=96,
+    )
+    plan_cache.store(key, {"plan": plan.to_json(), "fingerprint": fp}, path)
+    got = TunePlan.from_json(plan_cache.lookup(key, fp, path)["plan"])
+    assert got == plan
+    applied = cfg.apply_plan(got)
+    assert applied.band_backend == "pallas_fused"
+    assert applied.table_layout == "unified"
 
 
 def test_candidate_grid_offers_layout_kp_and_bf16sr_candidates():

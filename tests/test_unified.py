@@ -494,6 +494,7 @@ def test_autotune_probe_arbitrates_layouts_end_to_end(tmp_path):
         keys[0].split("|")[0], "cpu", kernel_route(cfg_u), len(vocab),
         cfg_u.word_dim, table_layout="unified",
         shared_negatives=cfg_u.shared_negatives,
+        band_backend=cfg_u.band_backend,
     )
     assert plan_cache.lookup(key_u, config_fingerprint(cfg_u), cache) is None
 

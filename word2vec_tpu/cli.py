@@ -150,14 +150,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "the whole batch (one dense matmul + KP-row update; "
                         "raise --shared-negatives with 'batch'; "
                         "config.negative_scope)")
-    p.add_argument("--band-backend", choices=["xla", "pallas", "pallas_oa"],
+    p.add_argument("--band-backend",
+                   choices=["xla", "pallas", "pallas_oa", "pallas_fused"],
                    default="xla",
-                   help="band step compute: XLA chain, the fused Pallas "
-                        "kernel, or the XLA chain with the Pallas "
-                        "overlap-add kernel deleting the layout-copy chain "
-                        "(config.band_backend; sg/cbow + ns, f32 or bf16 "
-                        "tables, single-chip; 'pallas' is additionally "
-                        "unfused-only)")
+                   help="band step compute: XLA chain; the fused Pallas "
+                        "kernel; the XLA chain with the Pallas overlap-add "
+                        "kernel deleting the layout-copy chain (pallas_oa); "
+                        "or the fully-fused step — in-kernel gather, "
+                        "compute, overlap-add and the doubled-width sorted "
+                        "scatter over the unified [V, 2, d] slab "
+                        "(pallas_fused; requires --table-layout unified "
+                        "and row negative scope). config.band_backend; "
+                        "sg/cbow + ns, f32 or bf16 tables, single-chip; "
+                        "'pallas' is additionally unfused-only")
     p.add_argument("--slab-scatter", type=int, default=0, choices=[0, 1],
                    help="band kernel: scatter context grads from slab space "
                         "(skips the overlap-add; config.slab_scatter)")

@@ -159,7 +159,22 @@ class Word2VecConfig:
     #                 second argsort, unlike slab_scatter v2); composes with
     #                 fused_tables / bf16 ± SR / both negative scopes;
     #                 chunked representation + single-chip only.
-    # All three are A/B perf levers for the on-chip sweep and candidates in
+    #   "pallas_fused" — the WHOLE band step over the unified [V, 2, d]
+    #                 slab as two Pallas kernels (ops/pallas_step.py):
+    #                 in-kernel token-id gather from the HBM-resident slab,
+    #                 positive/negative dots + sigmoid + gradients in VMEM,
+    #                 the context-gradient overlap-add in token order, and
+    #                 the doubled-width sorted scatter back into the slab
+    #                 as an aliased in-kernel read-modify-write — the
+    #                 intermediate row tensors and band planes never
+    #                 round-trip HBM between XLA programs. Requires
+    #                 table_layout='unified' and negative_scope='row';
+    #                 composes with scatter_mean / clip / bf16 ± SR
+    #                 (f32 trajectory bitwise vs the XLA chain, SR on the
+    #                 split step's exact stream indices —
+    #                 tests/test_pallas_step.py); chunked representation +
+    #                 single-chip only.
+    # All four are A/B perf levers for the on-chip sweep and candidates in
     # the autotuned planner's TPU grid (tune/planner.py).
     band_backend: str = "xla"
 
@@ -400,10 +415,12 @@ class Word2VecConfig:
             raise ValueError(f"kernel must be auto|band|pair, got {self.kernel!r}")
         if self.shared_negatives < 1:
             raise ValueError("shared_negatives must be >= 1")
-        if self.band_backend not in ("xla", "pallas", "pallas_oa"):
+        if self.band_backend not in (
+            "xla", "pallas", "pallas_oa", "pallas_fused"
+        ):
             raise ValueError(
-                f"band_backend must be 'xla', 'pallas' or 'pallas_oa', "
-                f"got {self.band_backend!r}"
+                f"band_backend must be 'xla', 'pallas', 'pallas_oa' or "
+                f"'pallas_fused', got {self.band_backend!r}"
             )
         if self.band_backend != "xla" and (
             self.train_method == "hs" or self.kernel == "pair"
@@ -412,11 +429,36 @@ class Word2VecConfig:
             # router never reaches the band step for hs/pair, and a bench
             # A/B must not bank a measurement labeled pallas that actually
             # ran another kernel
+            lever = (
+                "train_method='hs'" if self.train_method == "hs"
+                else "kernel='pair'"
+            )
             raise ValueError(
                 f"band_backend={self.band_backend!r} applies to the ns band "
-                "kernel only (hs and kernel='pair' route elsewhere; "
-                "ops/pallas_band.py, ops/pallas_overlap.py)"
+                f"kernel only, but this config selects {lever} (which "
+                "routes elsewhere — ops/pallas_band.py, "
+                "ops/pallas_overlap.py, ops/pallas_step.py); drop the "
+                "band_backend override or use the ns band kernel"
             )
+        if self.band_backend == "pallas_fused":
+            if self.table_layout != "unified":
+                raise ValueError(
+                    "band_backend='pallas_fused' requires "
+                    "table_layout='unified' (the kernel gathers and "
+                    f"scatters the [V, 2, d] slab; got table_layout="
+                    f"{self.table_layout!r}) — set table_layout='unified', "
+                    "or use band_backend='pallas_oa' for split tables"
+                )
+            if self.negative_scope != "row":
+                raise ValueError(
+                    "band_backend='pallas_fused' requires "
+                    f"negative_scope='row' (got {self.negative_scope!r}: "
+                    "a batch-scope pool's negative gradient reduces over "
+                    "the whole batch jointly, which the per-row kernel "
+                    "order cannot reproduce bitwise — "
+                    "ops/pallas_step.py) — use band_backend='pallas_oa', "
+                    "which composes with negative_scope='batch'"
+                )
         if self.band_backend == "pallas_oa" and self.slab_scatter:
             # both delete the same overlap-add by different mechanisms; a
             # combined flag would silently measure only one of them
@@ -504,9 +546,12 @@ class Word2VecConfig:
             if self.band_backend == "pallas":
                 raise ValueError(
                     "table_layout='unified' is incompatible with "
-                    "band_backend='pallas' (the fully-fused kernel gathers "
-                    "the two tables separately; 'pallas_oa' composes — "
-                    "ops/pallas_band.py scope note)"
+                    "band_backend='pallas' (that kernel gathers the two "
+                    "tables separately from split params — "
+                    "ops/pallas_band.py scope note); use "
+                    "band_backend='pallas_fused', the fused kernel built "
+                    "FOR the unified slab (ops/pallas_step.py), or "
+                    "'pallas_oa', which composes with either layout"
                 )
             if self.fused_tables:
                 raise ValueError(

@@ -160,34 +160,62 @@ def make_band_train_step(
         )
     pallas = config.band_backend == "pallas"
     pallas_oa = config.band_backend == "pallas_oa"
-    if pallas or pallas_oa:
+    pallas_fused = config.band_backend == "pallas_fused"
+    if pallas or pallas_oa or pallas_fused:
         # Hard errors, not silent fallbacks: a bench A/B that silently ran
-        # the XLA chain would bank a mislabeled measurement.
+        # the XLA chain would bank a mislabeled measurement. Each rejection
+        # names the specific incompatible lever AND the supported
+        # alternative, so a failing config is actionable from the message
+        # alone (the r12 error-message contract; tests/test_pallas_step.py
+        # negative-parse-style pins).
         unsupported = [
-            why for cond, why in [
+            msg for cond, msg in [
                 # fused_tables composes with pallas_oa (its context grads
                 # come back in token order, same index set as the center
                 # side) but not with the fully-fused kernel's slab scatter
-                (fused and pallas, "fused_tables"),
-                (tp_axis is not None, "tensor parallelism"),
-                (sp_axis is not None, "sequence parallelism"),
+                (fused and pallas,
+                 "fused_tables (the fused [V, 2, d] restack has no split "
+                 "gather for this kernel — use band_backend='pallas_oa', "
+                 "which composes with fused_tables, or 'pallas_fused' on "
+                 "table_layout='unified')"),
+                (tp_axis is not None,
+                 "tensor parallelism (tp mesh axis — use "
+                 "band_backend='xla', the only backend shard_map can "
+                 "host)"),
+                (sp_axis is not None,
+                 "sequence parallelism (sp mesh axis — use "
+                 "band_backend='xla')"),
                 # defense in depth: sharded trainers already reject pallas
                 # up front (parallel/trainer._reject_pallas — shard_map
                 # cannot host the kernel, see ops/pallas_band.py scope note)
-                (dp_axis is not None, "data-parallel sharding"),
+                (dp_axis is not None,
+                 "data-parallel sharding (dp mesh axis — use "
+                 "band_backend='xla')"),
                 # only the dtypes whose Mosaic tiling the kernel's block
                 # specs were validated for
                 (config.dtype not in ("float32", "bfloat16"),
-                 f"table dtype {config.dtype}"),
+                 f"table dtype {config.dtype} (supported: float32, "
+                 "bfloat16)"),
             ] if cond
         ]
         if unsupported:
             raise ValueError(
                 f"band_backend={config.band_backend!r} covers the sg/cbow "
                 "ns single-chip step (ops/pallas_band.py, "
-                "ops/pallas_overlap.py); unsupported here: "
-                + ", ".join(unsupported)
+                "ops/pallas_overlap.py, ops/pallas_step.py); unsupported "
+                "here: " + "; ".join(unsupported)
             )
+    if pallas_fused and not fused:
+        # config validation pins pallas_fused to table_layout='unified',
+        # which routes every dispatch through fused=True — this is the
+        # defense-in-depth for direct make_band_train_step callers
+        raise ValueError(
+            "band_backend='pallas_fused' needs the unified [V, 2, d] slab "
+            "params (fused=True via table_layout='unified'); split tables "
+            "have two index spaces the one-gather/one-scatter kernel "
+            "cannot address — use band_backend='pallas_oa' for split "
+            "tables"
+        )
     W = config.window
     K = config.negative
     KP = config.shared_negatives
@@ -560,6 +588,8 @@ def make_band_train_step(
         }
         return new_params, metrics
 
+    if pallas_fused:
+        return _make_pallas_fused_step(config, tables)
     if not pallas:
         return step
 
@@ -749,3 +779,183 @@ def make_band_train_step(
         return new_params, metrics
 
     return step_pallas
+
+
+def _make_pallas_fused_step(config: Word2VecConfig, tables: DeviceTables):
+    """band_backend='pallas_fused' (ops/pallas_step.py): the whole unified
+    band step as gather->dot->grad->overlap-add in one Pallas kernel per
+    band chunk plus the in-kernel doubled-width sorted scatter. The tail
+    between the two kernels (argsort, scatter_mean counts, the clip trust
+    region, bf16 SR casts on the split step's exact per-plane stream
+    indices, and the unsorted negative-row scatter) is the XLA fused
+    branch's code, shared value-for-value — which is what makes the f32
+    trajectory bitwise and bf16 ± SR exact vs the XLA chain
+    (tests/test_pallas_step.py)."""
+    from . import pallas_step
+
+    if config.negative_scope != "row":
+        # d_neg under a batch-scope pool reduces over (batch, position)
+        # jointly — no per-row kernel order reproduces that bitwise
+        # (ops/pallas_step.py scope note)
+        raise ValueError(
+            "band_backend='pallas_fused' supports negative_scope='row' "
+            "only (a batch-scope pool's negative gradient reduces over "
+            "the whole batch at once); use band_backend='pallas_oa', "
+            "which composes with negative_scope='batch'"
+        )
+
+    W = config.window
+    K = config.negative
+    KP = config.shared_negatives
+    is_cbow = config.model == "cbow"
+    cbow_mean = config.cbow_mean
+    scatter_mean = config.scatter_mean
+    clip_tau = config.clip_row_update
+    sr = config.stochastic_rounding
+    cdt = jnp.dtype(config.compute_dtype)
+
+    # interpret=True routes through the Pallas interpreter on non-TPU
+    # backends (CPU tests / smoke); the same code compiles to Mosaic on
+    # chip — the pallas/pallas_oa gate
+    interpret = jax.devices()[0].platform != "tpu"
+
+    def step_fused(
+        params: Params, tokens: jnp.ndarray, key: jax.Array, alpha: jnp.ndarray
+    ) -> Tuple[Params, Metrics]:
+        B, L = tokens.shape
+        k_sub, k_win, k_neg = jax.random.split(key, 3)
+        # same stream indices as the XLA tail (0=in, 1=out, 2=negatives)
+        k_sr = _sr_streams(key, sr)
+
+        valid = tokens >= 0
+        tok = jnp.where(valid, tokens, 0)
+        keep = valid & (jax.random.uniform(k_sub, (B, L)) < tables.keep_probs[tok])
+        w_eff = W - jax.random.randint(k_win, (B, L), 0, W, dtype=jnp.int32)
+
+        S = banded.resolve_chunk(L, W, config.band_chunk)
+        if S == 0:
+            raise ValueError(
+                f"band_backend='pallas_fused' needs the chunked band "
+                f"representation, but rows of length {L} resolved to the "
+                f"dense path (band_chunk={config.band_chunk}, window={W}). "
+                f"Set band_chunk to 2*window <= S < {L}, or use "
+                f"band_backend='xla' for short rows"
+            )
+        C, _ = banded._geom(L, W, S)
+        emb = params[FUSED_KEY]  # [V, 2, d]
+        d = emb.shape[-1]
+
+        negs = _draw_negatives(
+            k_neg, (B, KP), tables.alias_accept, tables.alias_idx
+        )  # [B, KP]
+
+        pad_c = C * S - L
+        tok_c = jnp.pad(tok, ((0, 0), (0, pad_c))).reshape(B, C, S)
+        tok_k = banded.slab_token_ids(tokens, W, S)  # raw ids, -1 outside
+        keep_c = jnp.pad(
+            keep.astype(jnp.float32), ((0, 0), (0, pad_c))
+        ).reshape(B, C, S)
+        w_c = jnp.pad(
+            w_eff.astype(jnp.float32), ((0, 0), (0, pad_c))
+        ).reshape(B, C, S)
+
+        d_ctr, d_ctx, nctx_c, ctxw_c, d_neg, wns, losses = (
+            pallas_step.fused_grad_core(
+                emb, tok_c, tok_k, keep_c, w_c, negs, alpha,
+                W=W, K=K, L=L, cdt=cdt, is_cbow=is_cbow,
+                cbow_mean=cbow_mean, interpret=interpret,
+            )
+        )
+        d_ctr = d_ctr.reshape(B, C * S, d)[:, :L]
+        d_ctx = d_ctx.reshape(B, C * S, d)[:, :L]
+        n_ctx = nctx_c.reshape(B, C * S)[:, :L]
+        ctx_w = ctxw_c.reshape(B, C * S)[:, :L]
+        d_neg_flat = d_neg.reshape(-1, d)
+        flat_negs = negs.reshape(-1)
+
+        # routing mirrors the XLA fused branch (token-order context grads
+        # share the center side's sorted index set)
+        active = (keep & (n_ctx > 0)).astype(jnp.float32)
+        if not is_cbow:
+            d_in_pos, d_out_pos = d_ctr, d_ctx
+            in_weight, out_weight = active, ctx_w
+            pos_pairs = jnp.sum(n_ctx)
+        else:
+            d_in_pos, d_out_pos = d_ctx, d_ctr
+            in_weight, out_weight = ctx_w, active
+            pos_pairs = jnp.sum(active)
+
+        # ---- the XLA fused tail, value-for-value (ops above): one shared
+        # argsort of the row token ids, joint scatter_mean counts, one clip
+        # budget per table, per-plane SR streams
+        flat = tok.reshape(-1)
+        order = jnp.argsort(flat)
+        sorted_idx = flat[order]
+        d_in_flat = d_in_pos.reshape(-1, d)[order]
+        if scatter_mean:
+            d_in_flat = d_in_flat * _dup_mean_scale(
+                emb.shape[0], sorted_idx, in_weight.reshape(-1)[order]
+            )[:, None]
+        d_out_flat = d_out_pos.reshape(-1, d)[order]
+        if scatter_mean:
+            cnt = (
+                jnp.zeros((emb.shape[0],), jnp.float32)
+                .at[flat].add(out_weight.reshape(-1))
+                .at[flat_negs].add(wns.reshape(-1))
+            )
+            inv = 1.0 / jnp.maximum(cnt, 1.0)
+            d_out_flat = d_out_flat * inv[sorted_idx][:, None]
+            d_neg_flat = d_neg_flat * inv[flat_negs][:, None]
+
+        clip_count = jnp.float32(0.0)
+        if clip_tau > 0.0:
+            in_scale = _row_clip_scale(
+                emb.shape[0], clip_tau, (sorted_idx, d_in_flat)
+            )
+            out_scale = _row_clip_scale(
+                emb.shape[0], clip_tau,
+                (sorted_idx, d_out_flat), (flat_negs, d_neg_flat),
+            )
+            clip_count = jnp.sum(
+                (in_scale < 1.0).astype(jnp.float32)
+            ) + jnp.sum((out_scale < 1.0).astype(jnp.float32))
+            d_in_flat = d_in_flat * in_scale[sorted_idx][:, None]
+            d_out_flat = d_out_flat * out_scale[sorted_idx][:, None]
+            d_neg_flat = d_neg_flat * out_scale[flat_negs][:, None]
+
+        vals2 = jnp.stack(
+            [
+                _cast_update(
+                    d_in_flat, emb.dtype, k_sr(0),
+                    emb[sorted_idx, 0] if sr else None,
+                ),
+                _cast_update(
+                    d_out_flat, emb.dtype, k_sr(1),
+                    emb[sorted_idx, 1] if sr else None,
+                ),
+            ],
+            axis=1,
+        )
+        # the doubled-width sorted scatter runs INSIDE the kernel
+        # (sequential RMW = XLA's sorted left-to-right duplicate order)
+        new_emb = pallas_step.fused_slab_scatter(
+            emb, sorted_idx, vals2, interpret=interpret
+        )
+        # negative rows: unsorted tail scatter, SR dest rows from NEW_emb
+        # (the XLA fused branch's binade note)
+        new_emb = new_emb.at[flat_negs, 1].add(
+            _cast_update(
+                d_neg_flat, emb.dtype, k_sr(2),
+                new_emb[flat_negs, 1] if sr else None,
+            )
+        )
+        new_params = dict(params)
+        new_params[FUSED_KEY] = new_emb
+        metrics = {
+            "loss_sum": losses[0, 0] + losses[0, 1],
+            "pairs": pos_pairs + jnp.sum(wns),
+            "clip_engaged": clip_count,
+        }
+        return new_params, metrics
+
+    return step_fused

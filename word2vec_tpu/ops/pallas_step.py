@@ -1,0 +1,506 @@
+"""Fully-fused Pallas train step over the unified [V, 2, d] slab.
+
+The unified-layout XLA band step (ops/band_step.py, table_layout="unified")
+still materializes every intermediate in HBM: the [B, L, 2, d] gathered row
+stack, four band-contraction planes, the overlap-add chain, and the sorted
+doubled-width scatter each execute as separate XLA programs with the row
+tensors round-tripping between them. The r12 lever (ROADMAP item 2) is to
+delete those boundaries: the banked TPU best is dispatch-tail-bound
+(tracediff attributes the kp16 win 100% to dispatch, PERF.md), and the
+planner can only shrink the tail, not remove it.
+
+This module is the whole band step as two Pallas kernels over the
+HBM-resident slab (`band_backend='pallas_fused'`):
+
+  * `fused_grad_core` — grid (B, C+1). Per (batch row, band chunk) it
+    DMA-gathers the center rows (both planes at once — the unified layout's
+    one-gather contract), the context slab rows and the shared-negative
+    rows straight from the slab, computes the band mask, the positive and
+    negative logits, sigmoid and every gradient contraction in VMEM, and
+    performs the context-gradient overlap-add IN TOKEN ORDER with a
+    one-chunk-lagged window reduction (the ops/pallas_overlap.py structure,
+    inlined: chunk c's rows sum their own slab slots plus the <= W-wide
+    left/right neighbor contributions, so the +1 grid step per row flushes
+    the last chunk once its right neighbor can no longer exist). Outputs
+    are exactly the tensors the unified scatter tail needs — per-token
+    center/context gradients, n_ctx / context-weight counts, the per-row
+    negative gradients and expectation weights — nothing else touches HBM.
+  * `fused_slab_scatter` — the doubled-width sorted scatter back into the
+    slab, input/output-aliased: sequential read-modify-write over the
+    sorted (token id, [2, d] value) rows, so duplicate ids accumulate in
+    exactly the left-to-right order XLA's sorted-indices scatter applies
+    (pinned by tests/test_pallas_step.py) and the sorted order the r2
+    "slab scatter lost" experiment destroyed is preserved inside the
+    kernel. Padding ids are -1 and skipped.
+
+Parity contract (the `pallas_oa` bar): the f32 trajectory vs the unified
+XLA chain is BITWISE in interpret mode across sg/cbow x negative-scope-row
+x scatter_mean x clip, and bf16 tables ± stochastic rounding match exactly
+too (the SR cast runs in the shared band_step tail on the split step's
+exact per-plane stream indices). That holds by construction, not by luck:
+
+  * every contraction is a per-chunk `dot_general` whose per-element
+    reduction XLA computes identically for the chunked and full shapes
+    (same contraction length, same operand dtypes);
+  * cross-position reductions (d_neg, w_neg sums) are NOT accumulated
+    chunk-by-chunk — the per-chunk gn/h/w_neg rows are staged in VMEM
+    scratch and reduced once per batch row at the flush step, over exactly
+    the row's L positions, reproducing the XLA einsum's reduction shape;
+  * the overlap-add sums the identical <= 2 slab slots per token row that
+    banded._overlap_add sums (two-operand float addition is order-free);
+  * the loss metrics are the one exception: they accumulate per chunk
+    across the sequential grid (a reassociation), so `loss_sum` is pinned
+    to rtol, not bitwise — parameters, the thing checkpoints and the
+    quality gate read, stay exact.
+
+cbow note: the center logit is a BATCHED row-dot (XLA's einsum
+"bid,bid->bi"). Mosaic has no batched dot_general, so the compiled kernel
+realizes it as multiply + row-sum on the VPU (one-ulp-class reassociation);
+the interpreter path keeps the batched dot so the CPU parity pin stays
+bitwise. Everything else is identical code on both paths.
+
+Scope (config validation + ops/band_step.py): ns band kernel,
+table_layout='unified' only (the kernel gathers and scatters the slab —
+split tables have two index spaces), negative_scope='row' (a batch-scope
+pool's d_neg reduces over (b, i) jointly, which no per-row kernel order
+reproduces bitwise — 'pallas_oa' composes with batch scope instead),
+chunked band representation, single chip (parallel/trainer._reject_pallas).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Sorted-scatter row block per grid step of fused_slab_scatter; the caller
+# pads the flattened id/value rows up to a multiple with id -1 (skipped).
+SCATTER_BLOCK = 512
+
+
+def _gather_rows(emb_ref, dst, idx_fn, n, sem, plane=None):
+    """DMA-gather n rows of the HBM slab into VMEM scratch.
+
+    idx_fn(j) -> row id (already clamped to [0, V)). plane selects one
+    [d] plane of the [V, 2, d] slab; None copies the whole [2, d] row —
+    the unified layout's one-gather-for-both-tables contract.
+    """
+
+    def body(j, carry):
+        i = idx_fn(j)
+        src = emb_ref.at[i] if plane is None else emb_ref.at[i, plane]
+        cp = pltpu.make_async_copy(src, dst.at[j], sem)
+        cp.start()
+        cp.wait()
+        return carry
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def _grad_kernel(
+    alpha_ref,   # [1, 1] SMEM
+    emb_ref,     # [V, 2, d] ANY (HBM-resident slab)
+    tokc_s,      # [1, 1, S, 1] SMEM int32 (clamped center ids, DMA source)
+    tokk_s,      # [1, 1, SK, 1] SMEM int32 (raw slab ids, -1 outside)
+    negs_s,      # [1, KP, 1] SMEM int32
+    tokc_v,      # [1, 1, 1, S] int32
+    tokk_v,      # [1, 1, 1, SK] int32
+    keep_v,      # [1, 1, 1, S] f32
+    wc_v,        # [1, 1, 1, S] f32
+    negs_v,      # [1, 1, KP] int32
+    d_ctr_ref,   # [1, 1, S, d] out (token order, one-chunk lag)
+    d_ctx_ref,   # [1, 1, S, d] out (token order, one-chunk lag)
+    nctx_ref,    # [1, 1, 1, S] out
+    ctxw_ref,    # [1, 1, 1, S] out (token order, one-chunk lag)
+    dneg_ref,    # [1, KP, d] out (per batch row)
+    wns_ref,     # [1, 1, KP] out (per batch row)
+    loss_ref,    # [1, 2] out (accumulated over the grid)
+    g2,          # scratch [S, 2, d] emb dtype — gathered center rows
+    bk,          # scratch [SK, d] emb dtype — gathered context-plane rows
+    en,          # scratch [KP, d] emb dtype — gathered negative rows
+    h_full,      # scratch [C*S, d] f32 — per-row hidden rows (flush input)
+    gn_full,     # scratch [C*S, KP] f32
+    wn_full,     # scratch [C*S, KP] f32
+    y_scr,       # scratch [SK, d] f32 — this chunk's slab-space ctx grad
+    cwy_scr,     # scratch [1, SK] f32 — this chunk's slab col sums
+    dctr_scr,    # scratch [S, d] f32 — this chunk's center grad
+    ctr_stash,   # scratch [S, d] f32 — previous chunk's center grad
+    part_stash,  # scratch [S, d] f32 — prev chunk's ctx grad, body + left
+    tail_stash,  # scratch [W, d] f32 — prev chunk's right-overhang slots
+    cw_part,     # scratch [1, S] f32
+    cw_tail,     # scratch [1, W] f32
+    sem,         # DMA semaphore
+    *,
+    W: int,
+    K: int,
+    C: int,
+    L: int,
+    cdt,
+    is_cbow: bool,
+    cbow_mean: bool,
+    interpret: bool,
+):
+    b = pl.program_id(0)
+    c = pl.program_id(1)
+    S = tokc_v.shape[3]
+    SK = tokk_v.shape[3]
+    KP = negs_v.shape[2]
+    d = g2.shape[2]
+
+    def dot(x, y, dims):
+        return jax.lax.dot_general(
+            x.astype(cdt), y.astype(cdt), (dims, ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    alpha = alpha_ref[0, 0]
+    # which slab plane each side lives on (Word2Vec.cpp:300-315 vs
+    # :330-351 matrix roles): sg scores emb_in centers against emb_out
+    # contexts; cbow swaps them. Negatives always live on the out plane.
+    ctr_plane = 1 if is_cbow else 0
+    ctx_plane = 0 if is_cbow else 1
+
+    # ---------------------------------------------------- compute (c < C)
+    @pl.when(c < C)
+    def _compute():
+        @pl.when(c == 0)
+        def _():
+            _gather_rows(
+                emb_ref, en, lambda k: negs_s[0, k, 0], KP, sem, plane=1
+            )
+
+        # one DMA per center token fetches BOTH planes of its slab row
+        _gather_rows(emb_ref, g2, lambda s: tokc_s[0, 0, s, 0], S, sem)
+        _gather_rows(
+            emb_ref, bk,
+            lambda k: jnp.maximum(tokk_s[0, 0, k, 0], 0), SK, sem,
+            plane=ctx_plane,
+        )
+
+        # band mask (banded.band_mask semantics; int32 iota — Mosaic
+        # rejects float iota)
+        s_iota = jax.lax.broadcasted_iota(jnp.int32, (S, SK), 0)
+        k_iota = jax.lax.broadcasted_iota(jnp.int32, (S, SK), 1)
+        dist = jnp.abs(s_iota + W - k_iota).astype(jnp.float32)
+        valid_k = (tokk_v[0, 0, 0, :] >= 0).astype(jnp.float32)
+        mask = (
+            keep_v[0, 0, 0, :][:, None]
+            * valid_k[None, :]
+            * (dist <= wc_v[0, 0, 0, :][:, None]).astype(jnp.float32)
+            * (dist > 0.0).astype(jnp.float32)
+        )
+        n_ctx = jnp.sum(mask, axis=1)  # [S]
+        nctx_ref[0, 0, 0, :] = n_ctx
+        cwy_scr[0, :] = jnp.sum(mask, axis=0)  # [SK] slab col sums
+
+        a = g2[:, ctr_plane, :]         # center-side rows
+        bk_rows = jnp.where(valid_k[:, None] > 0.0, bk[:], 0)
+
+        # projection h and the reference draw count k_i per center
+        if not is_cbow:
+            h = a.astype(jnp.float32)
+            k_i = n_ctx * float(K)
+        else:
+            h = dot(mask, bk_rows, ((1,), (0,)))
+            if cbow_mean:
+                h = h / jnp.maximum(n_ctx, 1.0)[:, None]
+            k_i = jnp.where(n_ctx > 0.0, float(K), 0.0)
+        h_full[pl.ds(c * S, S), :] = h
+
+        # ---- negative side (per-row shared draws, collision-masked)
+        negs = negs_v[0, 0, :]
+        center_hit = (
+            tokc_v[0, 0, 0, :][:, None] == negs[None, :]
+        ).astype(jnp.float32)  # [S, KP]
+        hit_k = (
+            tokk_v[0, 0, 0, :][:, None] == negs[None, :]
+        ).astype(jnp.float32)  # [SK, KP]
+        ctx_hit = dot(mask, hit_k, ((1,), (0,)))
+        neg_ok = 1.0 - jnp.clip(center_hit + ctx_hit, 0.0, 1.0)
+        w_neg = (k_i / float(KP))[:, None] * neg_ok  # [S, KP]
+        nlog = dot(h, en[:], ((1,), (1,)))  # [S, KP]
+        gn = (0.0 - jax.nn.sigmoid(nlog)) * w_neg * alpha
+        d_hid = dot(gn, en[:], ((1,), (0,)))  # [S, d]
+        gn_full[pl.ds(c * S, S), :] = gn
+        wn_full[pl.ds(c * S, S), :] = w_neg
+        neg_loss = -jnp.sum(w_neg * (jax.nn.log_sigmoid(nlog) - nlog))
+
+        # ---- positive side + gradient routing
+        if not is_cbow:
+            plog = dot(a, bk_rows, ((1,), (1,)))  # [S, SK] band logits
+            gp = (1.0 - jax.nn.sigmoid(plog)) * mask * alpha
+            dctr_scr[:] = d_hid + dot(gp, bk_rows, ((1,), (0,)))
+            y_scr[:] = dot(gp, a, ((0,), (0,)))  # slab-space ctx grad
+            pos_loss = -jnp.sum(mask * jax.nn.log_sigmoid(plog))
+        else:
+            # center logit = batched row-dot of h against the center's
+            # out-plane row. The interpreter keeps XLA's batched-dot
+            # reduction (the bitwise pin); Mosaic has no batched dot, so
+            # on chip it is the VPU multiply + row-sum (module docstring).
+            if interpret:
+                plog_c = jax.lax.dot_general(
+                    h.astype(cdt), a.astype(cdt),
+                    (((1,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                plog_c = jnp.sum(
+                    h.astype(cdt).astype(jnp.float32)
+                    * a.astype(cdt).astype(jnp.float32),
+                    axis=1,
+                )
+            active = (n_ctx > 0.0).astype(jnp.float32)
+            gp = (1.0 - jax.nn.sigmoid(plog_c)) * active * alpha  # [S]
+            dctr_scr[:] = gp[:, None] * h  # center's emb_out update
+            d_hid2 = d_hid + gp[:, None] * a.astype(jnp.float32)
+            if cbow_mean:  # second divide (Word2Vec.cpp:313-315)
+                d_hid2 = d_hid2 / jnp.maximum(n_ctx, 1.0)[:, None]
+            y_scr[:] = dot(mask, d_hid2, ((0,), (0,)))
+            pos_loss = -jnp.sum(active * jax.nn.log_sigmoid(plog_c))
+
+        @pl.when(jnp.logical_and(b == 0, c == 0))
+        def _():
+            loss_ref[...] = jnp.zeros_like(loss_ref)
+
+        loss_ref[0, :] = loss_ref[0, :] + jnp.stack([pos_loss, neg_loss])
+
+    # ------------------------------------------------------ flush (c == C)
+    @pl.when(c == C)
+    def _flush():
+        # no right neighbor exists for the last chunk
+        y_scr[:] = jnp.zeros_like(y_scr)
+        cwy_scr[:] = jnp.zeros_like(cwy_scr)
+        # per-row reductions at FULL row granularity — the XLA einsum's
+        # reduction shape, not a chunk-blocked reassociation (docstring)
+        dneg_ref[0] = dot(gn_full[0:L, :], h_full[0:L, :], ((0,), (0,)))
+        wns_ref[0, 0, :] = jnp.sum(wn_full[0:L, :], axis=0)
+
+    # ------------------------------------- token-order outputs, lagged one
+    # chunk: block (b, c-1) finalizes here, once chunk c's left-overhang
+    # (this chunk's first W slab slots) is known. Same <= 2-slot sums as
+    # banded._overlap_add (ops/pallas_overlap.py structure).
+    d = g2.shape[2]
+    zeros_tail = jnp.zeros((S - W, d), jnp.float32)
+    rpad = jnp.concatenate([zeros_tail, y_scr[0:W, :]], axis=0)
+    d_ctr_ref[0, 0] = ctr_stash[:]
+    d_ctx_ref[0, 0] = part_stash[:] + rpad
+    cw_rpad = jnp.concatenate(
+        [jnp.zeros((1, S - W), jnp.float32), cwy_scr[:, 0:W]], axis=1
+    )
+    ctxw_ref[0, 0] = cw_part[:] + cw_rpad
+
+    # ------------------------------------------------------- stash updates
+    lpad = jnp.concatenate([tail_stash[:], zeros_tail], axis=0)
+    # jnp.where (not a 0-gate multiply): the stash is uninitialized at
+    # c == 0 and garbage * 0.0 would propagate NaN
+    part_stash[:] = y_scr[W:S + W, :] + jnp.where(c > 0, lpad, 0.0)
+    tail_stash[:] = y_scr[S + W:, :]
+    ctr_stash[:] = dctr_scr[:]
+    cw_lpad = jnp.concatenate(
+        [cw_tail[:], jnp.zeros((1, S - W), jnp.float32)], axis=1
+    )
+    cw_part[:] = cwy_scr[:, W:S + W] + jnp.where(c > 0, cw_lpad, 0.0)
+    cw_tail[:] = cwy_scr[:, S + W:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "W", "K", "L", "cdt", "is_cbow", "cbow_mean", "interpret",
+    ),
+)
+def fused_grad_core(
+    emb: jnp.ndarray,     # [V, 2, d] unified slab (any table dtype)
+    tok_c: jnp.ndarray,   # [B, C, S] int32, clamped to [0, V)
+    tok_k: jnp.ndarray,   # [B, C, SK] int32, -1 outside the row
+    keep_c: jnp.ndarray,  # [B, C, S]
+    w_c: jnp.ndarray,     # [B, C, S]
+    negs: jnp.ndarray,    # [B, KP] int32 (negative_scope='row' only)
+    alpha: jnp.ndarray,   # scalar
+    *,
+    W: int,
+    K: int,
+    L: int,
+    cdt=jnp.bfloat16,
+    is_cbow: bool = False,
+    cbow_mean: bool = True,
+    interpret: bool = False,
+):
+    """One fused gather->dot->grad->overlap-add pass; module docstring.
+
+    Returns (d_ctr, d_ctx, n_ctx, ctx_w, d_neg, w_neg_sum, losses):
+      d_ctr  [B, C, S, d]  center-side gradient, token order
+      d_ctx  [B, C, S, d]  context-side gradient, token order (overlap-added)
+      n_ctx  [B, C, S]     active contexts per center
+      ctx_w  [B, C, S]     per-token context contribution counts
+      d_neg  [B, KP, d]    negative-row gradient (reduced over the full row)
+      w_neg_sum [B, KP]    per-draw expectation weight, summed over the row
+      losses [1, 2]        (pos_loss, neg_loss), grid-accumulated (rtol-class)
+    """
+    B, C, S = tok_c.shape
+    SK = tok_k.shape[2]
+    _, KP = negs.shape
+    d = emb.shape[2]
+
+    def sds(shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+    def bc4(i, j):
+        return (i, j, 0, 0)
+
+    def bc4_clamp(i, j):
+        return (i, jnp.minimum(j, C - 1), 0, 0)
+
+    def bc3_clamp(i, j):
+        return (i, jnp.minimum(j, C - 1), 0)
+
+    def lag4(i, j):
+        return (i, jnp.maximum(j - 1, 0), 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda i, j: (0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        # SMEM blocks carry a trailing singleton so the last two block
+        # dims equal the array dims (the Mosaic SMEM tiling rule)
+        pl.BlockSpec((1, 1, S, 1), bc4_clamp, memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, SK, 1), bc4_clamp, memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, KP, 1), lambda i, j: (i, 0, 0),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1, 1, S), bc4_clamp),
+        pl.BlockSpec((1, 1, 1, SK), bc4_clamp),
+        pl.BlockSpec((1, 1, 1, S), bc4_clamp),
+        pl.BlockSpec((1, 1, 1, S), bc4_clamp),
+        pl.BlockSpec((1, 1, KP), lambda i, j: (i, 0, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, 1, S, d), lag4),
+        pl.BlockSpec((1, 1, S, d), lag4),
+        pl.BlockSpec((1, 1, 1, S), bc4_clamp),
+        pl.BlockSpec((1, 1, 1, S), lag4),
+        pl.BlockSpec((1, KP, d), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, 1, KP), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, 2), lambda i, j: (0, 0)),
+    ]
+    out_shape = [
+        sds((B, C, S, d)),
+        sds((B, C, S, d)),
+        sds((B, C, 1, S)),
+        sds((B, C, 1, S)),
+        sds((B, KP, d)),
+        sds((B, 1, KP)),
+        sds((1, 2)),
+    ]
+    kernel = functools.partial(
+        _grad_kernel, W=W, K=K, C=C, L=L, cdt=cdt, is_cbow=is_cbow,
+        cbow_mean=cbow_mean, interpret=interpret,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(B, C + 1),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((S, 2, d), emb.dtype),
+            pltpu.VMEM((SK, d), emb.dtype),
+            pltpu.VMEM((KP, d), emb.dtype),
+            pltpu.VMEM((C * S, d), jnp.float32),
+            pltpu.VMEM((C * S, KP), jnp.float32),
+            pltpu.VMEM((C * S, KP), jnp.float32),
+            pltpu.VMEM((SK, d), jnp.float32),
+            pltpu.VMEM((1, SK), jnp.float32),
+            pltpu.VMEM((S, d), jnp.float32),
+            pltpu.VMEM((S, d), jnp.float32),
+            pltpu.VMEM((S, d), jnp.float32),
+            pltpu.VMEM((W, d), jnp.float32),
+            pltpu.VMEM((1, S), jnp.float32),
+            pltpu.VMEM((1, W), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray(alpha, jnp.float32).reshape(1, 1),
+        emb,
+        tok_c[:, :, :, None], tok_k[:, :, :, None], negs[:, :, None],
+        tok_c[:, :, None], tok_k[:, :, None],
+        keep_c.astype(jnp.float32)[:, :, None],
+        w_c.astype(jnp.float32)[:, :, None],
+        negs[:, None],
+    )
+    d_ctr, d_ctx, nctx, ctxw, d_neg, wns, losses = outs
+    return (
+        d_ctr, d_ctx, nctx[:, :, 0], ctxw[:, :, 0], d_neg, wns[:, 0],
+        losses,
+    )
+
+
+def _scatter_kernel(idx_ref, vals_ref, emb_in_ref, emb_ref, row, sem):
+    """One SCATTER_BLOCK of the sorted doubled-width scatter: sequential
+    read-modify-write per row, so duplicate ids accumulate left-to-right —
+    the sorted-indices order XLA's scatter applies (emb_in_ref is the
+    aliased input view of emb_ref; only emb_ref is touched)."""
+    n = idx_ref.shape[0]
+
+    def body(j, carry):
+        i = idx_ref[j]
+
+        @pl.when(i >= 0)
+        def _():
+            cp = pltpu.make_async_copy(emb_ref.at[i], row, sem)
+            cp.start()
+            cp.wait()
+            row[:] = row[:] + vals_ref[j]
+            cp2 = pltpu.make_async_copy(row, emb_ref.at[i], sem)
+            cp2.start()
+            cp2.wait()
+
+        return carry
+
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_slab_scatter(
+    emb: jnp.ndarray,         # [V, 2, d]
+    sorted_idx: jnp.ndarray,  # [N] int32, ascending; -1 = skip (padding)
+    vals: jnp.ndarray,        # [N, 2, d] in emb's dtype
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """emb.at[sorted_idx].add(vals, indices_are_sorted=True), in-kernel:
+    the slab is input/output-aliased and each sorted row is applied as one
+    VMEM read-modify-write, preserving both the sorted order and XLA's
+    left-to-right duplicate accumulation (bitwise in every table dtype —
+    tests/test_pallas_step.py)."""
+    n = sorted_idx.shape[0]
+    d = emb.shape[2]
+    blk = min(SCATTER_BLOCK, n)
+    pad = (-n) % blk
+    if pad:
+        sorted_idx = jnp.concatenate(
+            [sorted_idx, jnp.full((pad,), -1, sorted_idx.dtype)]
+        )
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((pad, 2, d), vals.dtype)]
+        )
+    return pl.pallas_call(
+        _scatter_kernel,
+        grid=((n + pad) // blk,),
+        in_specs=[
+            pl.BlockSpec((blk,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((blk, 2, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct(emb.shape, emb.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((2, d), emb.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(sorted_idx, vals, emb)

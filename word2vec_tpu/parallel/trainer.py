@@ -122,15 +122,19 @@ def _reject_pallas(config: Word2VecConfig) -> None:
     """shard_map cannot host the pallas band kernels yet: the Pallas
     interpreter's internal dynamic_slices are not vma-aware (crashes even
     on a 1x1x1 mesh on the CPU test backend), and no multi-chip hardware
-    exists here to validate a real-TPU compile. Covers both the fused band
-    kernel (band_backend='pallas') and the overlap-add kernel
-    ('pallas_oa', ops/pallas_overlap.py). Reject up front with the real
-    reason instead of an internal JAX error mid-step."""
-    if config.band_backend in ("pallas", "pallas_oa"):
+    exists here to validate a real-TPU compile. Covers the fused band
+    kernel (band_backend='pallas'), the overlap-add kernel ('pallas_oa',
+    ops/pallas_overlap.py) and the fully-fused step ('pallas_fused',
+    ops/pallas_step.py). Reject up front with the real reason — naming the
+    incompatible lever (the mesh) and the supported alternative — instead
+    of an internal JAX error mid-step."""
+    if config.band_backend in ("pallas", "pallas_oa", "pallas_fused"):
         raise ValueError(
             f"band_backend={config.band_backend!r} is single-chip only "
-            "(plain Trainer); sharded trainers run the XLA band chain — "
-            "see the scope note in ops/pallas_band.py"
+            "(plain Trainer): shard_map cannot host pallas_call, so a "
+            "sharded mesh is the incompatible lever here. Use "
+            "band_backend='xla' for sharded training, or drop the mesh "
+            "axes — see the scope note in ops/pallas_band.py"
         )
 
 

@@ -43,7 +43,12 @@ from typing import Dict, Optional
 
 # 2: plan_key gained (table_layout, shared_negatives); fingerprints dropped
 #    dtype/stochastic_rounding (now TunePlan dimensions the grid searches)
-SCHEMA = 2
+# 3: plan_key gained the CONFIGURED band_backend — a plan probed under the
+#    xla/pallas_oa chain could otherwise be silently applied to a
+#    band_backend='pallas_fused' run (the PR 7 plan-key lesson, again:
+#    the fused step's optimal chunk/cap shapes have no reason to match
+#    the chain's, and a mislabeled cached plan poisons every A/B)
+SCHEMA = 3
 
 _SEED_PATH = os.path.join(os.path.dirname(__file__), "seed_plans.json")
 
@@ -59,22 +64,26 @@ def default_cache_path() -> str:
 
 def plan_key(
     device_kind: str, backend: str, kernel_route: str, vocab_size: int,
-    dim: int, table_layout: str, shared_negatives: int,
+    dim: int, table_layout: str, shared_negatives: int, band_backend: str,
 ) -> str:
     """The cache key: (device_kind, backend, kernel, vocab_size, dim,
-    table_layout, shared_negatives).
+    table_layout, shared_negatives, band_backend).
 
     vocab_size is bucketed to 2 significant figures — step shapes do not
     change between a 71,290- and a 71,000-word vocabulary, and an exact
     count would make every corpus re-probe.
 
-    table_layout and shared_negatives are the CONFIGURED values (the
-    problem identity), deliberately required arguments: a default would
-    re-open the schema-1 bug where a cached split-layout plan was silently
-    applied to a unified-layout run (or a pinned-KP quality run inherited
-    another width's plan). The plan stored under the key may still realize
-    a different layout/width — that is the planner's arbitration, recorded
-    in the entry, not an identity mismatch.
+    table_layout, shared_negatives and band_backend are the CONFIGURED
+    values (the problem identity), deliberately required arguments: a
+    default would re-open the schema-1 bug where a cached split-layout
+    plan was silently applied to a unified-layout run (or a pinned-KP
+    quality run inherited another width's plan). Schema 3 added
+    band_backend for the same reason: a plan probed under the xla or
+    pallas_oa chain must never be silently applied to a
+    band_backend='pallas_fused' run. The plan stored under the key may
+    still realize a different layout/width/backend — that is the
+    planner's arbitration, recorded in the entry, not an identity
+    mismatch.
     """
     v = int(vocab_size)
     if v >= 100:
@@ -82,7 +91,7 @@ def plan_key(
         v = (v // mag) * mag
     return (
         f"{device_kind or 'unknown'}|{backend}|{kernel_route}|V{v}|d{dim}"
-        f"|{table_layout}|kp{int(shared_negatives)}"
+        f"|{table_layout}|kp{int(shared_negatives)}|{band_backend}"
     )
 
 

@@ -71,6 +71,28 @@ LAYOUT_COPY_INEFFICIENCY = 7.4
 # (tests/test_tune.py counterfactual-flip pin).
 SCATTER_SEC_PER_ROW = 21e-9
 
+# --- fused-step terms (r12 lever, band_backend='pallas_fused') ---
+# The step's op chain executes as `programs` separately scheduled device
+# programs (utils/profiling.step_hbm_bytes "programs": ~9 for the XLA
+# band chain — gathers, four band contractions, the overlap-add, two table
+# scatters — vs 3 for the fused step). Each boundary costs a scheduling
+# gap the byte roofline cannot see; the r2 trace's step decomposition
+# leaves ~1 ms of the 7.97 ms flagship step unattributed to bytes, flops
+# or scatter rows, which at the 9-program chain calibrates the gap to
+# ~0.12 ms/program. This is the dispatch-tail term the fused step deletes
+# (tracediff attributed the kp16 win 100% to dispatch — the motivating
+# evidence that the tail, not the bytes, now binds).
+PROGRAM_GAP_MS = 0.12
+# The fused kernels pay their gathers/scatter as back-to-back in-kernel
+# row DMAs (step_hbm_bytes "dma_rows") instead of XLA scatter machinery.
+# Priced at a third of SCATTER_SEC_PER_ROW: a descriptor-driven DMA skips
+# the scatter's bounds/update machinery and overlaps with compute. The
+# fused step's predicted win hinges on this staying well under the 21 ns
+# anchor — the r12 counterfactual-flip test pins exactly that sensitivity
+# (price DMAs AT the scatter anchor x3 and the fused step must stop
+# outranking pallas_oa), and the tpu_queue8.sh A/B banks the ground truth.
+DMA_SEC_PER_ROW = 7e-9
+
 
 def device_spec(
     device_kind: str, platform: str
@@ -90,7 +112,11 @@ class CostEstimate:
     copy_bytes: float
     scatter_rows: float  # rows fed to table scatter-adds (a count)
     scatter_ms: float    # scatter_rows * SCATTER_SEC_PER_ROW (per-layout)
-    step_ms: float       # compute + traffic + copies + scatter rows, per step
+    dma_rows: float      # in-kernel per-row DMAs (pallas_fused only)
+    dma_ms: float        # dma_rows * DMA_SEC_PER_ROW
+    programs: float      # separately scheduled device programs per step
+    program_gap_ms: float  # programs * PROGRAM_GAP_MS (the dispatch tail)
+    step_ms: float       # compute + traffic + copies + row terms, per step
     dispatch_ms: float   # per-step share of dispatch overhead
     total_ms: float
 
@@ -101,6 +127,10 @@ class CostEstimate:
             "copy_bytes": self.copy_bytes,
             "scatter_rows": self.scatter_rows,
             "scatter_ms": round(self.scatter_ms, 4),
+            "dma_rows": self.dma_rows,
+            "dma_ms": round(self.dma_ms, 4),
+            "programs": self.programs,
+            "program_gap_ms": round(self.program_gap_ms, 4),
             "step_ms": round(self.step_ms, 4),
             "dispatch_ms": round(self.dispatch_ms, 4),
             "total_ms": round(self.total_ms, 4),
@@ -115,6 +145,17 @@ def table_scatter_ms(scatter_rows: float) -> float:
     """The per-layout scatter term: row machinery the byte roofline cannot
     see (~21 ns/row regardless of width — SCATTER_SEC_PER_ROW anchor)."""
     return 1e3 * scatter_rows * SCATTER_SEC_PER_ROW
+
+
+def kernel_dma_ms(dma_rows: float) -> float:
+    """The fused step's in-kernel per-row DMA term (DMA_SEC_PER_ROW)."""
+    return 1e3 * dma_rows * DMA_SEC_PER_ROW
+
+
+def program_gap_ms(programs: float) -> float:
+    """Inter-program scheduling gaps in the step's device op chain — the
+    dispatch tail the fused step collapses (PROGRAM_GAP_MS each)."""
+    return programs * PROGRAM_GAP_MS
 
 
 def predict(
@@ -135,10 +176,16 @@ def predict(
     streamed = traffic["total"] - traffic["layout_copies"]
     scatter_rows = traffic.get("scatter_rows", 0.0)
     scatter_ms = table_scatter_ms(scatter_rows)
+    dma_rows = traffic.get("dma_rows", 0.0)
+    dma_ms = kernel_dma_ms(dma_rows)
+    programs = traffic.get("programs", 0.0)
+    gap_ms = program_gap_ms(programs)
     step_ms = (
         1e3 * max(flops / peak, streamed / bw)
         + layout_copy_ms(traffic["layout_copies"], bw)
         + scatter_ms
+        + dma_ms
+        + gap_ms
     )
     cap = chunk_cap if chunk_cap is not None else config.chunk_cap
     dispatch_ms = overhead / max(1, cap)
@@ -148,6 +195,10 @@ def predict(
         copy_bytes=traffic["layout_copies"],
         scatter_rows=scatter_rows,
         scatter_ms=scatter_ms,
+        dma_rows=dma_rows,
+        dma_ms=dma_ms,
+        programs=programs,
+        program_gap_ms=gap_ms,
         step_ms=step_ms,
         dispatch_ms=dispatch_ms,
         total_ms=step_ms + dispatch_ms,
@@ -223,5 +274,30 @@ def attribution_rows(est: CostEstimate, trace_summary: Dict) -> list:
         "delta_ms": None,
         "note": "sub-term of device_step; measure via split-vs-unified "
                 "tracediff A/B",
+    })
+    # Fused-step sub-terms (r12): the program-gap tail the fused backend
+    # collapses and the in-kernel DMA rows it pays instead. Like
+    # table_scatter these have no host-visible span of their own — they
+    # are measured DIFFERENTIALLY via a fused-vs-xla tracediff A/B (the
+    # dispatch-span delta between the two runs isolates the gap term).
+    rows.append({
+        "term": "program_gap",
+        "spans": [],
+        "predicted_ms": round(est.program_gap_ms, 4),
+        "programs": est.programs,
+        "measured_ms": None,
+        "delta_ms": None,
+        "note": "sub-term of device_step; measure via fused-vs-xla "
+                "tracediff A/B (the dispatch-span delta)",
+    })
+    rows.append({
+        "term": "kernel_dma",
+        "spans": [],
+        "predicted_ms": round(est.dma_ms, 4),
+        "dma_rows": est.dma_rows,
+        "measured_ms": None,
+        "delta_ms": None,
+        "note": "sub-term of device_step; nonzero only for "
+                "band_backend='pallas_fused'",
     })
     return rows

@@ -49,7 +49,9 @@ from . import cost_model
 # them would orphan every banked seed plan for an observability overlay.
 # Schema 2 moved dtype/stochastic_rounding OUT of the fingerprint (they are
 # TunePlan dimensions the grid searches — the bf16+SR-default candidate)
-# and table_layout into the KEY (cache.plan_key), not here.
+# and table_layout into the KEY (cache.plan_key), not here. Schema 3 moved
+# the configured band_backend into the KEY too (a pallas_fused run must
+# never inherit a chain-probed plan — cache.py).
 FINGERPRINT_FIELDS = (
     "model", "train_method", "negative", "window", "max_sentence_len",
     "compute_dtype", "slab_scatter",
@@ -227,15 +229,19 @@ def candidate_grid(
         and c.get("allow_pallas", True)
         and c.get("platform") == "tpu"
     ):
-        # the fully-fused kernel cannot gather fused [V, 2, d] tables
+        # the per-chunk fused kernel cannot gather fused [V, 2, d] tables
         # (chunk-restacked OR unified-layout); the overlap-add kernel
         # composes with both (token-order output shares the center side's
-        # sorted index set — ops/pallas_overlap.py). unified x pallas
-        # combos are additionally dropped by apply_plan's validation.
+        # sorted index set — ops/pallas_overlap.py); the fully-fused step
+        # REQUIRES the unified slab (ops/pallas_step.py). Invalid combos
+        # (unified x pallas, split x pallas_fused, batch-scope x
+        # pallas_fused, ...) are dropped by apply_plan's validation.
         if not config.fused_tables and "pallas" not in backends:
             backends.append("pallas")
         if "pallas_oa" not in backends:
             backends.append("pallas_oa")
+        if "pallas_fused" not in backends:
+            backends.append("pallas_fused")
 
     combos = [
         (b, cap, kp, scope, S, be, lay, dt)
@@ -276,8 +282,8 @@ def candidate_grid(
         cand_block = (applied.batch_rows // applied.micro_steps) * L
         if cand_block > max_block:
             continue
-        if be in ("pallas", "pallas_oa"):
-            # both kernels require the chunked band representation; a
+        if be in ("pallas", "pallas_oa", "pallas_fused"):
+            # all three kernels require the chunked band representation; a
             # candidate whose rows resolve dense would only burn a probe
             # on a guaranteed ValueError
             from ..ops.banded import resolve_chunk
@@ -413,6 +419,7 @@ def resolve_plan(
         config.word_dim,
         table_layout=config.table_layout,
         shared_negatives=config.shared_negatives,
+        band_backend=config.band_backend,
     )
     fp = config_fingerprint(config)
 

@@ -206,7 +206,25 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
                        and it is the term the table LAYOUT moves: the
                        unified [V, 2, d] slab scatters the shared sorted
                        id set once at doubled width instead of twice.
-      total          — sum of the BYTE terms (scatter_rows excluded)
+      dma_rows       — a COUNT: per-row DMAs the pallas_fused kernels
+                       issue INSIDE the step (in-kernel gathers + the
+                       aliased scatter's read-modify-writes). Zero for
+                       every other backend (their gathers/scatters are
+                       priced as table_io bytes + scatter_rows). Priced
+                       by tune/cost_model.DMA_SEC_PER_ROW — the fused
+                       step's whole bet is that back-to-back in-kernel
+                       DMAs underprice XLA's scatter row machinery, which
+                       is exactly the sensitivity the counterfactual-flip
+                       test pins (tests/test_tune.py).
+      programs       — a COUNT: separately scheduled device programs the
+                       step's op chain splits into (gathers / band
+                       matmuls / overlap-add / scatters). The dispatch
+                       tail the fused step exists to delete: ~1 program
+                       per kernel for pallas_fused vs the XLA chain's
+                       ~9 (tune/cost_model.PROGRAM_GAP_MS prices the
+                       inter-program gaps).
+      total          — sum of the BYTE terms (scatter_rows/dma_rows/
+                       programs excluded)
 
     Absolute bytes are a model, not a measurement — the value is in the
     ORDERING (pallas < xla band << pair at bench shapes) and the terms'
@@ -228,6 +246,8 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
             "layout_copies": 0.0,
             # per-pair enumeration scatters every (pair, target) row
             "scatter_rows": float(P + P * targets),
+            "dma_rows": 0.0,
+            "programs": 4.0,
             "total": gathers + scatters + inter,
         }
     if g["route"] == "band-hs":
@@ -249,6 +269,8 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
             "intermediates": inter,
             "layout_copies": 0.0,
             "scatter_rows": float(B * (L + 2 * g["W"]) * path + B * L),
+            "dma_rows": 0.0,
+            "programs": 6.0,
             "total": table_io + inter,
         }
     # --- band ns ---
@@ -263,19 +285,43 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
     # width; slab-space paths (slab_scatter, the fused pallas kernel) trade
     # one token-order scatter for a (S+2W)/S-larger slab-id scatter.
     slab_side = g["backend"] == "pallas" or (config.slab_scatter and g["S"] > 0)
+    dma_rows = 0.0
+    programs = 9.0  # the XLA chain's gather/matmul/overlap-add/scatter ops
     if slab_side:
         scatter_rows = B * L + B * g["C"] * g["slab"] + g["NB"] * g["KP"]
     elif g["layout"] == "unified":
         scatter_rows = B * L + g["NB"] * g["KP"]
     else:
         scatter_rows = 2 * B * L + g["NB"] * g["KP"]
-    if g["backend"] == "pallas":
+    if g["backend"] == "pallas_fused":
+        # Fully-fused step (ops/pallas_step.py): gathers and the doubled-
+        # width sorted scatter happen INSIDE the kernels as per-row DMAs
+        # (dma_rows), and the only XLA scatter left is the negative-row
+        # tail. The intermediates term collapses to the token-order
+        # [B, L, 2, d] gradient stack crossing HBM once out of the grad
+        # kernel and once into the scatter kernel — the band planes, the
+        # gathered row stack and the overlap-add chain never leave VMEM.
+        scatter_rows = g["NB"] * g["KP"]
+        dma_rows = float(
+            B * L                          # center rows, both planes/DMA
+            + B * g["C"] * g["slab"]       # context slab rows
+            + g["NB"] * g["KP"]            # negative rows
+            + 2 * B * L                    # scatter read-modify-writes
+        )
+        programs = 3.0  # grad kernel + scatter kernel + negative scatter
+        inter = 4.0 * ein_rows * f32  # the [B, L, 2, d] grad stack, out+in
+        copies = 0.0
+    elif g["backend"] == "pallas":
         # each row tensor crosses HBM exactly once in and once out
         # (kernel outputs d_h/d_ctx/d_neg in f32)
         inter = (ein_rows + slab_rows + neg_rows) * tb + (
             B * g["C"] * g["S"] * d + slab_rows + neg_rows
         ) * f32
         copies = 0.0
+        # one compute kernel + XLA gathers and the three scatters;
+        # pallas_oa stays at the XLA chain's count — its kernel replaces
+        # the overlap-add chain 1:1 (the win there was bytes, not programs)
+        programs = 6.0
     elif g["backend"] == "pallas_oa" and g["S"] > 0:
         # the XLA chain's traffic, with the overlap-add done in VMEM by
         # ops/pallas_overlap.py: the layout-copy term disappears and the
@@ -304,5 +350,7 @@ def step_hbm_bytes(config, vocab_size: int) -> Dict[str, float]:
         "intermediates": inter,
         "layout_copies": copies,
         "scatter_rows": float(scatter_rows),
+        "dma_rows": dma_rows,
+        "programs": programs,
         "total": table_io + inter + copies,
     }

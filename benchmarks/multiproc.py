@@ -290,9 +290,9 @@ def _run_signals(args, result, tmp, procs, logs, straggler, t0) -> None:
     print(_json.dumps(result))
 
 
-def _manifest(tmp, rank=0):
+def _manifest(tmp, rank=0, mdir=None):
     try:
-        with open(os.path.join(tmp, f"m{rank}", "manifest.json")) as f:
+        with open(os.path.join(tmp, mdir or f"m{rank}", "manifest.json")) as f:
             return json.load(f)
     except (OSError, ValueError):
         return {}
@@ -313,12 +313,17 @@ def _run_elastic(args, result, tmp, procs, logs, victim, cmds, envs,
     N-1 fleet resumed from the same generation snapshot."""
     import numpy as np
 
-    result["chaos"] = "elastic"
+    result["chaos"] = args.chaos
     result["elastic_mode"] = args.elastic_mode
     result["victim_rank"] = victim
     result["kill_at_step"] = args.kill_at
     result["step_deadline_s"] = args.step_deadline
     result["sync_deadline_s"] = args.sync_deadline
+    result["compile_cache"] = bool(args.compile_cache)
+    # manifests are primary-gated: after the kill the new primary is the
+    # lowest SURVIVING rank (old rank 1 when rank 0 is the victim — the
+    # rank-0-kill drill's elected rendezvous host)
+    mrank = min(r for r in range(len(procs)) if r != victim)
 
     def fail(msg, tails=()):
         for p in procs:
@@ -382,7 +387,7 @@ def _run_elastic(args, result, tmp, procs, logs, victim, cmds, envs,
     # (2.5x sync deadline) + exec + jax.distributed re-init + recompile
     shrink_budget = 4.0 * args.sync_deadline + 120.0
     if not wait_for(
-        lambda: _manifest(tmp).get("elastic_generation", 0) >= 1,
+        lambda: _manifest(tmp, mrank).get("elastic_generation", 0) >= 1,
         shrink_budget, "shrink",
     ):
         survivors = [r for r in range(len(procs)) if r != victim]
@@ -392,12 +397,34 @@ def _run_elastic(args, result, tmp, procs, logs, victim, cmds, envs,
             f"kill (survivor rcs so far: {rcs}) — survivors aborted or "
             "hung instead of remeshing", survivors,
         )
-    man1 = _manifest(tmp)
+    man1 = _manifest(tmp, mrank)
     t_shrink = time.perf_counter() - t0
     result["shrink_detect_to_resume_s"] = round(t_shrink - t_kill, 1)
     result["gen1_world"] = (man1.get("mesh_events") or [{}])[-1].get("world")
     snap1 = os.path.join(tmp, "ck_shared.elastic_g1")
     result["gen1_snapshot"] = os.path.isdir(snap1)
+    result["gen1_compile_cache"] = (man1.get("compile_cache") or None)
+    if victim == 0:
+        # rank-0 kill: the rendezvous died with its host, so generation 1
+        # can only exist if the survivors RE-ELECTED it — assert the
+        # election event landed in the manifest's mesh_events and that the
+        # deciding rendezvous moved off the original coordinator address
+        events = man1.get("mesh_events") or []
+        elections = [e for e in events
+                     if e.get("event") == "rendezvous_election"]
+        result["election"] = elections[-1] if elections else None
+        if not elections:
+            return fail(
+                "rank-0 kill formed generation 1 WITHOUT a recorded "
+                f"rendezvous election (mesh_events: {events})",
+                [r for r in range(len(procs)) if r != victim],
+            )
+        gen1 = [e for e in events if e.get("gen") == 1
+                and e.get("event") == "generation_start"]
+        result["gen1_rendezvous"] = (
+            gen1[-1].get("rendezvous") if gen1 else None
+        )
+        result["gen1_trigger"] = gen1[-1].get("trigger") if gen1 else None
 
     # ---- phase 3 (shrink+grow): relaunch the victim, expect readmission -
     if args.elastic_mode == "shrink+grow":
@@ -412,7 +439,7 @@ def _run_elastic(args, result, tmp, procs, logs, victim, cmds, envs,
         )
         grow_budget = 4.0 * args.sync_deadline + 150.0
         if not wait_for(
-            lambda: _manifest(tmp).get("elastic_generation", 0) >= 2,
+            lambda: _manifest(tmp, mrank).get("elastic_generation", 0) >= 2,
             grow_budget, "grow",
         ):
             return fail(
@@ -422,7 +449,7 @@ def _run_elastic(args, result, tmp, procs, logs, victim, cmds, envs,
             )
         t_grow = time.perf_counter() - t0
         result["grow_relaunch_to_resume_s"] = round(t_grow - t_shrink, 1)
-        events = _manifest(tmp).get("mesh_events") or []
+        events = _manifest(tmp, mrank).get("mesh_events") or []
         gen2 = [e for e in events if e.get("gen") == 2
                 and e.get("event") == "generation_start"]
         result["gen2_world"] = gen2[-1].get("world") if gen2 else None
@@ -452,6 +479,22 @@ def _run_elastic(args, result, tmp, procs, logs, victim, cmds, envs,
         return fail(f"ranks {bad} exited nonzero on the elastic path "
                     f"(rcs={result['rcs']})", bad)
     result["wall_s"] = round(time.perf_counter() - t0, 1)
+
+    # ---- blackout wall: kill -> first POST-KILL step progress ----------
+    # the full recovery blackout an external observer sees (detection +
+    # rendezvous + exec + jax re-init + COMPILE + the steps to the first
+    # post-resume checkpoint rotation). The warm-restart compile cache
+    # attacks the compile term: rerunning this drill with the same
+    # --compile-cache dir banks the warm wall next to the cold one.
+    pre_rows = [c for c in curve if c["t_s"] <= t_kill]
+    step_at_kill = pre_rows[-1]["step"] if pre_rows else 0
+    first_post = next(
+        (c for c in curve
+         if c["t_s"] > t_kill and c["step"] > step_at_kill), None,
+    )
+    result["blackout_to_first_progress_s"] = (
+        round(first_post["t_s"] - t_kill, 1) if first_post else None
+    )
 
     # ---- throughput curve: pre-kill vs post-remesh slopes ---------------
     # words_done is rank 0's LOCAL count (constant words per global step),
@@ -572,6 +615,119 @@ def _parity_reference(args, tmp, victim, dp):
                   "reference_world": world, "reference_dp": new_dp}
 
 
+def _run_policy(args, result, tmp, procs, logs, straggler, t0) -> None:
+    """Policy-driven autoscale drill (ISSUE 13 acceptance): ZERO failures
+    injected — a stall stretch makes one rank a straggler, the
+    --elastic-policy throughput rule drives a shrink that evicts it
+    (trigger=policy), the evicted host parks as a rejoiner, and the
+    policy's recovery rule opens the grow gate so it is readmitted
+    (trigger=policy). Asserts exactly one shrink + one grow (hysteresis:
+    no remesh oscillation), every process rc=0, and no failure-triggered
+    remesh anywhere."""
+    mdir = "mpol"
+
+    def fail(msg, ranks=()):
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        result["error"] = msg
+        if ranks:
+            result["log_tails"] = [_tail(logs, r) for r in ranks]
+        print(json.dumps(result))
+
+    result["chaos"] = "policy"
+    result["straggler_rank"] = straggler
+    result["policy"] = args.policy_spec
+
+    def gen() -> int:
+        return _manifest(tmp, mdir=mdir).get("elastic_generation", 0)
+
+    def wait_for(pred, budget, what):
+        deadline = time.time() + budget
+        while time.time() < deadline:
+            if pred():
+                return True
+            for r, p in enumerate(procs):
+                if p.poll() is not None and p.returncode != 0:
+                    result[f"early_exit_rank{r}"] = p.returncode
+                    return False
+            time.sleep(0.4)
+        return False
+
+    budget = 240.0
+    if not wait_for(lambda: gen() >= 1, budget, "policy shrink"):
+        return fail(
+            f"no policy-shrink generation within {budget:.0f}s (gen "
+            f"{gen()}) — the policy never actuated", range(len(procs)),
+        )
+    t_shrink = time.perf_counter() - t0
+    result["policy_shrink_at_s"] = round(t_shrink, 1)
+    if not wait_for(lambda: gen() >= 2, budget, "policy grow"):
+        return fail(
+            f"no policy-grow generation within {budget:.0f}s of the "
+            f"shrink (gen {gen()}) — the evicted host was not readmitted",
+            range(len(procs)),
+        )
+    result["policy_grow_at_s"] = round(time.perf_counter() - t0, 1)
+    deadline = time.time() + args.timeout
+    while time.time() < deadline:
+        if all(p.poll() is not None for p in procs):
+            break
+        time.sleep(0.3)
+    still = [r for r, p in enumerate(procs) if p.poll() is None]
+    if still:
+        return fail(f"ranks {still} still running at the drill timeout",
+                    still)
+    result["rcs"] = [p.returncode for p in procs]
+    result["wall_s"] = round(time.perf_counter() - t0, 1)
+    if any(result["rcs"]):
+        return fail(
+            f"zero-failure policy drill must end rc=0 everywhere, got "
+            f"{result['rcs']}",
+            [r for r, rc in enumerate(result["rcs"]) if rc],
+        )
+    man = _manifest(tmp, mdir=mdir)
+    events = man.get("mesh_events") or []
+    remeshes = [e for e in events if e.get("event") == "remesh"]
+    result["mesh_events"] = [
+        {k: e.get(k) for k in ("event", "gen", "kind", "trigger", "world",
+                               "to_world", "victim")}
+        for e in events
+    ]
+    failure = [e for e in remeshes if e.get("trigger") == "failure"]
+    if failure:
+        return fail(f"failure-triggered remesh in a ZERO-failure drill: "
+                    f"{failure}", [0])
+    shrinks = [e for e in remeshes if e.get("kind") == "policy_shrink"]
+    grows = [e for e in remeshes if e.get("kind") == "grow"]
+    if len(shrinks) != 1 or shrinks[0].get("trigger") != "policy":
+        return fail(f"expected exactly ONE policy shrink, got {shrinks}",
+                    [0])
+    if shrinks[0].get("victim") != straggler:
+        return fail(
+            f"policy shrink evicted rank {shrinks[0].get('victim')}, "
+            f"expected the injected straggler {straggler}", [0],
+        )
+    if len(grows) != 1 or grows[0].get("trigger") != "policy":
+        return fail(f"expected exactly ONE policy grow, got {grows}", [0])
+    if len(remeshes) != 2 or man.get("elastic_generation") != 2:
+        return fail(
+            f"remesh oscillation: {len(remeshes)} remeshes, final gen "
+            f"{man.get('elastic_generation')} (hysteresis must pin "
+            "exactly shrink->grow)", [0],
+        )
+    gen2 = [e for e in events if e.get("event") == "generation_start"
+            and e.get("gen") == 2]
+    result["final_world"] = gen2[-1].get("world") if gen2 else None
+    if result["final_world"] != args.procs:
+        return fail(
+            f"final world {result['final_world']} != launch world "
+            f"{args.procs}", [0],
+        )
+    result["ok"] = True
+    print(json.dumps(result))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--procs", type=int, default=2)
@@ -599,6 +755,17 @@ def main() -> None:
                     "survivors exit within them instead of hanging; the "
                     "special value 'elastic' runs the elastic shrink/grow "
                     "drill instead (survivors must remesh and CONTINUE); "
+                    "'rank0' runs the elastic drill with the RENDEZVOUS "
+                    "HOST as the victim (rank0_dead fault): survivors "
+                    "must re-elect the rendezvous onto the lowest "
+                    "surviving rank, shrink to N-1, and byte-match a "
+                    "fresh N-1 resume — the rank-0-survival acceptance; "
+                    "'policy' runs the ZERO-failure autoscale drill "
+                    "(resilience/policy.py): a stall stretch makes "
+                    "--chaos-rank a straggler, the --policy-spec rules "
+                    "drive a trigger=policy shrink evicting it and a "
+                    "later trigger=policy grow readmitting it, with "
+                    "hysteresis pinned (exactly one of each); "
                     "the special value 'signals' runs the fleet signal-"
                     "plane drill (obs/signals.py): repeated stalls slow "
                     "--chaos-rank, every rank publishes windowed signal "
@@ -606,6 +773,18 @@ def main() -> None:
                     "asserts fleet.json names the straggler host, the "
                     "--slo throughput rule escalates warn->breach, and "
                     "the SloEvent lands in rank 0's flight.json")
+    ap.add_argument("--policy-spec", metavar="RULES",
+                    default="throughput_wps<0.55*baseline:for=2:baseline=2"
+                            ":act=shrink,"
+                            "throughput_wps>0.7*baseline:for=2:baseline=2"
+                            ":act=grow,cooldown=3",
+                    help="--chaos policy: the --elastic-policy rules "
+                    "forwarded to every rank")
+    ap.add_argument("--compile-cache", metavar="DIR", default="",
+                    help="elastic drills: forward --compile-cache DIR to "
+                    "every rank (warm-restart compile cache; pass the "
+                    "SAME absolute dir to a second drill run to measure "
+                    "the warm blackout against the cold one)")
     ap.add_argument("--elastic-mode", choices=["shrink", "shrink+grow"],
                     default="shrink+grow",
                     help="--chaos elastic: shrink runs the kill->remesh leg "
@@ -673,15 +852,29 @@ def main() -> None:
         }
 
         # --- multi-process run -------------------------------------------
-        elastic = args.chaos == "elastic"
+        rank0_drill = args.chaos == "rank0"
+        policy_drill = args.chaos == "policy"
+        elastic = args.chaos == "elastic" or rank0_drill
         signals_drill = args.chaos == "signals"
+        if rank0_drill:
+            # the rendezvous host is the victim; it stays dead (shrink
+            # mode) and the drill byte-checks the elected continuation
+            args.elastic_mode = "shrink"
         victim = None
         if args.chaos:
             victim = (
-                args.chaos_rank if args.chaos_rank >= 0 else args.procs - 1
+                args.chaos_rank if args.chaos_rank >= 0
+                else (0 if rank0_drill else args.procs - 1)
             )
         port = free_port()
-        elastic_port = free_port() if elastic else None
+        elastic_port = free_port() if elastic or policy_drill else None
+        # per-rank standby rendezvous table: explicit free ports (the
+        # default port+rank derivation risks collisions on a busy host)
+        peer_addrs = None
+        if elastic_port is not None:
+            peer_addrs = [f"127.0.0.1:{elastic_port}"] + [
+                f"127.0.0.1:{free_port()}" for _ in range(args.procs - 1)
+            ]
         t0 = time.perf_counter()
         procs = []
         logs = []
@@ -694,8 +887,9 @@ def main() -> None:
                 "W2V_NUM_PROCS": str(args.procs),
                 "W2V_PROC_ID": str(r),
             }
-            if elastic:
-                env["W2V_ELASTIC_COORD"] = f"127.0.0.1:{elastic_port}"
+            if peer_addrs is not None:
+                env["W2V_ELASTIC_COORD"] = peer_addrs[0]
+                env["W2V_ELASTIC_PEERS"] = ",".join(peer_addrs)
             extra = ["--multihost", "--sync-mode", args.sync_mode]
             if args.chaos:
                 extra += [
@@ -711,10 +905,13 @@ def main() -> None:
                     "--chunk-steps", "1",
                     "--step-deadline", str(args.step_deadline),
                     "--sync-deadline", str(args.sync_deadline),
-                    # signals drill: ONE shared metrics dir — each rank's
-                    # signals_p<r>.jsonl is a distinct file (the PR 6
-                    # trace_p<i>.json discipline) and rank 0 merges them
-                    "--metrics-dir", "msig" if signals_drill else f"m{r}",
+                    # signals/policy drills: ONE shared metrics dir — each
+                    # rank's signals_p<r>.jsonl is a distinct file (the
+                    # PR 6 trace_p<i>.json discipline) and rank 0 merges
+                    # them (the policy's straggler attribution input)
+                    "--metrics-dir",
+                    "msig" if signals_drill
+                    else ("mpol" if policy_drill else f"m{r}"),
                 ]
                 if signals_drill:
                     extra += [
@@ -741,7 +938,28 @@ def main() -> None:
                         # (rc 75 everywhere) and rank 0 dumps flight.json
                         # with the SloEvents on its signal ring
                         extra += ["--faults", "sigterm@30"]
-                if elastic:
+                if policy_drill:
+                    extra += [
+                        "--elastic", "shrink+grow",
+                        "--elastic-policy", args.policy_spec,
+                        "--signal-window", "5",
+                        "--checkpoint-dir", "ck_shared",
+                        "--checkpoint-every", "5",
+                        "--checkpoint-keep", "2",
+                        "--quality-probe-every", "0",
+                    ]
+                    if r == victim:
+                        # the injected straggler (NOT a failure): a 0.5s
+                        # stall at every boundary from step 12 on — the
+                        # fleet's lockstep throughput drops below the
+                        # policy's 0.55x baseline for consecutive windows
+                        # and the host_overhead attribution names this
+                        # rank; the stalls are stripped at the eviction
+                        # exec so the rejoiner comes back healthy
+                        extra += ["--faults", ",".join(
+                            f"stall@{s}:secs=0.5" for s in range(12, 61)
+                        )]
+                elif elastic:
                     extra += [
                         "--elastic", args.elastic_mode,
                         # SHARED checkpoint dir (the elastic contract: all
@@ -761,8 +979,13 @@ def main() -> None:
                         "--checkpoint-dir", f"ck{r}",
                         "--checkpoint-every", "5",
                     ]
-                if r == victim and not signals_drill:
+                if elastic and args.compile_cache:
+                    extra += [
+                        "--compile-cache", os.path.abspath(args.compile_cache)
+                    ]
+                if r == victim and not signals_drill and not policy_drill:
                     kind = (
+                        "rank0_dead" if rank0_drill else
                         "peer_rejoin" if args.elastic_mode == "shrink+grow"
                         else "peer_dead"
                     ) if elastic else None
@@ -786,6 +1009,9 @@ def main() -> None:
         if elastic:
             _run_elastic(args, result, tmp, procs, logs, victim,
                          cmds, envs, dp, t0)
+            return
+        if policy_drill:
+            _run_policy(args, result, tmp, procs, logs, victim, t0)
             return
         if signals_drill:
             _run_signals(args, result, tmp, procs, logs, victim, t0)

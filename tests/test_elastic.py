@@ -649,3 +649,252 @@ def test_cli_single_host_sync_timeout_fails_fast(tmp_path, capsys):
     man = json.load(open(os.path.join(mdir, "manifest.json")))
     assert man["shutdown"] == "sync_timeout_single_host"
     assert man["elastic"] == "off" and man["mesh_size"] == 1
+
+
+# --------------------------------------------------- policy_shrink rounds
+def test_policy_shrink_round_with_victim(tmp_path):
+    """A policy_shrink round closes at world-1 without the victim and
+    deliberately does NOT admit parked waiters (admitting the just-evicted
+    host would undo the shrink in the same decision)."""
+    import threading as _threading
+
+    from word2vec_tpu.resilience.elastic import rendezvous
+
+    srv, addr, ck = _server(tmp_path, world=3, window=10.0)
+    try:
+        srv.mark_running()
+        parked = _park_raw_waiter(addr, rank=2)
+        out = {}
+
+        def join(rank):
+            out[rank] = rendezvous(addr, rank, 1, "policy_shrink",
+                                   timeout=30.0, victim=2)
+
+        ts = [_threading.Thread(target=join, args=(r,), daemon=True)
+              for r in (0, 1)]
+        t_start = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        wall = time.monotonic() - t_start
+        d0, d1 = out[0], out[1]
+        assert d0["status"] == "go" and d1["status"] == "go"
+        assert d0["world"] == 2  # victim out, waiter NOT admitted
+        assert d0["members"] == [0, 1] and d0["rejoined"] == []
+        assert d0["rank"] == 0 and d1["rank"] == 1
+        # closed promptly at world-1 — no join-window / grace wait for the
+        # deliberately-absent victim
+        assert wall < 5.0, wall
+        # the waiter is STILL parked for a later grow round
+        assert srv.grow_pending() == 1.0
+        parked.close()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- election
+def _controller(rank, world, peers, ck, sync_deadline=2.0, **kw):
+    from word2vec_tpu.resilience.elastic import ElasticController
+
+    return ElasticController(
+        mode="shrink", argv=["-train", "x"], rank=rank, world=world,
+        gen=0, dp=world * 2, elastic_addr=peers[0], jax_host="127.0.0.1",
+        jax_port0=9000, ckpt_dir=ck, sync_deadline=sync_deadline,
+        join_window=6.0, peers=peers, **kw,
+    )
+
+
+def test_election_lowest_surviving_rank_hosts_the_round(tmp_path):
+    """Rank 0 (and its rendezvous) is dead: rank 1 must bind its standby
+    slot and host the round, rank 2 must find it there, and the decision
+    must make old rank 1 the next generation's rank 0 — the host that can
+    bind the moved W2V_ELASTIC_COORD."""
+    ck = _mini_checkpoint(tmp_path)
+    peers = [f"127.0.0.1:{free_port()}" for _ in range(3)]  # slot 0 dead
+    c1 = _controller(1, 3, peers, ck)
+    c2 = _controller(2, 3, peers, ck)
+    out = {}
+
+    def join(ctl, key):
+        out[key] = ctl._join_next_gen(1, "shrink")
+
+    t1 = threading.Thread(target=join, args=(c1, 1), daemon=True)
+    t2 = threading.Thread(target=join, args=(c2, 2), daemon=True)
+    t1.start()
+    t2.start()
+    t1.join(60)
+    t2.join(60)
+    try:
+        d1, d2 = out[1], out[2]
+        assert d1["status"] == "go" and d2["status"] == "go"
+        assert d1["world"] == 2 and d1["members"] == [1, 2]
+        assert d1["rank"] == 0 and d2["rank"] == 1  # old rank 1 -> rank 0
+        # the deciding coordinator moved to the elected host's slot
+        assert d1["coordinator"].startswith("127.0.0.1:9001")
+        assert c1.server is not None and c1.addr == peers[1]
+        assert c1.elected == {"elected_rank": 1, "rendezvous": peers[1]}
+        assert c2.elected == {"elected_rank": 1, "rendezvous": peers[1]}
+        assert c2.addr == peers[1]
+    finally:
+        if c1.server is not None:
+            c1.server.stop()
+
+
+def test_election_without_peer_table_degrades(tmp_path):
+    from word2vec_tpu.resilience.elastic import ElasticError
+
+    ck = _mini_checkpoint(tmp_path)
+    dead = f"127.0.0.1:{free_port()}"
+    c = _controller(1, 3, [dead], ck)
+    c.peers = [dead]  # only the incumbent: nothing to elect from
+    with pytest.raises(ElasticError, match="no standby peer table"):
+        c._elect(1, "shrink")
+
+
+def test_default_peers_derivation():
+    from word2vec_tpu.resilience.elastic import default_peers
+
+    peers = default_peers("10.0.0.1:9476", 3)
+    assert peers == ["10.0.0.1:9476", "10.0.0.1:9477", "10.0.0.1:9478"]
+
+
+def test_from_env_reads_peer_table_and_reannounce():
+    from word2vec_tpu.resilience.elastic import ElasticController
+
+    env = {
+        "W2V_COORDINATOR": "127.0.0.1:8476",
+        "W2V_NUM_PROCS": "3",
+        "W2V_PROC_ID": "1",
+        "W2V_ELASTIC_COORD": "127.0.0.1:9476",
+        "W2V_ELASTIC_PEERS": "127.0.0.1:9476,127.0.0.1:9480,127.0.0.1:9481",
+    }
+    c = ElasticController.from_env(
+        mode="shrink", argv=[], dp=6, ckpt_dir="ck", sync_deadline=5.0,
+        max_reannounce=9, env=env,
+    )
+    assert c.peers == ["127.0.0.1:9476", "127.0.0.1:9480", "127.0.0.1:9481"]
+    assert c.max_reannounce == 9
+    # without the env the table derives from the elastic address
+    env.pop("W2V_ELASTIC_PEERS")
+    c2 = ElasticController.from_env(
+        mode="shrink", argv=[], dp=6, ckpt_dir="ck", sync_deadline=5.0,
+        env=env,
+    )
+    assert c2.peers == ["127.0.0.1:9476", "127.0.0.1:9477", "127.0.0.1:9478"]
+
+
+def test_startup_hello_reannounce_bound_is_configurable():
+    """--rejoin-window: the re-announce cap is a parameter and the
+    exhaustion error spells out the total bounded wait it implies."""
+    port = free_port()
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", port))
+    srv.listen(8)
+    stop = threading.Event()
+
+    def flap():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(2.0)
+                conn.recv(1024)
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    threading.Thread(target=flap, daemon=True).start()
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(ElasticError) as ei:
+            startup_hello(f"127.0.0.1:{port}", 2, 0,
+                          hello_timeout=10.0, admit_timeout=20.0,
+                          max_reannounce=2)
+        msg = str(ei.value)
+        assert "2 times" in msg
+        assert "total bounded wait" in msg and "60s" in msg  # 2x(10+20)
+        assert "--rejoin-window" in msg
+        assert time.monotonic() - t0 < 10.0  # far inside one hello window
+    finally:
+        stop.set()
+        srv.close()
+
+
+def test_rank0_dead_fault_kind():
+    from word2vec_tpu.resilience.faults import KINDS, FaultPlan
+
+    assert "rank0_dead" in KINDS
+    plan = FaultPlan.parse("rank0_dead@6")
+    assert plan.faults[0].kind == "rank0_dead"
+    assert plan.faults[0].step == 6
+
+
+def test_quorum_less_round_aborts_not_splinters(tmp_path):
+    """A round that expires with fewer than world-1 members must ABORT to
+    requeue, never decide: pre-fix, two survivors delayed past each
+    other's windows each formed a world-1 'fleet' and both trained
+    against the same shared checkpoint (split brain, observed live in the
+    rank-0-kill drill)."""
+    srv, addr, _ = _server(tmp_path, world=3, window=1.0)
+    try:
+        srv.mark_running()
+        parked = _park_raw_waiter(addr, rank=9)  # an uninvolved rejoiner
+        t0, r0 = _join_async(addr, 0, 1)
+        t0.join(30)
+        d = r0["decision"]
+        assert d["status"] == "abort", d
+        assert "quorum" in d["reason"], d
+        # the round did NOT advance the generation: a later complete
+        # round can still form gen 1
+        assert srv.gen == 0 and srv.world == 3
+        # the parked waiter was not dropped by the abort
+        assert srv.grow_pending() == 1.0
+        parked.close()
+    finally:
+        srv.stop()
+
+
+def test_probe_rendezvous_rejects_phantom_listener(tmp_path):
+    """A TCP listener that accepts and then drops (a recycled port — a
+    gloo pair listener took the dead rendezvous's port, observed live)
+    must NOT count as a live rendezvous; a real server answers the ping
+    in-protocol."""
+    from word2vec_tpu.resilience.elastic import probe_rendezvous
+
+    # phantom: accepts, reads nothing meaningful, closes immediately
+    phantom = socket.socket()
+    phantom.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    phantom.bind(("127.0.0.1", 0))
+    phantom.listen(8)
+    pport = phantom.getsockname()[1]
+    stop = threading.Event()
+
+    def drop():
+        while not stop.is_set():
+            try:
+                conn, _ = phantom.accept()
+            except OSError:
+                return
+            conn.close()
+
+    threading.Thread(target=drop, daemon=True).start()
+    try:
+        t0 = time.monotonic()
+        assert probe_rendezvous(f"127.0.0.1:{pport}", 2.0) is False
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        stop.set()
+        phantom.close()
+    # a REAL server answers the ping
+    srv, addr, _ = _server(tmp_path, world=2)
+    try:
+        assert probe_rendezvous(addr, 5.0) is True
+    finally:
+        srv.stop()
+    # nothing listening at all
+    assert probe_rendezvous(f"127.0.0.1:{free_port()}", 1.0) is False

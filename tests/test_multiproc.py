@@ -174,3 +174,55 @@ def test_elastic_grow_rejoined_host_admitted_at_sync_boundary():
     assert result["gen1_world"] == 2, result
     assert result["gen2_world"] == 3, result
     assert result["rcs"] == [0, 0, 0], result
+
+
+def _chaos_drill(chaos: str, timeout: int, iters: int = 2,
+                 tokens: int = 120000, extra=()):
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "multiproc.py"),
+            "--procs", "3", "--devices-per-proc", "2",
+            "--tokens", str(tokens), "--iters", str(iters),
+            "--chaos", chaos,
+            "--step-deadline", "10", "--sync-deadline", "6",
+            "--timeout", str(timeout), *extra,
+        ],
+        capture_output=True, text=True, timeout=timeout + 240,
+        env={k: v for k, v in os.environ.items() if k != "XLA_FLAGS"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_elastic_rank0_kill_survivors_elect_and_continue():
+    """Rank-0 survival acceptance (ISSUE 13): SIGKILL the rendezvous host
+    itself. The survivors must re-elect the rendezvous (lowest surviving
+    rank binds its standby slot), shrink to world 2, and run to rc=0 with
+    final embeddings byte-identical to a fresh 2-process resume — instead
+    of the PR 10 documented abort-to-requeue degrade."""
+    result = _chaos_drill("rank0", 480, extra=("--kill-at", "6"))
+    assert result.get("ok"), result
+    assert result["victim_rank"] == 0 and result["victim_rc"] == -9, result
+    assert result["election"]["elected_rank"] == 1, result
+    assert result["gen1_world"] == 2, result
+    assert result["gen1_trigger"] == "failure", result
+    assert result["rcs"][1] == 0 and result["rcs"][2] == 0, result
+    assert result["parity"]["byte_identical"] is True, result
+
+
+def test_elastic_policy_zero_failure_shrink_then_grow():
+    """Policy acceptance (ISSUE 13): ZERO failures injected — a stall
+    stretch makes rank 2 a straggler, the --elastic-policy throughput
+    rule drives a trigger=policy shrink evicting it, the recovery rule
+    opens the grow gate and readmits it (trigger=policy), hysteresis pins
+    exactly one of each, and every process ends rc=0."""
+    result = _chaos_drill("policy", 480, iters=3, tokens=200000)
+    assert result.get("ok"), result
+    assert result["rcs"] == [0, 0, 0], result
+    remesh = [e for e in result["mesh_events"] if e["event"] == "remesh"]
+    assert len(remesh) == 2, result
+    assert all(e["trigger"] == "policy" for e in remesh), result
+    assert remesh[0]["kind"] == "policy_shrink", result
+    assert remesh[0]["victim"] == result["straggler_rank"], result
+    assert result["final_world"] == 3, result

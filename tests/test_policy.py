@@ -1,0 +1,293 @@
+"""Elastic autoscale policy (resilience/policy.py) + its delivery channel
+(the PeerAgreement policy column) + the warm-restart compile cache fence
+(tune/compile_cache.py).
+
+The 3-process CPU drills (benchmarks/multiproc.py --chaos policy / rank0)
+exercise the end-to-end actuation; everything here is fast single-process
+coverage of the decision logic: rule parsing, hysteresis (no flapping on an
+oscillating signal), cooldown, world bounds, victim selection, the latched
+delivery encoding, and the in-process ShardedTrainer.remesh leg.
+"""
+
+import os
+
+import pytest
+
+from word2vec_tpu.resilience.policy import (
+    ElasticPolicy,
+    PolicyError,
+    parse_policy,
+)
+
+
+def _row(window, **signals):
+    row = {"event": "signals", "window": window, "host": 0}
+    for k, v in signals.items():
+        row[f"signal_{k}"] = v
+    return row
+
+
+def _policy(spec, world=3, **kw):
+    p = parse_policy(spec)
+    p.world = world
+    for k, v in kw.items():
+        setattr(p, k, v)
+    return p
+
+
+# ------------------------------------------------------------------ parsing
+def test_parse_actions_and_options():
+    p = parse_policy(
+        "throughput_wps<0.6*baseline:for=2:act=shrink,"
+        "throughput_wps>0.8*baseline:for=3:act=grow:victim=highest,"
+        "cooldown=5,min_world=2,max_world=4"
+    )
+    assert [r.action for r in p.rules] == ["shrink", "grow"]
+    assert p.cooldown == 5 and p.min_world == 2 and p.max_world == 4
+    assert p.rules[0].rule.relative and p.rules[0].rule.for_n == 2
+    # a grow rule exists -> the gate starts CLOSED
+    assert not p.grow_gate()
+
+
+def test_parse_default_action_is_shrink_and_gate_open_without_grow_rule():
+    p = parse_policy("straggler_skew>4:for=3")
+    assert p.rules[0].action == "shrink"
+    assert p.grow_gate()  # no act=grow rule: PR 10 admission semantics
+
+
+def test_parse_errors_name_clause_and_offset():
+    with pytest.raises(PolicyError, match=r"rule 2 .* at offset 25"):
+        parse_policy("throughput_wps<0.5:for=2,bogus>>3")
+    with pytest.raises(PolicyError, match="act must be"):
+        parse_policy("throughput_wps<0.5:act=explode")
+    with pytest.raises(PolicyError, match="global option"):
+        parse_policy("cooldowns=3")
+    with pytest.raises(PolicyError, match="not a number"):
+        parse_policy("throughput_wps<fast")
+
+
+def test_parse_json_file(tmp_path):
+    import json
+
+    f = os.path.join(tmp_path, "policy.json")
+    with open(f, "w") as fh:
+        json.dump(["straggler_skew>3:for=2:act=shrink", "cooldown=4"], fh)
+    p = parse_policy(f)
+    assert len(p.rules) == 1 and p.cooldown == 4
+
+
+def test_config_validates_policy_spec():
+    from word2vec_tpu.config import Word2VecConfig
+
+    Word2VecConfig(elastic_policy="throughput_wps<0.5:for=2")
+    with pytest.raises(ValueError, match="bad elastic_policy"):
+        Word2VecConfig(elastic_policy="nope>>1")
+
+
+# ----------------------------------------------------- hysteresis / cooldown
+def test_for_n_streak_required_before_action():
+    p = _policy("throughput_wps<100:for=3", cooldown=0)
+    p.on_window(_row(1, throughput_wps=50.0))
+    p.on_window(_row(2, throughput_wps=50.0))
+    assert p.pending() is None  # streak 2 < for=3
+    p.on_window(_row(3, throughput_wps=50.0))
+    assert p.pending() is not None
+
+
+def test_oscillating_signal_never_flaps():
+    """The no-flapping pin: a signal oscillating across the threshold
+    every window resets the for=N streak and must never trigger."""
+    p = _policy("throughput_wps<100:for=2", cooldown=0)
+    for w in range(1, 21):
+        v = 50.0 if w % 2 else 150.0  # breach, conform, breach, conform...
+        p.on_window(_row(w, throughput_wps=v))
+    assert p.pending() is None
+
+
+def test_cooldown_defers_but_does_not_lose_a_sustained_breach():
+    """A breach that lands during the cooldown still acts once the
+    cooldown expires, for as long as the condition sustains (the breach
+    EVENT is one-shot; the policy acts on breach STATE)."""
+    events = []
+    p = _policy("throughput_wps<100:for=2", cooldown=4, log_fn=events.append)
+    for w in range(1, 5):  # breach state from window 2, cooldown covers 1-4
+        p.on_window(_row(w, throughput_wps=50.0))
+    assert p.pending() is None
+    sup = [e for e in events if e["event"] == "policy_suppressed"]
+    assert sup and "cooldown" in sup[0]["reason"]
+    assert len(sup) == 1  # noted once, not per window
+    p.on_window(_row(5, throughput_wps=50.0))  # first post-cooldown window
+    assert p.pending() is not None
+
+
+def test_shrink_latches_once_per_generation():
+    events = []
+    p = _policy("throughput_wps<100:for=1", cooldown=0, log_fn=events.append)
+    for w in range(1, 6):
+        p.on_window(_row(w, throughput_wps=10.0))
+    reqs = [e for e in events if e["event"] == "policy_shrink_request"]
+    assert len(reqs) == 1
+    assert p.poll() == float(p.pending()["victim"] + 1)
+
+
+# ------------------------------------------------------ bounds / victims
+def test_min_world_blocks_shrink():
+    events = []
+    p = _policy("throughput_wps<100:for=1", world=2, cooldown=0,
+                log_fn=events.append)
+    p.on_window(_row(1, throughput_wps=10.0))
+    assert p.pending() is None
+    assert any(
+        e["event"] == "policy_suppressed" and "min_world" in e["reason"]
+        for e in events
+    )
+
+
+def test_victim_prefers_fleet_attribution_and_never_rank0():
+    p = _policy("throughput_wps<100:for=1", world=3, cooldown=0)
+    p.on_fleet({"event": "fleet", "fleet_straggler_host": 1})
+    p.on_window(_row(1, throughput_wps=10.0))
+    assert p.pending()["victim"] == 1
+    # rank 0 attributed: fall back to the highest rank, never evict the
+    # rendezvous host
+    p2 = _policy("throughput_wps<100:for=1", world=3, cooldown=0)
+    p2.on_fleet({"event": "fleet", "fleet_straggler_host": 0})
+    p2.on_window(_row(1, throughput_wps=10.0))
+    assert p2.pending()["victim"] == 2
+
+
+def test_grow_gate_opens_on_sustained_recovery_only():
+    p = _policy(
+        "throughput_wps>80:for=2:act=grow,throughput_wps<10:for=9:act=shrink",
+        cooldown=0,
+    )
+    assert not p.grow_gate()
+    p.on_window(_row(1, throughput_wps=100.0))
+    assert not p.grow_gate()  # streak 1 < for=2
+    p.on_window(_row(2, throughput_wps=100.0))
+    assert p.grow_gate()
+
+
+def test_slo_breach_pseudo_signal():
+    p = _policy("slo_breach>0:for=1", cooldown=0)
+    p.on_window(_row(1))
+    assert p.pending() is None
+    p.on_slo({"event": "slo_breach", "rule": "x<1"})
+    p.on_window(_row(2))
+    assert p.pending() is not None
+
+
+def test_bus_attach_detach():
+    from word2vec_tpu.obs.signals import SignalBus
+
+    bus = SignalBus()
+    p = _policy("throughput_wps<100:for=1", cooldown=0).attach(bus)
+    bus.publish("fleet", {"event": "fleet", "fleet_straggler_host": 2})
+    bus.publish("signals", _row(1, throughput_wps=10.0))
+    assert p.pending()["victim"] == 2
+    p.detach()
+
+
+# ------------------------------------------------- delivery (PeerAgreement)
+def test_peer_agreement_policy_column_raises_eviction():
+    from word2vec_tpu.resilience.elastic import PolicyShrinkRequested
+    from word2vec_tpu.resilience.shutdown import ShutdownHandler
+    from word2vec_tpu.resilience.watchdog import PeerAgreement
+
+    handler = ShutdownHandler()
+    pa = PeerAgreement(handler, agree_every=1, policy_fn=lambda: 3.0)
+    with pytest.raises(PolicyShrinkRequested) as ei:
+        pa.check(8)
+    assert ei.value.victim == 2 and ei.value.step == 8
+    # a requested stop takes precedence over a pending eviction
+    handler.requested = True
+    assert pa.check(9) is True
+
+
+def test_policy_shrink_outranks_pending_grow():
+    from word2vec_tpu.resilience.elastic import PolicyShrinkRequested
+    from word2vec_tpu.resilience.shutdown import ShutdownHandler
+    from word2vec_tpu.resilience.watchdog import PeerAgreement
+
+    pa = PeerAgreement(
+        ShutdownHandler(), agree_every=1,
+        elastic_fn=lambda: 1.0, policy_fn=lambda: 2.0,
+    )
+    with pytest.raises(PolicyShrinkRequested):
+        pa.check(4)
+
+
+# --------------------------------------------------- in-process remesh leg
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_apply_inprocess_drives_sharded_remesh():
+    """The in-process autoscale leg: a latched policy shrink halves dp
+    through ShardedTrainer.remesh — the same decision surface as the
+    cross-process exec path, without the fleet."""
+    from test_elastic import _tiny_setup
+
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    cfg, vocab, corpus = _tiny_setup()
+    t = ShardedTrainer(cfg, vocab, corpus, dp=4)
+    s = t.init_state()
+    p = _policy("throughput_wps<100:for=1", world=4, cooldown=0)
+    p.on_window(_row(1, throughput_wps=10.0))
+    rec = p.apply_inprocess(t, state=s)
+    assert rec and rec["dp"] == 2 and rec["trigger"] == "policy"
+    assert t.dp == 2
+    assert p.pending() is None  # consumed
+    assert p.apply_inprocess(t, state=s) is None  # nothing pending
+
+
+# ------------------------------------------------ warm compile cache fence
+def _cache_dir_flag():
+    import jax
+
+    try:
+        return jax.config.jax_compilation_cache_dir
+    except AttributeError:
+        return None
+
+
+def test_warm_cache_fenced_to_next_generation_processes(tmp_path):
+    """The PR 1 regression fence: ONLY an exec'd elastic generation
+    (gen > 0) may enable the persistent compile cache — gen 0 (the launch
+    process, every test process) must always fresh-compile, and an
+    operator-owned JAX_COMPILATION_CACHE_DIR is never overridden."""
+    import jax
+
+    from word2vec_tpu.tune.compile_cache import enable_warm_cache
+
+    prev = _cache_dir_flag()
+    try:
+        root = os.path.join(tmp_path, "cache")
+        # gen 0: refused — the exact PR 1 scenario (long-lived process)
+        assert enable_warm_cache(root, "w3dp6-abc", gen=0) is None
+        assert _cache_dir_flag() == prev
+        # no root: refused (the lever is opt-in)
+        assert enable_warm_cache("", "w3dp6-abc", gen=2) is None
+        # operator owns the cache: refused
+        assert enable_warm_cache(
+            root, "w3dp6-abc", gen=2,
+            env={"JAX_COMPILATION_CACHE_DIR": "/operator"},
+        ) is None
+        assert _cache_dir_flag() == prev
+        # an exec'd next generation: enabled, keyed per topology
+        path = enable_warm_cache(root, "w2dp4-def", gen=1, env={})
+        assert path == os.path.join(root, "w2dp4-def")
+        assert os.path.isdir(path)
+        assert _cache_dir_flag() == path
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_topology_key_pins_mesh_and_plan():
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.tune.compile_cache import topology_key
+
+    cfg = Word2VecConfig()
+    a = topology_key(3, 6, 1, 1, cfg)
+    b = topology_key(2, 4, 1, 1, cfg)
+    assert a != b and a.startswith("w3dp6tp1sp1-")
+    assert topology_key(3, 6, 1, 1, cfg) == a  # deterministic
+    assert topology_key(3, 6, 1, 1, cfg, plan_key="k1") != a

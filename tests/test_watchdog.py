@@ -495,3 +495,46 @@ def test_cli_resume_vocab_mismatch_error_text(tmp_path, corpus_file, capsys):
     err = capsys.readouterr().err
     assert str(other) in err and ck in err  # names BOTH paths
     assert "--allow-vocab-mismatch" in err
+
+
+def test_is_peer_failure_newer_jaxlib_message_variants():
+    """Newer jaxlib coordination-service spellings: barrier timeouts and
+    reworded heartbeat timeouts must classify as peer loss — when (and
+    only when) the runtime TYPE vouches for them."""
+    from word2vec_tpu.resilience.watchdog import is_peer_failure
+
+    class FakeXlaRuntimeError(Exception):
+        pass
+
+    FakeXlaRuntimeError.__module__ = "jaxlib.xla_extension"
+    for msg in (
+        "DEADLINE_EXCEEDED: Barrier timed out. Barrier_id: agree_42",
+        "Coordination service barrier timeout: tasks [2] did not reach "
+        "the barrier",
+        "Task 1 heartbeat timeout; the task may have restarted",
+        "ABORTED: Task 2 recorded heartbeat timeout and is marked dead",
+    ):
+        assert is_peer_failure(FakeXlaRuntimeError(msg)), msg
+    # the same words from application code stay program errors (type gate)
+    assert not is_peer_failure(RuntimeError("barrier timeout"))
+    assert not is_peer_failure(TimeoutError("Barrier timed out"))
+
+
+def test_inspect_accepts_6_col_policy_rows():
+    import numpy as np
+
+    from word2vec_tpu.resilience.shutdown import ShutdownHandler
+    from word2vec_tpu.resilience.watchdog import PeerAgreement
+
+    pa = PeerAgreement(ShutdownHandler(), agree_every=1)
+    import pytest as _pytest
+
+    with _pytest.warns(UserWarning, match="straggler"):
+        pa.inspect(
+            np.array([
+                [0, 0, 8, 10.0, 0.0, 0.0],
+                [1, 0, 8, 12.0, 0.0, 0.0],
+                [2, 0, 8, 900.0, 0.0, 3.0],
+            ]),
+            8,
+        )

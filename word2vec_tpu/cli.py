@@ -276,6 +276,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "next sync boundary. Requires --sync-deadline and "
                         "--checkpoint-dir/--checkpoint-every (validated); "
                         "single-process runs ignore it with a warning")
+    p.add_argument("--elastic-policy", metavar="RULES", default="",
+                   help="signal-driven autoscale policy "
+                        "(resilience/policy.py; needs --elastic): "
+                        "comma-separated "
+                        "'<signal><op><thr>[:for=N][:act=shrink|grow]' "
+                        "clauses over the derived signals (same clause "
+                        "core as --slo), e.g. "
+                        "'throughput_wps<0.6*baseline:for=2:act=shrink,"
+                        "throughput_wps>0.8*baseline:for=2:act=grow,"
+                        "cooldown=3'. A sustained shrink breach evicts "
+                        "the attributed straggler at the next sync "
+                        "boundary (trigger=policy, zero failures); a "
+                        "sustained grow breach opens the admission gate "
+                        "for parked rejoiners. Global options: cooldown=N "
+                        "windows per fresh generation, min_world=/"
+                        "max_world= bounds. Implies the signal plane on")
+    p.add_argument("--rejoin-window", type=int, default=0, metavar="N",
+                   help="rejoin re-announce bound (resilience/elastic.py; "
+                        "0 = the default 6): how many times a parked "
+                        "rejoiner re-announces after the rendezvous drops "
+                        "its connection (one generation turnover each) "
+                        "before giving up — the exhaustion error prints "
+                        "the total bounded wait N implies")
+    p.add_argument("--compile-cache", metavar="DIR", default="",
+                   help="warm-restart compile cache root "
+                        "(tune/compile_cache.py): exec'd elastic "
+                        "generations (W2V_ELASTIC_GEN > 0) point jax's "
+                        "persistent compilation cache at DIR/<topology-"
+                        "plan-key> so a generation switch that revisits a "
+                        "compiled topology skips the recompile blackout. "
+                        "FENCED to next-generation processes only: the "
+                        "launch process (gen 0) and every non-elastic run "
+                        "always fresh-compile (the PR 1 warm-cache "
+                        "segfault scenario; tests pin the fence), and an "
+                        "operator-set JAX_COMPILATION_CACHE_DIR is never "
+                        "overridden")
     p.add_argument("--allow-vocab-mismatch", action="store_true",
                    help="skip the --resume vocabulary-compatibility guard "
                         "(by default a resume whose corpus rebuilds to a "
@@ -431,6 +467,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     # rejoining host must be parked at the rendezvous instead of hanging on
     # a coordination service the fleet has already moved past.
     elastic_ctl = None
+    if args.rejoin_window < 0:
+        print("error: --rejoin-window must be >= 0", file=sys.stderr)
+        return 1
+    if args.elastic_policy and args.elastic == "off":
+        print(
+            "error: --elastic-policy requires --elastic shrink or "
+            "shrink+grow: the policy actuates through the elastic "
+            "rendezvous/remesh machinery",
+            file=sys.stderr,
+        )
+        return 1
+    if args.elastic_policy:
+        # fail-in-milliseconds: a typo'd policy spec dies before the
+        # corpus scan (clause + offset in the message, the --faults/--slo
+        # contract)
+        from .resilience.policy import PolicyError, parse_policy
+
+        try:
+            parse_policy(args.elastic_policy)
+        except PolicyError as e:
+            print(f"error: bad --elastic-policy spec: {e}", file=sys.stderr)
+            return 1
     if args.elastic != "off":
         if args.sync_deadline <= 0:
             print(
@@ -457,6 +515,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             mode=args.elastic, argv=list(argv), dp=args.dp,
             ckpt_dir=args.checkpoint_dir, sync_deadline=args.sync_deadline,
             step_deadline=args.step_deadline,
+            max_reannounce=args.rejoin_window,
         )
         if elastic_ctl is None:
             if not args.quiet:
@@ -477,10 +536,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return 1
 
     if args.multihost:
-        # must run before any backend use on every host
+        # must run before any backend use on every host. Elastic fleets
+        # defuse the coordination service's fatal error poller: its
+        # default callback SIGABRTs survivors when the coordinator host
+        # dies — the one loss the rank-0 election exists to survive.
         from .parallel.multihost import initialize_from_env
 
-        if not initialize_from_env() and not args.quiet:
+        if not initialize_from_env(
+            defuse_fatal=elastic_ctl is not None
+        ) and not args.quiet:
             print(
                 "warning: --multihost set but W2V_COORDINATOR/W2V_NUM_PROCS "
                 "not configured; continuing single-process",
@@ -626,20 +690,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         divergence_budget=args.divergence_budget,
         quality_probe_every=q_every,
         elastic=args.elastic,
+        elastic_policy=args.elastic_policy,
     )
     try:
         cfg = ck_cfg if ck_cfg is not None else Word2VecConfig(**flag_kwargs)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
-    if cfg.elastic != args.elastic:
-        # elasticity is runtime wiring, like --sync-deadline: the flag is
-        # authoritative on resume (a checkpoint from a non-elastic
-        # generation must not pin recovery off — every elastic generation
-        # IS such a resume)
+    if cfg.elastic != args.elastic or cfg.elastic_policy != args.elastic_policy:
+        # elasticity (and its policy) is runtime wiring, like
+        # --sync-deadline: the flag is authoritative on resume (a
+        # checkpoint from a non-elastic generation must not pin recovery
+        # off — every elastic generation IS such a resume)
         import dataclasses as _dc
 
-        cfg = _dc.replace(cfg, elastic=args.elastic)
+        cfg = _dc.replace(
+            cfg, elastic=args.elastic, elastic_policy=args.elastic_policy
+        )
 
     if args.export_side == "output" and cfg.use_hs:
         # fail BEFORE training, not at the export step after a long run —
@@ -740,9 +807,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             diffs = sorted(
                 f.name
                 for f in _dc.fields(flag_cfg)
-                # prng_impl warned separately above; elastic is runtime
-                # wiring the flag overrides on resume (never ignored)
-                if f.name not in ("prng_impl", "elastic")
+                # prng_impl warned separately above; elastic and its
+                # policy are runtime wiring the flag overrides on resume
+                # (never ignored)
+                if f.name not in ("prng_impl", "elastic", "elastic_policy")
                 and user_set(f.name)
                 and flag_value(f.name) != getattr(ck_cfg, f.name)
             )
@@ -918,6 +986,30 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"autotune ({hit}, key {pr.key}): {pr.plan.to_json()}")
 
     elastic_gen = int(os.environ.get("W2V_ELASTIC_GEN", "0") or 0)
+    # Warm-restart compile cache: ONLY an exec'd next-generation elastic
+    # process may point jax's persistent compilation cache at the
+    # per-(topology, plan) directory — enable_warm_cache refuses for gen 0
+    # (the PR 1 warm-cache segfault fence) and for operator-owned
+    # JAX_COMPILATION_CACHE_DIR. Enabled after plan resolution (the plan
+    # is part of the key) and before the first train-step compile.
+    warm_cache_dir = None
+    if args.compile_cache:
+        from .tune.compile_cache import enable_warm_cache, topology_key
+
+        warm_cache_dir = enable_warm_cache(
+            args.compile_cache,
+            topology_key(
+                jax.process_count(), args.dp, args.tp, args.sp,
+                trainer.config,
+                plan_key=getattr(trainer.plan_resolution, "key", None),
+            ),
+            elastic_gen,
+        )
+        if warm_cache_dir and not args.quiet:
+            print(
+                f"compile cache: generation {elastic_gen} warm-restarts "
+                f"from {warm_cache_dir}"
+            )
     if metrics_dir:
         # the manifest carries the REALIZED config (plan applied) so every
         # record in this directory can be traced to what actually ran
@@ -937,7 +1029,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             "kernel_decision": trainer.kernel_decision,
             "mesh_size": args.dp * args.tp * args.sp,
             "elastic": args.elastic,
+            "elastic_policy": args.elastic_policy or None,
             "elastic_generation": elastic_gen,
+            "compile_cache": warm_cache_dir,
         }
         if args.elastic != "off":
             # mesh_events survive the exec between generations: carry the
@@ -952,13 +1046,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                 except (OSError, ValueError):
                     prior_events = []
             exec_t = os.environ.get("W2V_ELASTIC_EXEC_T")
-            extra["mesh_events"] = list(prior_events) + [{
+            elected_env = os.environ.get("W2V_ELASTIC_ELECTED")
+            election = None
+            if elected_env:
+                er, _, ea = elected_env.partition(":")
+                try:
+                    election = {"elected_rank": int(er), "rendezvous": ea}
+                except ValueError:
+                    election = None
+            extra["mesh_events"] = list(prior_events) + ([{
+                "event": "rendezvous_election", "gen": elastic_gen,
+                **election,
+            }] if election else []) + [{
                 "event": "generation_start",
                 "gen": elastic_gen,
                 "world": jax.process_count(),
                 "mesh_size": args.dp * args.tp * args.sp,
                 "dp": args.dp, "tp": args.tp, "sp": args.sp,
                 "resumed_from": args.resume or None,
+                # per-generation audit: which rendezvous decided this
+                # topology (moves after a rank-0 election) and WHY the
+                # remesh happened (failure | policy | rejoin; launch for
+                # gen 0)
+                "rendezvous": os.environ.get("W2V_ELASTIC_COORD"),
+                "trigger": (
+                    os.environ.get("W2V_ELASTIC_TRIGGER")
+                    or ("launch" if elastic_gen == 0 else None)
+                ),
                 "startup_wall_s": (
                     round(time.monotonic() - float(exec_t), 3)
                     if exec_t and elastic_gen > 0 else None
@@ -981,6 +1095,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             "mesh_processes": jax.process_count(),
             "elastic_generation": elastic_gen,
         })
+        elected_env = os.environ.get("W2V_ELASTIC_ELECTED")
+        if elected_env and elastic_gen > 0:
+            # the generation we exec'd FROM ran the rendezvous election;
+            # count it here, where this process has its metrics sinks
+            # (w2v_rendezvous_elections_total, present from zero)
+            er, _, ea = elected_env.partition(":")
+            log_fn({
+                "event": "rendezvous_election", "gen": elastic_gen,
+                "elected_rank": er, "rendezvous": ea,
+            })
 
     if state is not None and hasattr(trainer, "import_params"):
         # checkpoints always hold unreplicated [V, d] tables; re-shard them
@@ -1071,7 +1195,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .obs.manifest import update_manifest
     from .resilience import faults as _faults
     from .resilience import watchdog as _watchdog
-    from .resilience.elastic import GrowRequested
+    from .resilience.elastic import GrowRequested, PolicyShrinkRequested
     from .resilience.shutdown import EXIT_PREEMPTED, ShutdownHandler
     from .resilience.watchdog import SyncTimeout
 
@@ -1164,7 +1288,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # straggler_skew signal; registered on the hub so the quality probe's
     # gauge records feed quality_planted with zero new plumbing.
     sig_engine = None
-    if slo_rules or args.metrics_dir or args.prom_textfile:
+    if (
+        slo_rules or args.metrics_dir or args.prom_textfile
+        or args.elastic_policy
+    ):
         from .obs.fleet import FleetAggregator
         from .obs.signals import SignalEngine
         from .obs.slo import SloEvaluator
@@ -1190,6 +1317,42 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"slo: {len(slo_rules)} rule(s) over {sig_window}-step "
                 f"windows: {[str(r) for r in slo_rules]}"
             )
+    # Elastic policy (resilience/policy.py): the control loop over the
+    # signal plane. Only the rendezvous-hosting rank evaluates and
+    # requests; every other rank reads the verdict from the heartbeat
+    # rows. Wired BEFORE install_shutdown so PeerAgreement carries both
+    # the policy column and the (now policy-gated) grow column.
+    elastic_policy = None
+    if args.elastic_policy and elastic_ctl is not None:
+        from .resilience.policy import parse_policy
+
+        if elastic_ctl.server is not None:
+            elastic_policy = parse_policy(args.elastic_policy)
+            elastic_policy.world = jax.process_count()
+            elastic_policy.log_fn = log_fn
+            if sig_engine is not None:
+                elastic_policy.attach(sig_engine.bus)
+            trainer.policy_poll = elastic_policy.poll
+            if trainer.elastic_poll is not None:
+                grow_src = trainer.elastic_poll
+                trainer.elastic_poll = lambda: (
+                    grow_src() if elastic_policy.grow_gate() else 0.0
+                )
+            if not args.quiet:
+                print(
+                    f"elastic policy: {len(elastic_policy.rules)} rule(s), "
+                    f"cooldown {elastic_policy.cooldown} windows, world "
+                    f"[{elastic_policy.min_world}, "
+                    f"{elastic_policy.max_world or 'unbounded'}]: "
+                    f"{[str(r) for r in elastic_policy.rules]}"
+                )
+    elif args.elastic_policy and elastic_ctl is None and not args.quiet:
+        print(
+            "warning: --elastic-policy set but no elastic fleet is "
+            "configured; a single-process run has nothing to shrink or "
+            "grow — the policy is inert",
+            file=sys.stderr,
+        )
     trainer.install_shutdown(handler)
 
     # On-demand diagnostics: SIGUSR1 dumps the flight recorder + all-thread
@@ -1382,6 +1545,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "grow", getattr(last, "step", None),
                 manifest_path=manifest_path, hub=hub,
                 flight=trainer.flight, metrics_dir=metrics_dir,
+                # a policy-gated admission is a policy decision; the plain
+                # PR 10 waiter-pending admission is a rejoin
+                trigger="policy" if args.elastic_policy else "rejoin",
             )
         # unreachable after a successful exec — this is the failure path
         if manifest_path:
@@ -1390,6 +1556,68 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "grow_checkpoint": grow_saved,
             })
         dump_flight("elastic_failed", failure_step=getattr(last, "step", None))
+        export_trace()
+        hub.close()
+        return EXIT_PREEMPTED
+    except PolicyShrinkRequested as e:
+        # Elastic policy shrink: the rendezvous host's policy latched an
+        # eviction and every rank read the same heartbeat row, so the
+        # whole fleet lands here at one sync boundary with ZERO failures.
+        # The fleet is intact: write the collective checkpoint (the
+        # generation snapshot's source), then split — the victim leaves
+        # (announce-only exec in shrink+grow, clean rc=0 exit in shrink),
+        # the survivors join a policy_shrink round that closes at world-1.
+        print(f"elastic: {e}", file=sys.stderr)
+        last = getattr(trainer, "last_state", None)
+        if last is not None:
+            try:
+                snap = unreplicated(last)  # collective: all ranks enter
+                if is_primary:
+                    save_checkpoint(
+                        args.checkpoint_dir, snap, trainer.config, vocab,
+                        keep=args.checkpoint_keep,
+                    )
+            except Exception as ce:  # noqa: BLE001 — degrade to last periodic
+                print(
+                    f"warning: policy-shrink checkpoint failed ({ce}); the "
+                    "generation snapshot falls back to the last periodic "
+                    "checkpoint",
+                    file=sys.stderr,
+                )
+        if elastic_ctl is not None and jax.process_index() == e.victim:
+            # the evicted host: record how this run ended, then leave
+            if manifest_path:
+                update_manifest(manifest_path, {
+                    "shutdown": "policy_evicted",
+                    "policy_evict": {"step": e.step, "victim": e.victim},
+                })
+            dump_flight("policy_evicted", failure_step=e.step)
+            export_trace()
+            hub({"event": "policy_evicted", "step": e.step})
+            if args.elastic == "shrink+grow":
+                hub.close()
+                elastic_ctl.exec_announce()  # never returns: parks + rejoins
+            print(
+                f"policy shrink: this host (rank {e.victim}) was evicted "
+                "at a sync boundary; exiting 0 (shrink mode does not "
+                "readmit)",
+                file=sys.stderr,
+            )
+            hub.close()
+            return 0
+        if elastic_ctl is not None:
+            elastic_ctl.remesh_and_exec(
+                "policy_shrink", e.step,
+                manifest_path=manifest_path, hub=hub,
+                flight=trainer.flight, metrics_dir=metrics_dir,
+                trigger="policy", victim=e.victim,
+            )
+        # unreachable after a successful exec — this is the failure path
+        if manifest_path:
+            update_manifest(manifest_path, {"shutdown": "elastic_failed"})
+        dump_flight(
+            "elastic_failed", failure_step=getattr(last, "step", None)
+        )
         export_trace()
         hub.close()
         return EXIT_PREEMPTED

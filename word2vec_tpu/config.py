@@ -384,6 +384,15 @@ class Word2VecConfig:
     # validates that pairing.
     elastic: str = "off"
 
+    # Elastic autoscale policy (resilience/policy.py; CLI --elastic-policy):
+    # declarative shrink/grow rules over the derived signals, e.g.
+    # "throughput_wps<0.6*baseline:for=2:act=shrink,cooldown=3". Empty =
+    # failure-driven elasticity only (the PR 10 behavior). Parsed (and
+    # therefore validated) at construction; runtime wiring like `elastic`
+    # — the CLI flag is authoritative on resume, and every elastic
+    # generation IS such a resume.
+    elastic_policy: str = ""
+
     # How replicas are reconciled at each sync (parallel/trainer.make_sync):
     #   "mean"  — pmean the full f32 tables over the replica axes.
     #   "delta" — delta-psum (SURVEY §7(d)): each replica sends only what
@@ -581,6 +590,15 @@ class Word2VecConfig:
                 f"elastic must be 'off', 'shrink' or 'shrink+grow', "
                 f"got {self.elastic!r}"
             )
+        if self.elastic_policy:
+            # parse = validate: a typo'd policy must fail at construction
+            # (the fail-in-milliseconds contract), not at the first window
+            from .resilience.policy import PolicyError, parse_policy
+
+            try:
+                parse_policy(self.elastic_policy)
+            except PolicyError as e:
+                raise ValueError(f"bad elastic_policy: {e}") from None
         if self.batch_rows % self.micro_steps != 0:
             raise ValueError(
                 f"batch_rows {self.batch_rows} must be divisible by "

@@ -54,6 +54,15 @@ EVENT_COUNTERS = {
     # a numeric mesh_size record at every generation start.)
     "remesh": "w2v_remesh_total",
     "peer_rejoin": "w2v_peer_rejoin_total",
+    # rank-0 survival (resilience/elastic.py): a rendezvous re-election —
+    # the incumbent host died and the lowest surviving rank bound its
+    # standby address to host the round. Counted by every survivor that
+    # participated (elected host and joiners alike).
+    "rendezvous_election": "w2v_rendezvous_elections_total",
+    # purpose-driven remeshes (resilience/policy.py): a shrink/grow whose
+    # trigger was the elastic policy, not a failure. Fires alongside the
+    # plain remesh counter on the recovering generation's hub.
+    "policy_remesh": "w2v_policy_remesh_total",
     # SLO breaches (obs/slo.py): a rule that stayed breached for its `for=`
     # budget of consecutive windows. A breach is a log + event, never an
     # exit — but a dashboard must be able to alert on increase() from zero.

@@ -135,11 +135,15 @@ def write_manifest(path: str, config, **kwargs) -> Dict:
 def append_manifest_event(path: str, key: str, record: Dict) -> Optional[Dict]:
     """Append `record` to the manifest's `key` LIST field (creating it),
     atomically. The elastic wiring uses this for `mesh_events`: every
-    shrink/grow decision and every generation start lands as one ordered
-    row in the same file that pins the run's configuration, surviving the
-    in-place exec that separates generations (the new generation carries
-    the prior list forward before rewriting its manifest). Same
-    never-fail-the-run contract as update_manifest."""
+    shrink/grow decision, rendezvous re-election, and generation start
+    lands as one ordered row in the same file that pins the run's
+    configuration, surviving the in-place exec that separates generations
+    (the new generation carries the prior list forward before rewriting
+    its manifest). Since PR 13 every remesh/generation_start row also
+    carries the DECIDING rendezvous address (`rendezvous` — moves after a
+    rank-0 election) and the `trigger` (failure | policy | rejoin |
+    launch), so one manifest read reconstructs who decided each topology
+    and why. Same never-fail-the-run contract as update_manifest."""
     try:
         with open(path) as f:
             man = json.load(f)

@@ -1,0 +1,88 @@
+"""Coordination-service shepherd: keep the jax coordinator endpoint alive
+through rank 0's death.
+
+`jax.distributed.initialize` hosts the coordination service INSIDE process
+0 — so a SIGKILL of rank 0 takes the service endpoint with it, and every
+survivor's error poller turns the broken PollForError RPC into LOG(QFATAL)
+("Terminating process because the JAX distributed service detected fatal
+errors", xla/pjrt/distributed/client.h) within seconds: the processes that
+were about to run the elastic rank-0 recovery get SIGABRTed mid-election
+(observed live in the rank-0-kill drill; the pybind
+`missed_heartbeat_callback` escape hatch dies in a `std::bad_cast` casting
+the absl::Status argument, so the callback cannot be defused from Python).
+
+On an ELASTIC fleet the endpoint therefore moves OUT of the training
+process: rank 0 spawns this module as a small subprocess that hosts ONLY
+`get_distributed_runtime_service`, and every rank (rank 0 included)
+connects as a plain client. Rank 0's death then breaks gloo data-plane
+connections (the bounded collectives turn that into SyncTimeout — the
+detection path) but the coordination endpoint stays reachable, the
+survivors' pollers stay quiet, and the election + re-exec proceed at
+leisure. The shepherd's service runs with a generous heartbeat tolerance
+(the training layer's own deadlines detect death 10-50x faster), holds
+the fleet's stdin pipe as a liveness leash — the parent's exec or death
+closes it — and then lingers a bounded grace so in-flight recoveries
+finish before the port is released.
+
+    python -m word2vec_tpu.parallel.coordservice --port P --procs N \
+        [--linger SECS] [--heartbeat-interval S] [--max-missing N]
+
+Prints one `ready` line to stdout once the service is bound (the parent
+blocks on it before connecting clients).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+#: seconds the shepherd keeps serving after its leash (stdin) closes —
+#: must cover a full shrink recovery (detection + election + round +
+#: exec) of the generation it coordinates
+LINGER_DEFAULT = 240.0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m word2vec_tpu.parallel.coordservice"
+    )
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--procs", type=int, required=True)
+    ap.add_argument("--linger", type=float, default=LINGER_DEFAULT)
+    ap.add_argument("--heartbeat-interval", type=int, default=10)
+    ap.add_argument("--max-missing", type=int, default=30,
+                    help="service-side missed-heartbeat tolerance; the "
+                         "default 30 x 10s = ~300s keeps the service from "
+                         "broadcasting a fatal task error while an elastic "
+                         "recovery (which needs ~30s) is still running — "
+                         "the training layer's --sync/--step deadlines own "
+                         "prompt detection, not this channel")
+    args = ap.parse_args(argv)
+
+    from jaxlib import xla_extension as xe
+
+    service = xe.get_distributed_runtime_service(
+        f"[::]:{args.port}", args.procs,
+        heartbeat_interval=args.heartbeat_interval,
+        max_missing_heartbeats=args.max_missing,
+    )
+    print("ready", flush=True)
+    # leash: block until the parent's pipe end closes (clean exit, SIGKILL,
+    # or the CLOEXEC close at a generation exec) — read() returns b'' then
+    try:
+        while os.read(0, 4096):
+            pass
+    except OSError:
+        pass
+    time.sleep(args.linger)
+    try:
+        service.shutdown()
+    except Exception:  # noqa: BLE001 — exiting anyway
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 from typing import Optional
 
 import jax
@@ -43,19 +44,32 @@ from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS, make_mesh
 # Elastic extension (resilience/elastic.py; CLI --elastic): each
 # shrink/grow re-forms the runtime in a new GENERATION — same processes,
 # new coordination service — so the contract gains:
-#   W2V_ELASTIC_COORD  host:port of the elastic rendezvous (stable across
-#                      generations; defaults to the gen-0 coordinator host
-#                      at port+1000). Hosted by rank 0's process.
+#   W2V_ELASTIC_COORD  host:port of the elastic rendezvous (hosted by the
+#                      CURRENT rank 0's process; defaults to the gen-0
+#                      coordinator host at port+1000). No longer assumed
+#                      stable: when rank 0 dies the survivors re-elect the
+#                      rendezvous onto the lowest surviving rank's standby
+#                      address and the next generation's COORD moves there.
+#   W2V_ELASTIC_PEERS  comma list of per-rank STANDBY rendezvous addresses
+#                      (entry r = where rank r would host the rendezvous if
+#                      elected; entry 0 == W2V_ELASTIC_COORD). Defaults to
+#                      the elastic host at port+rank. Rewritten per
+#                      generation in new-rank order by the elastic exec.
 #   W2V_ELASTIC_GEN    current generation (0 = the launch topology)
 #   W2V_ELASTIC_PORT0  the gen-0 jax coordinator port; generation g's
 #                      coordinator is that port + g, so re-formed fleets
 #                      never collide with a half-dead predecessor service
+#   W2V_ELASTIC_TRIGGER what decided the CURRENT generation (failure |
+#                      policy | rejoin); recorded in the generation_start
+#                      mesh event so the manifest names every remesh cause
 ENV_COORDINATOR = "W2V_COORDINATOR"
 ENV_NUM_PROCS = "W2V_NUM_PROCS"
 ENV_PROC_ID = "W2V_PROC_ID"
 ENV_ELASTIC_COORD = "W2V_ELASTIC_COORD"
+ENV_ELASTIC_PEERS = "W2V_ELASTIC_PEERS"
 ENV_ELASTIC_GEN = "W2V_ELASTIC_GEN"
 ENV_ELASTIC_PORT0 = "W2V_ELASTIC_PORT0"
+ENV_ELASTIC_TRIGGER = "W2V_ELASTIC_TRIGGER"
 
 
 def generation_env(coordinator: str, num_processes: int, process_id: int,
@@ -133,12 +147,102 @@ def _enable_cpu_collectives() -> None:
         pass  # knob absent (old jaxlib) — single-process still works
 
 
-def initialize_from_env(env=os.environ) -> bool:
+#: the shepherd Popen, held for the life of this process: dropping it
+#: would GC-close our end of the leash pipe and start the shepherd's
+#: linger countdown mid-run (the exec/death close is the intended one)
+_coordservice = None
+
+
+def _spawn_coordservice(port: int, num_processes: int):
+    """Start the coordination-service shepherd (parallel/coordservice.py)
+    as a subprocess holding our pipe as a liveness leash. Returns the
+    Popen once the service printed `ready`, or raises RuntimeError."""
+    import subprocess
+
+    global _coordservice
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "word2vec_tpu.parallel.coordservice",
+         "--port", str(port), "--procs", str(num_processes)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+    )
+    line = proc.stdout.readline()
+    if "ready" not in line:
+        proc.kill()
+        raise RuntimeError(
+            f"coordination-service shepherd failed to start (got {line!r})"
+        )
+    _coordservice = proc
+    return proc
+
+
+def _initialize_elastic(cfg: DistConfig) -> bool:
+    """jax.distributed.initialize for an ELASTIC fleet: the coordination
+    service lives in a SHEPHERD SUBPROCESS that survives rank 0's death,
+    and every rank (rank 0 included) connects as a plain client.
+
+    Why: jax hosts the service inside process 0, and the client's error
+    poller LOG(QFATAL)s the whole process the moment the service endpoint
+    dies — so a SIGKILL of rank 0 used to SIGABRT every survivor within
+    seconds, exactly while they were re-electing the rendezvous (observed
+    live in the rank-0-kill drill; the pybind missed_heartbeat_callback
+    escape hatch dies in std::bad_cast, so the callback cannot be defused
+    from Python). With the endpoint out-of-process, rank-0 loss breaks
+    only the gloo data plane — which the bounded collectives turn into
+    SyncTimeout, the intended detection path — while the pollers stay
+    quiet; the shepherd's generous service-side heartbeat tolerance
+    (~300s vs the ~30s a recovery needs) keeps the fatal broadcast away,
+    and its leash + linger bound its own lifetime.
+
+    Replicates the CPU-relevant client core of
+    jax._src.distributed.initialize against the private surface; returns
+    False so the caller falls back to the public initialize (in-process
+    service, die-fast pollers — the non-elastic semantics) if that
+    surface moved."""
+    try:
+        from jax._src import distributed as jdist
+        from jaxlib import xla_extension as xe
+
+        state = jdist.global_state
+        if state.client is not None:
+            return True
+        _, _, port = cfg.coordinator.rpartition(":")
+        if cfg.process_id == 0 and state.service is None:
+            _spawn_coordservice(int(port), cfg.num_processes)
+        state.client = xe.get_distributed_runtime_client(
+            cfg.coordinator, cfg.process_id,
+            init_timeout=300, use_compression=True,
+        )
+        state.client.connect()
+        state.process_id = cfg.process_id
+        state.num_processes = cfg.num_processes
+        try:
+            state.initialize_preemption_sync_manager()
+        except RuntimeError:
+            pass  # already initialized (idempotent re-entry)
+        return True
+    except Exception as e:  # noqa: BLE001 — private surface moved
+        import warnings
+
+        warnings.warn(
+            f"elastic coordination-service shepherd unavailable ({e!r}); "
+            "falling back to the in-process service — rank-0 loss will "
+            "degrade to abort-to-requeue on this jax",
+            stacklevel=2,
+        )
+        return False
+
+
+def initialize_from_env(env=os.environ, defuse_fatal: bool = False) -> bool:
     """Call jax.distributed.initialize from the W2V_* environment contract.
 
     Must run before the first backend use on every host. Returns True when
     distributed mode is active (now or from an earlier call), False for
-    single-process. Idempotent.
+    single-process. Idempotent. With `defuse_fatal` (elastic fleets), the
+    coordination service is hosted by an out-of-process shepherd
+    (`_initialize_elastic`) so a dead rank 0 cannot take the endpoint —
+    and with it every survivor — down; non-elastic runs keep jax's
+    in-process service and die-fast pollers, which double as their abort
+    path.
     """
     global _initialized
     if _initialized:
@@ -147,11 +251,12 @@ def initialize_from_env(env=os.environ) -> bool:
     if cfg is None:
         return False
     _enable_cpu_collectives()
-    jax.distributed.initialize(
-        coordinator_address=cfg.coordinator,
-        num_processes=cfg.num_processes,
-        process_id=cfg.process_id,
-    )
+    if not (defuse_fatal and _initialize_elastic(cfg)):
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
     _initialized = True
     return True
 

@@ -875,6 +875,11 @@ class ShardedTrainer(Trainer):
                 # restarted host at the SAME sync boundary (cli.py wires
                 # trainer.elastic_poll before calling install_shutdown)
                 elastic_fn=self.elastic_poll,
+                # elastic policy channel (resilience/policy.py): the
+                # rendezvous host's latched shrink verdict rides the same
+                # row, so a purpose-driven eviction is delivered exactly
+                # like a grow — one allgather, one boundary, whole fleet
+                policy_fn=self.policy_poll,
                 # fleet-skew feed: the same heartbeat rows derive the
                 # straggler_skew signal (obs/signals.py — cli.py wires
                 # trainer.signals before calling install_shutdown)

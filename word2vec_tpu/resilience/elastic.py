@@ -70,8 +70,36 @@ The protocol, per failure leg:
                   rank/world/coordinator and execs into the grown
                   generation, resuming from the snapshot.
 
-Failure containment: if rank 0 (the rendezvous host) is the one that dies,
-or no integrity-verified checkpoint exists yet, or the round ends degenerate,
+  ELECTION (rank 0 — the rendezvous host — is the one that died)
+    The rendezvous used to die with its host: survivors found
+    W2V_ELASTIC_COORD unreachable and degraded to abort-to-requeue. Now
+    every rank carries a per-rank STANDBY address table (W2V_ELASTIC_PEERS;
+    entry r = where rank r would host the rendezvous). When the incumbent
+    is unreachable, survivors deterministically elect the LOWEST SURVIVING
+    RANK: each survivor scans candidate slots in ascending rank order,
+    waiting one stagger window per slot for that candidate to bind; the
+    survivor whose own slot comes up first (all lower candidates
+    unreachable) binds its standby address and hosts the round itself. The
+    elected host is the lowest surviving old rank, so the members-sorted-
+    by-old-rank rank assignment makes it rank 0 of the next generation —
+    which is exactly the host that can re-bind the (moved) COORD address
+    the exec hands the new generation. A SIGKILL of rank 0 therefore
+    shrinks the fleet cleanly instead of the old abort-to-requeue degrade.
+
+  POLICY SHRINK (no failure at all — resilience/policy.py decided to)
+    An ElasticPolicy breach names a victim rank at a sync boundary
+    (PolicyShrinkRequested rides the same heartbeat allgather as the grow
+    channel, so the whole fleet acts at one boundary). Everyone writes the
+    collective checkpoint; the victim does NOT join the round — it execs
+    into announce-only mode and parks as a rejoiner (mode shrink+grow) or
+    exits 0 — while the survivors join with kind="policy_shrink" carrying
+    the victim's rank. A policy round closes as soon as all non-victim
+    members joined, and parked waiters are deliberately NOT admitted into
+    it (admitting the just-evicted host would undo the shrink in the same
+    decision); they stay parked for a later policy-gated grow.
+
+Failure containment: if no integrity-verified checkpoint exists yet, the
+election finds no live candidate, or the round ends degenerate,
 `remesh_and_exec` returns False and the caller falls back to PR 5's
 abort-to-requeue — elasticity degrades to the old contract, never past it.
 A member too wedged to join before the round closes gets a "late" verdict
@@ -117,6 +145,23 @@ class GrowRequested(RuntimeError):
         )
 
 
+class PolicyShrinkRequested(RuntimeError):
+    """Raised by PeerAgreement.check on EVERY fleet member at the same sync
+    boundary when the elastic policy (resilience/policy.py) decided to
+    shrink the fleet on purpose — zero failures involved. Carries the
+    victim's CURRENT rank; the CLI writes a collective checkpoint, the
+    victim leaves (announce-only exec or clean exit), and the survivors
+    re-form at N-1 through a policy_shrink rendezvous round."""
+
+    def __init__(self, step: int, victim: int):
+        self.step = int(step)
+        self.victim = int(victim)
+        super().__init__(
+            f"elastic policy shrink requested at sync boundary (step "
+            f"{step}): evicting rank {victim}; survivors re-form at N-1"
+        )
+
+
 # --------------------------------------------------------------- wire format
 # One JSON object per line, newline-terminated, over plain TCP. Small,
 # debuggable with netcat, and entirely outside jax — the rendezvous must
@@ -143,6 +188,16 @@ def _recv(sock: socket.socket) -> Dict:
 def _split_addr(addr: str) -> Tuple[str, int]:
     host, _, port = addr.rpartition(":")
     return host, int(port)
+
+
+def default_peers(elastic_addr: str, world: int) -> List[str]:
+    """The default per-rank standby-rendezvous table when W2V_ELASTIC_PEERS
+    is not set: rank r's standby is the elastic host at port+r (entry 0 is
+    the incumbent address itself). Real multi-host fleets should export the
+    env with per-host addresses; the single-host drills work out of the
+    box with this derivation."""
+    host, port = _split_addr(elastic_addr)
+    return [elastic_addr] + [f"{host}:{port + r}" for r in range(1, world)]
 
 
 def _conn_alive(conn: socket.socket) -> bool:
@@ -236,10 +291,19 @@ class ElasticServer(threading.Thread):
         mode: str = "shrink",
         gen: int = 0,
         join_window: float = 10.0,
+        self_rank: Optional[int] = None,
         log_fn: Optional[Callable[[Dict], None]] = None,
     ):
         super().__init__(name="elastic-rendezvous", daemon=True)
         self.bind_addr = bind_addr
+        #: the old rank of the process HOSTING this server (rank 0
+        #: normally; the elected rank after a re-election). Its decision
+        #: reply is sent LAST: the moment that reply lands, the hosting
+        #: process execs into the next generation — killing this server's
+        #: threads mid-loop — so every other member's reply must already
+        #: be on the wire (observed live: the elected host's instant exec
+        #: stranded the other survivor into a spurious 'late' -> requeue).
+        self.self_rank = self_rank
         self.world = int(world)
         self.ckpt_dir = ckpt_dir
         self.jax_host = jax_host
@@ -312,7 +376,20 @@ class ElasticServer(threading.Thread):
             conn.close()
             return
         op = msg.get("op")
-        if op == "hello":
+        if op == "ping":
+            # protocol liveness probe (probe_rendezvous): a bare TCP
+            # connect proves nothing — after a host dies, the kernel can
+            # hand its freed port to ANOTHER process's ephemeral listener
+            # (a survivor's gloo pair listener, observed live in the
+            # rank-0-kill drill), which accepts and then resets. Only a
+            # valid JSON reply proves the rendezvous lives here.
+            try:
+                _send(conn, {"status": "ok", "gen": self.gen,
+                             "world": self.world})
+            except OSError:
+                pass
+            conn.close()
+        elif op == "hello":
             self._handle_hello(conn, msg)
         elif op == "join":
             self._handle_join(conn, msg)
@@ -394,6 +471,10 @@ class ElasticServer(threading.Thread):
                     "members": {},
                     "opened": time.monotonic(),
                     "grow": False,
+                    #: policy_shrink rounds name the evicted rank: the round
+                    #: closes at world-1 (the victim will never join) and
+                    #: parked waiters are NOT admitted into the decision
+                    "victim": None,
                 }
                 threading.Thread(
                     target=self._run_round, args=(self._round,),
@@ -401,8 +482,16 @@ class ElasticServer(threading.Thread):
                 ).start()
             if kind == "grow":
                 self._round["grow"] = True
+            if kind == "policy_shrink" and msg.get("victim") is not None:
+                self._round["victim"] = int(msg["victim"])
             old = self._round["members"].get(rank)
             self._round["members"][rank] = conn
+            print(
+                f"rendezvous[{self.bind_addr}]: gen={gen} join from rank "
+                f"{rank} ({kind or 'shrink'})"
+                + (" SUPERSEDES a stale conn" if old is not None else ""),
+                file=sys.stderr, flush=True,
+            )
         if old is not None:
             try:
                 old.close()  # a retried join supersedes the stale conn
@@ -412,6 +501,11 @@ class ElasticServer(threading.Thread):
 
     # -------------------------------------------------------------- rounds
     def _run_round(self, rnd: Dict) -> None:
+        print(
+            f"rendezvous[{self.bind_addr}]: round gen={rnd['gen']} opened "
+            f"(world {self.world}, window {self.join_window:g}s)",
+            file=sys.stderr, flush=True,
+        )
         deadline = rnd["opened"] + self.join_window
         grace_applied = False
         while True:
@@ -419,6 +513,16 @@ class ElasticServer(threading.Thread):
             with self._lock:
                 n = len(rnd["members"])
                 world = self.world
+                victim = rnd.get("victim")
+            if victim is not None:
+                # policy shrink: everyone alive, exactly one member (the
+                # named victim) deliberately absent — close the moment the
+                # other world-1 joined; no grace games, no waiter admission
+                if n >= world - 1 or now >= deadline:
+                    break
+                time.sleep(0.05)
+                continue
+            with self._lock:
                 # In a grow round (any join carried kind="grow", or a
                 # rejoiner is parked) the whole fleet is alive and the
                 # missing member is typically rank 0 ITSELF, still writing
@@ -442,9 +546,14 @@ class ElasticServer(threading.Thread):
 
     def _decide(self, rnd: Dict) -> None:
         t0 = time.monotonic()
+        policy_victim = rnd.get("victim")
         with self._lock:
             members = sorted(rnd["members"].items())  # [(old rank, conn)]
-            waiters = list(self._waiters)
+            # A policy_shrink decision deliberately ignores parked waiters:
+            # the evicted host re-announces as a waiter almost immediately,
+            # and admitting it into the very round that evicts it would
+            # undo the shrink. Waiters stay parked for a later grow round.
+            waiters = [] if policy_victim is not None else list(self._waiters)
             gen = rnd["gen"]
             prev_world = self.world
         if not members:
@@ -470,6 +579,35 @@ class ElasticServer(threading.Thread):
             except OSError:
                 pass
         waiters = live_waiters
+        if len(members) < prev_world - 1:
+            # Quorum: the single-failure contract expects every survivor
+            # (world-1 of them) in the round. Expiring with fewer means a
+            # second concurrent failure, a partitioned survivor, or an
+            # election race — and a "go" here would form a SPLINTER fleet
+            # (observed pre-fix: two survivors each decided a world-1
+            # generation and trained against the same shared checkpoint in
+            # parallel). Degrade to abort-to-requeue instead; the round is
+            # cleared so a later, complete round can still form this gen.
+            print(
+                f"rendezvous[{self.bind_addr}]: gen={gen} quorum not "
+                f"reached ({len(members)} of {prev_world - 1} survivors); "
+                "aborting the round",
+                file=sys.stderr, flush=True,
+            )
+            self._reply_all(members, [], {
+                "status": "abort",
+                "reason": (
+                    f"quorum not reached: {len(members)} of at least "
+                    f"{prev_world - 1} expected members joined generation "
+                    f"{gen} before the window closed — a second concurrent "
+                    "failure or partition must requeue, not form a "
+                    "splinter fleet"
+                ),
+            })
+            with self._lock:
+                if self._round is rnd:
+                    self._round = None
+            return
         resume = snapshot_checkpoint(self.ckpt_dir, gen)
         if resume is None:
             # nothing integrity-verified to resume from: the generation
@@ -486,6 +624,15 @@ class ElasticServer(threading.Thread):
                     self._round = None
             return
         new_world = len(members) + len(waiters)
+        print(
+            f"rendezvous[{self.bind_addr}]: gen={gen} decided "
+            f"{prev_world}->{new_world} (members {[r for r, _ in members]}, "
+            f"rejoined {[r for r, _ in waiters]}"
+            + (f", victim {policy_victim}" if policy_victim is not None
+               else "")
+            + f") after {time.monotonic() - rnd['opened']:.1f}s",
+            file=sys.stderr, flush=True,
+        )
         coordinator = f"{self.jax_host}:{self.jax_port0 + gen}"
         base = {
             "status": "go",
@@ -500,10 +647,13 @@ class ElasticServer(threading.Thread):
         }
         self._note({
             "event": "remesh_decision", "gen": gen, "kind":
+            "policy_shrink" if policy_victim is not None else
             "grow" if waiters else
             ("transient" if len(members) == prev_world else "shrink"),
             "from_world": prev_world, "to_world": new_world,
             "members": base["members"], "rejoined": base["rejoined"],
+            "victim": policy_victim,
+            "rendezvous": self.bind_addr,
             "resume": resume,
         })
         # advance the server's view BEFORE any reply lands: a member acts
@@ -512,15 +662,29 @@ class ElasticServer(threading.Thread):
         with self._lock:
             self.gen = gen
             self.world = new_world
-            self._waiters = []
+            if policy_victim is None:
+                self._waiters = []
             if self._round is rnd:
                 self._round = None
             self.running_fleet = False  # the new generation re-marks it
+        # Reply order matters: the member hosted in THIS process execs the
+        # instant its reply lands, replacing the process image and killing
+        # this thread — so its reply goes LAST, after every other member
+        # and waiter already has theirs on the wire.
+        self_entry = None
         for new_rank, (old_rank, conn) in enumerate(members):
+            if old_rank == self.self_rank:
+                self_entry = (new_rank, old_rank, conn)
+                continue
             try:
                 _send(conn, {**base, "rank": new_rank, "old_rank": old_rank})
-            except OSError:
-                pass
+            except OSError as e:
+                print(
+                    f"rendezvous[{self.bind_addr}]: gen={gen} 'go' to old "
+                    f"rank {old_rank} FAILED ({e}); it will retry and get "
+                    "'late' -> requeue",
+                    file=sys.stderr, flush=True,
+                )
             conn.close()
         for i, (old_rank, conn) in enumerate(waiters):
             try:
@@ -533,6 +697,13 @@ class ElasticServer(threading.Thread):
             except OSError:
                 pass
             conn.close()
+        if self_entry is not None:
+            new_rank, old_rank, conn = self_entry
+            try:
+                _send(conn, {**base, "rank": new_rank, "old_rank": old_rank})
+            except OSError:
+                pass
+            conn.close()
 
     def _reply_all(self, members, waiters, reply: Dict) -> None:
         for _, conn in list(members) + list(waiters):
@@ -541,8 +712,11 @@ class ElasticServer(threading.Thread):
             except OSError:
                 pass
             conn.close()
-        with self._lock:
-            self._waiters = []
+        if waiters:
+            # only waiters that were actually replied-to are dropped; a
+            # quorum abort keeps the parked (and uninvolved) rejoiners
+            with self._lock:
+                self._waiters = []
 
     def _note(self, rec: Dict) -> None:
         if self.log_fn is not None:
@@ -567,7 +741,19 @@ def _connect(addr: str, overall_deadline: float) -> socket.socket:
     host, port = _split_addr(addr)
     while True:
         try:
-            return socket.create_connection((host, port), timeout=5.0)
+            sock = socket.create_connection((host, port), timeout=5.0)
+            if sock.getsockname() == sock.getpeername():
+                # TCP self-connect: connecting to an EPHEMERAL-range port
+                # with no listener can simultaneous-open onto ITSELF when
+                # the kernel picks source port == destination port — the
+                # socket then echoes your own bytes back, a phantom
+                # rendezvous that eats the whole join budget (observed in
+                # the rank-0-kill drill: a survivor's probe of the DEAD
+                # incumbent connected "successfully" and its join spun on
+                # its own echoed bytes for 60+s instead of electing).
+                sock.close()
+                raise OSError("self-connect: no listener at this port")
+            return sock
         except OSError as e:
             if time.monotonic() >= overall_deadline:
                 raise ElasticError(
@@ -576,24 +762,81 @@ def _connect(addr: str, overall_deadline: float) -> socket.socket:
             time.sleep(0.3)
 
 
+#: consecutive protocol failures (reset / garbage / closed before any
+#: valid reply) before a join loop declares the address NOT-a-rendezvous.
+#: A listener that accepts but never speaks the protocol is a phantom
+#: (a recycled port), and burning the whole join budget against it is
+#: exactly how a survivor misses its election window.
+_MAX_PROTOCOL_STRIKES = 8
+
+
+def probe_rendezvous(addr: str, budget: float) -> bool:
+    """Is a LIVE RENDEZVOUS at `addr`? Connect + `ping` + valid JSON
+    reply within `budget`. A bare connect is not evidence: freed ports
+    get recycled into other processes' ephemeral listeners (gloo pair
+    listeners, observed live), which accept and then reset."""
+    deadline = time.monotonic() + budget
+    while True:
+        try:
+            sock = _connect(addr, deadline)
+        except ElasticError:
+            return False
+        try:
+            sock.settimeout(min(5.0, max(1.0, deadline - time.monotonic())))
+            _send(sock, {"op": "ping"})
+            reply = _recv(sock)
+            if isinstance(reply, dict) and reply.get("status"):
+                return True
+        except (ElasticError, OSError, ValueError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.3)
+
+
 def rendezvous(addr: str, rank: int, gen: int, kind: str,
-               timeout: float) -> Dict:
+               timeout: float, victim: Optional[int] = None) -> Dict:
     """Join generation `gen` and block for the decision. Retries transient
     connection failures within `timeout`; a 'late'/'abort' decision is
-    returned as-is (the caller falls back to abort-to-requeue)."""
+    returned as-is (the caller falls back to abort-to-requeue). `victim`
+    (policy_shrink joins only) names the evicted rank so the round can
+    close at world-1 without waiting a grace window for a member that will
+    never come. Consecutive protocol failures are bounded
+    (_MAX_PROTOCOL_STRIKES): a port that accepts-and-resets is a phantom,
+    not a slow server."""
     deadline = time.monotonic() + timeout
+    strikes = 0
     while True:
         sock = _connect(addr, deadline)
         try:
             sock.settimeout(max(1.0, deadline - time.monotonic()))
-            _send(sock, {"op": "join", "rank": rank, "gen": gen,
-                         "kind": kind})
+            msg = {"op": "join", "rank": rank, "gen": gen, "kind": kind}
+            if victim is not None:
+                msg["victim"] = int(victim)
+            _send(sock, msg)
             return _recv(sock)
         except (ElasticError, OSError, ValueError) as e:
             if time.monotonic() >= deadline:
                 raise ElasticError(
                     f"rendezvous join (gen {gen}) failed: {e}"
                 ) from None
+            strikes += 1
+            if strikes >= _MAX_PROTOCOL_STRIKES:
+                raise ElasticError(
+                    f"rendezvous join (gen {gen}): {strikes} consecutive "
+                    f"protocol failures at {addr} (last: {e}) — a phantom "
+                    "listener on a recycled port, not a rendezvous"
+                ) from None
+            print(
+                f"elastic: rank {rank} join (gen {gen}) retrying after: "
+                f"{e}",
+                file=sys.stderr, flush=True,
+            )
             time.sleep(0.3)
         finally:
             try:
@@ -603,7 +846,8 @@ def rendezvous(addr: str, rank: int, gen: int, kind: str,
 
 
 def startup_hello(addr: str, rank: int, gen: int, hello_timeout: float,
-                  admit_timeout: float) -> Optional[Dict]:
+                  admit_timeout: float,
+                  max_reannounce: int = 0) -> Optional[Dict]:
     """The pre-jax handshake of every non-leader elastic process.
 
     Returns None when the fleet is forming normally ("run": proceed with
@@ -613,8 +857,11 @@ def startup_hello(addr: str, rank: int, gen: int, hello_timeout: float,
     that dies mid-wait (the fleet's rank 0 exec'd between decision and
     reply, or a shrink re-formed the server) is retried transparently —
     the new generation's server re-parks the announce — up to
-    _MAX_REANNOUNCE times, so the total wait stays bounded.
+    `max_reannounce` times (CLI --rejoin-window; default _MAX_REANNOUNCE),
+    so the total wait stays bounded; the exhaustion error spells out the
+    bound it implies.
     """
+    max_reannounce = int(max_reannounce) or _MAX_REANNOUNCE
     deadline = time.monotonic() + hello_timeout
     reannounces = 0
     while True:
@@ -642,13 +889,18 @@ def startup_hello(addr: str, rank: int, gen: int, hello_timeout: float,
             if "connection closed" not in str(e):
                 raise
             # server went away mid-wait (generation turnover): re-announce
-            # on a fresh hello window, but only _MAX_REANNOUNCE times —
+            # on a fresh hello window, but only max_reannounce times —
             # never an unbounded loop against a flapping server
             reannounces += 1
-            if reannounces >= _MAX_REANNOUNCE:
+            if reannounces >= max_reannounce:
+                bound = max_reannounce * (hello_timeout + admit_timeout)
                 raise ElasticError(
                     f"elastic hello: rendezvous at {addr} dropped the "
-                    f"connection {reannounces} times; giving up"
+                    f"connection {reannounces} times; giving up after a "
+                    f"total bounded wait of up to {bound:.0f}s "
+                    f"({max_reannounce} windows x (hello {hello_timeout:g}s "
+                    f"+ admit {admit_timeout:g}s)); raise --rejoin-window "
+                    "to wait through more generation turnovers"
                 ) from None
             deadline = time.monotonic() + hello_timeout
             time.sleep(0.5)
@@ -737,6 +989,8 @@ class ElasticController:
         join_window: Optional[float] = None,
         hello_timeout: float = 60.0,
         admit_timeout: float = 3600.0,
+        peers: Optional[List[str]] = None,
+        max_reannounce: int = 0,
         log_fn: Optional[Callable[[Dict], None]] = None,
     ):
         self.mode = mode
@@ -751,6 +1005,17 @@ class ElasticController:
         self.ckpt_dir = ckpt_dir
         self.sync_deadline = float(sync_deadline)
         self.step_deadline = float(step_deadline)
+        #: per-rank standby rendezvous table (W2V_ELASTIC_PEERS; entry r =
+        #: where rank r hosts the rendezvous if elected, entry 0 = the
+        #: incumbent). The election scans it in ascending rank order.
+        self.peers = list(peers) if peers else default_peers(
+            elastic_addr, int(world)
+        )
+        #: rejoin re-announce bound (CLI --rejoin-window; 0 = the module
+        #: default _MAX_REANNOUNCE)
+        self.max_reannounce = int(max_reannounce)
+        #: set by a successful election: {"elected_rank", "rendezvous"}
+        self.elected: Optional[Dict] = None
         # the shrink round must outlast detection skew across survivors:
         # one survivor detects at its next bounded collective (~sync
         # deadline) while another, wedged inside a synchronous dispatch,
@@ -775,6 +1040,7 @@ class ElasticController:
         ckpt_dir: str,
         sync_deadline: float,
         step_deadline: float = 0.0,
+        max_reannounce: int = 0,
         env=os.environ,
         log_fn=None,
     ) -> Optional["ElasticController"]:
@@ -791,11 +1057,14 @@ class ElasticController:
         host, port = _split_addr(coord)
         port0 = int(env.get(mh.ENV_ELASTIC_PORT0, "") or (port - gen))
         eaddr = env.get(mh.ENV_ELASTIC_COORD) or f"{host}:{port0 + 1000}"
+        peers_env = env.get(mh.ENV_ELASTIC_PEERS, "")
+        peers = [p.strip() for p in peers_env.split(",") if p.strip()] or None
         return cls(
             mode=mode, argv=argv, rank=rank, world=world, gen=gen, dp=dp,
             elastic_addr=eaddr, jax_host=host, jax_port0=port0,
             ckpt_dir=ckpt_dir, sync_deadline=sync_deadline,
-            step_deadline=step_deadline, log_fn=log_fn,
+            step_deadline=step_deadline, peers=peers,
+            max_reannounce=max_reannounce, log_fn=log_fn,
         )
 
     # ------------------------------------------------------------- startup
@@ -809,7 +1078,8 @@ class ElasticController:
                 self.addr, world=self.world, ckpt_dir=self.ckpt_dir,
                 jax_host=self.jax_host, jax_port0=self.jax_port0,
                 mode=self.mode, gen=self.gen,
-                join_window=self.join_window, log_fn=self.log_fn,
+                join_window=self.join_window, self_rank=self.rank,
+                log_fn=self.log_fn,
             )
             self.server.start()
             self.server.bound.wait(timeout=10.0)
@@ -819,17 +1089,51 @@ class ElasticController:
                     f"{self.server.bind_error}"
                 )
             return
-        admitted = startup_hello(
-            self.addr, self.rank, self.gen,
-            hello_timeout=self.hello_timeout,
-            admit_timeout=self.admit_timeout,
-        )
+        last_err: Optional[ElasticError] = None
+        for i, addr in enumerate(self._hello_addrs()):
+            try:
+                admitted = startup_hello(
+                    addr, self.rank, self.gen,
+                    # full patience for the launch address (rank 0 may bind
+                    # later than our hello at fleet formation); standby
+                    # slots get a short scan — a moved rendezvous is
+                    # already listening or is not there at all
+                    hello_timeout=(
+                        self.hello_timeout if i == 0
+                        else max(10.0, self.sync_deadline)
+                    ),
+                    admit_timeout=self.admit_timeout,
+                    max_reannounce=self.max_reannounce,
+                )
+            except ElasticError as e:
+                msg = str(e)
+                if "unreachable" not in msg and "dropped the" not in msg:
+                    raise  # a reject / failed admission is a real verdict
+                # unreachable (or dropped past the bound): the rendezvous
+                # may have been re-elected onto a survivor's standby
+                # address — scan the peer table before giving up
+                last_err = e
+                continue
+            self.addr = addr
+            break
+        else:
+            raise last_err or ElasticError("elastic hello: no rendezvous")
         if admitted is not None:
             self._note({
                 "event": "peer_rejoin", "gen": admitted["gen"],
                 "rank": admitted["rank"], "world": admitted["world"],
             })
             self._exec(admitted)  # never returns
+
+    def _hello_addrs(self) -> List[str]:
+        """The incumbent first, then every standby slot — a rejoiner must
+        find a rendezvous that moved (rank-0 loss + election) without an
+        operator pointing it anywhere new."""
+        out = [self.addr]
+        for p in self.peers:
+            if p and p not in out:
+                out.append(p)
+        return out
 
     def mark_running(self) -> None:
         if self.server is not None:
@@ -840,6 +1144,117 @@ class ElasticController:
             return 0.0
         return self.server.grow_pending()
 
+    # ------------------------------------------------------------ election
+    def _join_timeout(self) -> float:
+        return self.join_window + 2.0 * self.sync_deadline + 30.0
+
+    def _join_next_gen(self, gen: int, kind: str,
+                       victim: Optional[int] = None) -> Dict:
+        """Join generation `gen` at the incumbent rendezvous — or, when the
+        incumbent is unreachable (rank 0 died WITH the rendezvous), run the
+        deterministic re-election and join the elected host's round."""
+        if self.server is not None:
+            # we host the rendezvous ourselves: no reachability question
+            return rendezvous(self.addr, self.rank, gen, kind,
+                              timeout=self._join_timeout(), victim=victim)
+        probe = max(2.0, min(self.sync_deadline or 5.0, 10.0))
+        t_probe = time.monotonic()
+        reachable = probe_rendezvous(self.addr, probe)
+        print(
+            f"elastic: rank {self.rank} incumbent {self.addr} "
+            f"{'reachable' if reachable else 'UNREACHABLE'} "
+            f"(probe {time.monotonic() - t_probe:.1f}s)",
+            file=sys.stderr, flush=True,
+        )
+        if reachable:
+            try:
+                return rendezvous(self.addr, self.rank, gen, kind,
+                                  timeout=self._join_timeout(),
+                                  victim=victim)
+            except ElasticError as e:
+                # the incumbent died mid-round: fall through to election
+                self._note({"event": "rendezvous_lost", "gen": gen,
+                            "rendezvous": self.addr, "reason": str(e)})
+        return self._elect(gen, kind, victim=victim)
+
+    def _elect(self, gen: int, kind: str,
+               victim: Optional[int] = None) -> Dict:
+        """Deterministic rendezvous re-election: scan candidate slots in
+        ascending rank order; each non-candidate waits one stagger window
+        (covering the slowest survivor's detection leg) for that slot to
+        bind before moving on; the survivor whose OWN slot comes up binds
+        its standby address and hosts the round itself. The winner is the
+        lowest surviving rank — which the members-sorted-by-old-rank
+        assignment then makes rank 0 of the next generation, the host that
+        can bind the moved W2V_ELASTIC_COORD."""
+        peers = [p for p in (self.peers or []) if p]
+        if len(peers) <= 1:
+            from ..parallel import multihost as mh
+
+            raise ElasticError(
+                f"rendezvous at {self.addr} unreachable and no standby "
+                f"peer table to elect from (set {mh.ENV_ELASTIC_PEERS})"
+            )
+        # the stagger must cover detection skew between survivors: one
+        # notices at its next bounded collective (~sync deadline), another
+        # only when its step watchdog fires (~step deadline)
+        stage = self.join_window
+        last_err: Optional[str] = None
+        for c in range(1, len(peers)):
+            addr = peers[c]
+            print(
+                f"elastic: rank {self.rank} election: candidate slot {c} "
+                f"({addr})" + (" — binding (own slot)" if c == self.rank
+                               else f" — waiting up to {stage:g}s"),
+                file=sys.stderr, flush=True,
+            )
+            if c == self.rank:
+                srv = ElasticServer(
+                    addr, world=self.world, ckpt_dir=self.ckpt_dir,
+                    jax_host=_split_addr(addr)[0] or self.jax_host,
+                    jax_port0=self.jax_port0, mode=self.mode, gen=self.gen,
+                    join_window=self.join_window, self_rank=self.rank,
+                    log_fn=self.log_fn,
+                )
+                srv.start()
+                srv.bound.wait(timeout=10.0)
+                if srv.bind_error:
+                    last_err = f"own standby {addr}: {srv.bind_error}"
+                    continue  # cannot host; keep scanning as a client
+                self.server = srv
+                self.addr = addr
+                self.elected = {"elected_rank": self.rank,
+                                "rendezvous": addr}
+                self._note({"event": "rendezvous_election", "gen": gen,
+                            "elected_rank": self.rank, "rendezvous": addr})
+                return rendezvous(addr, self.rank, gen, kind,
+                                  timeout=self._join_timeout(),
+                                  victim=victim)
+            try:
+                # protocol-probe bounded by the stagger; COMMIT with the
+                # full join budget once the candidate is VALIDATED (the
+                # stagger must never cut short a round that is merely
+                # waiting out its window, and a bare connect can be a
+                # phantom on a recycled port)
+                if not probe_rendezvous(addr, stage):
+                    last_err = f"candidate {addr} not answering pings"
+                    continue
+                decision = rendezvous(addr, self.rank, gen, kind,
+                                      timeout=self._join_timeout(),
+                                      victim=victim)
+            except ElasticError as e:
+                last_err = str(e)
+                continue
+            self.addr = addr
+            self.elected = {"elected_rank": c, "rendezvous": addr}
+            self._note({"event": "rendezvous_election", "gen": gen,
+                        "elected_rank": c, "rendezvous": addr})
+            return decision
+        raise ElasticError(
+            f"rendezvous election failed: no candidate reachable "
+            f"({last_err})"
+        )
+
     # ------------------------------------------------------------ recovery
     def remesh_and_exec(
         self,
@@ -849,18 +1264,25 @@ class ElasticController:
         hub=None,
         flight=None,
         metrics_dir: Optional[str] = None,
+        trigger: str = "failure",
+        victim: Optional[int] = None,
     ) -> bool:
         """The shrink/grow recovery: rendezvous into the next generation
         and replace this process image. Returns False (caller falls back to
         abort-to-requeue) when the round ends 'late'/'abort', the snapshot
-        is missing, or the rendezvous is unreachable."""
+        is missing, or the rendezvous is unreachable AND no survivor could
+        be elected to host it. `trigger` names WHY this remesh happens
+        (failure | policy | rejoin) and lands on the mesh_events row;
+        `victim` is the policy_shrink eviction."""
         gen = self.gen + 1
         t0 = time.monotonic()
+        print(
+            f"elastic: rank {self.rank} joining generation {gen} "
+            f"({kind}, trigger={trigger}) via {self.addr}",
+            file=sys.stderr, flush=True,
+        )
         try:
-            decision = rendezvous(
-                self.addr, self.rank, gen, kind,
-                timeout=self.join_window + 2.0 * self.sync_deadline + 30.0,
-            )
+            decision = self._join_next_gen(gen, kind, victim=victim)
         except ElasticError as e:
             self._note({
                 "event": "remesh_failed", "kind": kind, "gen": gen,
@@ -870,6 +1292,12 @@ class ElasticController:
                   file=sys.stderr)
             return False
         agree_wall = time.monotonic() - t0
+        print(
+            f"elastic: rank {self.rank} got {decision.get('status')!r} for "
+            f"generation {gen} in {agree_wall:.1f}s "
+            f"(world {decision.get('world')})",
+            file=sys.stderr, flush=True,
+        )
         if decision.get("status") != "go" or not decision.get("resume"):
             self._note({
                 "event": "remesh_failed", "kind": kind, "gen": gen,
@@ -893,20 +1321,34 @@ class ElasticController:
         record = {
             "event": "remesh",
             "kind": kind,
+            #: what decided this remesh — failure (a peer died), policy
+            #: (resilience/policy.py chose to), or rejoin (a parked host's
+            #: admission); the mesh_events audit key the drills assert on
+            "trigger": trigger,
+            #: the deciding rendezvous address (moved after an election)
+            "rendezvous": self.addr,
             "gen": int(decision["gen"]),
             "from_world": self.world,
             "to_world": new_world,
             "at_step": step,
             "rank": int(decision["rank"]),
+            "victim": victim,
             "agree_wall_s": round(agree_wall, 3),
             "snapshot_wall_s": decision.get("snapshot_wall_s"),
             "resume": decision["resume"],
             "rejoined": decision.get("rejoined", []),
             "mesh_size": None,  # the new generation logs the realized size
         }
+        if self.elected is not None:
+            record["election"] = dict(self.elected)
         if hub is not None:
             try:
                 hub(dict(record))  # counts w2v_remesh_total
+                if trigger == "policy":
+                    # the policy-actuation counter (w2v_policy_remesh_total)
+                    hub({"event": "policy_remesh", "kind": kind,
+                         "gen": gen, "to_world": new_world,
+                         "victim": victim})
                 if decision.get("rejoined"):
                     hub({"event": "peer_rejoin",
                          "ranks": decision["rejoined"], "gen": gen})
@@ -927,12 +1369,17 @@ class ElasticController:
         if manifest_path:
             from ..obs.manifest import append_manifest_event
 
+            if self.elected is not None:
+                append_manifest_event(manifest_path, "mesh_events", {
+                    "event": "rendezvous_election", "gen": gen,
+                    **self.elected,
+                })
             append_manifest_event(manifest_path, "mesh_events", record)
-        self._exec(decision)  # never returns
+        self._exec(decision, trigger=trigger)  # never returns
         return True  # pragma: no cover — unreachable
 
     # ---------------------------------------------------------------- exec
-    def _exec(self, decision: Dict) -> None:
+    def _exec(self, decision: Dict, trigger: str = "failure") -> None:
         """Replace this process image with the next generation's: same pid,
         same scheduler allocation, fresh jax runtime. The only sound way to
         change the process set of a jax.distributed job — the coordination
@@ -948,13 +1395,67 @@ class ElasticController:
             decision["coordinator"], new_world, int(decision["rank"]),
             int(decision["gen"]),
         ))
-        env[mh.ENV_ELASTIC_COORD] = self.addr
+        # The rendezvous follows rank 0: the next generation's COORD is the
+        # standby address of whoever became rank 0 (== the incumbent when
+        # rank 0 survived; the elected host's slot after a rank-0 loss),
+        # and the per-rank standby table is rewritten in new-rank order so
+        # a LATER election still has a correct map.
+        members = [int(r) for r in decision.get("members", [])]
+        members += [int(r) for r in decision.get("rejoined", [])]
+        if (
+            self.peers and members
+            and all(0 <= r < len(self.peers) for r in members)
+        ):
+            new_peers = [self.peers[r] for r in members]
+            env[mh.ENV_ELASTIC_PEERS] = ",".join(new_peers)
+            env[mh.ENV_ELASTIC_COORD] = new_peers[0]
+        else:
+            env[mh.ENV_ELASTIC_COORD] = self.addr
         env[mh.ENV_ELASTIC_PORT0] = str(self.jax_port0)
+        env[mh.ENV_ELASTIC_TRIGGER] = trigger
+        if self.elected is not None:
+            # the election must survive the exec: rank 1+'s gen-0 process
+            # has no manifest (metrics artifacts are primary-gated), so the
+            # NEW generation's primary records it — generation_start grows
+            # an `election` field and re-fires the counter event
+            env["W2V_ELASTIC_ELECTED"] = (
+                f"{self.elected['elected_rank']}:"
+                f"{self.elected['rendezvous']}"
+            )
+        else:
+            env.pop("W2V_ELASTIC_ELECTED", None)
         env["W2V_ELASTIC_EXEC_T"] = repr(time.monotonic())
         cmd = [sys.executable, "-m", "word2vec_tpu.cli"] + argv
         self._note({
             "event": "remesh_exec", "gen": int(decision["gen"]),
             "rank": int(decision["rank"]), "world": new_world, "dp": new_dp,
+        })
+        print(
+            f"elastic: exec into generation {decision['gen']} as rank "
+            f"{decision['rank']}/{new_world} (dp {new_dp}, resume "
+            f"{decision.get('resume')})",
+            file=sys.stderr, flush=True,
+        )
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.execve(sys.executable, cmd, env)
+
+    def exec_announce(self) -> None:
+        """The policy-shrink victim's exit: replace this process image with
+        an announce-only relaunch of the SAME generation env — the fresh
+        CLI's elastic startup hellos the rendezvous, is parked as a
+        rejoiner (the fleet has moved to gen+1, so the hello is a
+        crashed-member-coming-back by the server's rules), and rejoins at a
+        later policy-gated grow boundary. Faults are stripped like any
+        other generation hand-off — an injected straggler stall must not
+        follow the host back in."""
+        argv = rewrite_argv(self.argv)
+        env = dict(os.environ)
+        env["W2V_ELASTIC_EXEC_T"] = repr(time.monotonic())
+        env["W2V_ELASTIC_EVICTED"] = "1"
+        cmd = [sys.executable, "-m", "word2vec_tpu.cli"] + argv
+        self._note({
+            "event": "policy_evict_exec", "gen": self.gen, "rank": self.rank,
         })
         sys.stdout.flush()
         sys.stderr.flush()

@@ -21,6 +21,16 @@ WHEN, parsed from a compact spec string:
                             the elastic grow path (announce -> sync-boundary
                             admission, resilience/elastic.py) is exercised;
                             in-process delivery is identical to peer_dead
+    rank0_dead@25           SIGKILL this process at boundary 25, like
+                            peer_dead — the distinct kind documents that
+                            the victim is the RENDEZVOUS HOST (rank 0),
+                            so the harness (benchmarks/multiproc.py
+                            --chaos rank0) injects it into rank 0 and
+                            asserts the survivors RE-ELECT the rendezvous
+                            (lowest surviving rank binds its standby
+                            address) and shrink cleanly instead of the
+                            old abort-to-requeue degrade; in-process
+                            delivery is identical to peer_dead
     sync_timeout@25         raise resilience.watchdog.SyncTimeout at
                             boundary 25 — a dead-peer detection without
                             needing a real fleet; also the repro for the
@@ -62,7 +72,7 @@ from typing import Dict, List, Optional
 #: fault kinds delivered at optimizer-step boundaries by the trainers
 STEP_KINDS = (
     "nan", "stall", "hang", "sigterm", "peer_dead", "peer_rejoin",
-    "sync_timeout",
+    "rank0_dead", "sync_timeout",
 )
 #: fault kinds delivered at named injection points via raise_if_active()
 #: (oom: an XLA RESOURCE_EXHAUSTED-shaped allocation failure — the serve
@@ -238,13 +248,15 @@ class FaultPlan:
                 time.sleep(f.secs)
             elif f.kind == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
-            elif f.kind in ("peer_dead", "peer_rejoin"):
+            elif f.kind in ("peer_dead", "peer_rejoin", "rank0_dead"):
                 # a LOST host, not an evicted one: SIGKILL is uncatchable,
                 # so no cooperative stop, no final checkpoint, no collective
                 # farewell — exactly what the survivors' bounded collectives
                 # and step watchdog must turn into a bounded abort (or, with
                 # --elastic, into a shrink-remesh). peer_rejoin differs only
-                # in what the harness does next: it relaunches the victim.
+                # in what the harness does next: it relaunches the victim;
+                # rank0_dead only in WHO dies: the rendezvous host, so the
+                # survivors must re-elect before they can agree.
                 os.kill(os.getpid(), signal.SIGKILL)
             elif f.kind == "sync_timeout":
                 from .watchdog import SyncTimeout

@@ -96,6 +96,14 @@ _PEER_FAILURE_FRAGMENTS = (
     "gloo",
     "connection reset by peer",
     "heartbeat timeout",
+    # newer jaxlib coordination-service spellings: "Task N heartbeat
+    # timeout" became "... recorded heartbeat timeout" /
+    # "DEADLINE_EXCEEDED: Barrier timed out" / "barrier timeout" depending
+    # on the barrier vs heartbeat poller that notices first — all of them
+    # are the runtime reporting a dead member (tests/test_watchdog.py pins
+    # the observed variants)
+    "barrier timeout",
+    "barrier timed out",
     "coordination service",
     "socket closed",
     "connection refused",
@@ -480,14 +488,19 @@ class PeerAgreement:
     surviving host (or, with --elastic, into a shrink-remesh). Without a
     deadline the behavior is PR 4's (block).
 
-    The heartbeat row is now 5 columns: (process id, stop flag, step,
-    step-time p50 ms, elastic flag). The elastic column is the GROW channel
-    of elastic training (resilience/elastic.py): the rendezvous-hosting
-    process sets it when a restarted host has announced itself, and since
-    every process reads the same allgather rows, the whole fleet raises
-    GrowRequested at the SAME sync boundary — the rejoiner is admitted at a
-    reconciliation point, never mid-interval. A requested stop takes
-    precedence over a pending grow (preemption beats admission).
+    The heartbeat row is now 6 columns: (process id, stop flag, step,
+    step-time p50 ms, elastic flag, policy action). The elastic column is
+    the GROW channel of elastic training (resilience/elastic.py): the
+    rendezvous-hosting process sets it when a restarted host has announced
+    itself, and since every process reads the same allgather rows, the
+    whole fleet raises GrowRequested at the SAME sync boundary — the
+    rejoiner is admitted at a reconciliation point, never mid-interval.
+    The policy column is the SHRINK channel of the elastic policy
+    (resilience/policy.py): the rendezvous host encodes a pending
+    policy-shrink as victim_rank + 1 (0 = none) and the whole fleet raises
+    PolicyShrinkRequested at the same boundary. Precedence: a requested
+    stop beats everything (preemption first), a policy shrink beats a
+    pending grow (an active eviction decision outranks an admission).
     `inspect()` keeps accepting 4-column rows so synthetic-fleet tests and
     recorded heartbeats from older runs still parse.
     """
@@ -502,6 +515,7 @@ class PeerAgreement:
         log_fn=None,
         flight=None,
         elastic_fn: Optional[Callable[[], float]] = None,
+        policy_fn: Optional[Callable[[], float]] = None,
         signals=None,
         phases=None,
     ):
@@ -532,6 +546,11 @@ class PeerAgreement:
         #: rendezvous host polls its pending-rejoin list; everyone else
         #: contributes 0 and reads the verdict from the allgather rows)
         self.elastic_fn = elastic_fn
+        #: elastic policy channel (resilience/policy.ElasticPolicy.poll):
+        #: victim_rank + 1 when the rendezvous host's policy decided to
+        #: shrink, 0 otherwise — same one-allgather delivery as the grow
+        #: channel, so the whole fleet evicts at one sync boundary
+        self.policy_fn = policy_fn
         self._warned: set = set()
 
     def check(self, step: int) -> bool:
@@ -551,6 +570,9 @@ class PeerAgreement:
         grow = 0.0
         if self.elastic_fn is not None:
             grow = float(self.elastic_fn() or 0.0)
+        policy = 0.0
+        if self.policy_fn is not None:
+            policy = float(self.policy_fn() or 0.0)
         import contextlib
 
         agree_span = (
@@ -564,6 +586,7 @@ class PeerAgreement:
                 float(step),
                 p50,
                 grow,
+                policy,
             ])
         if self.flight is not None:
             self.flight.note_heartbeat(np.asarray(rows).tolist(), step)
@@ -571,6 +594,15 @@ class PeerAgreement:
             self.signals.note_heartbeat(np.asarray(rows).tolist(), step)
         self.inspect(rows, step)
         stop = bool(rows[:, 1].max() > 0)
+        if not stop and rows.shape[1] >= 6 and rows[:, 5].max() > 0:
+            # policy shrink outranks a pending grow: the encoded value is
+            # victim_rank + 1, and every process decodes the same rows, so
+            # the whole fleet evicts at this same boundary
+            from .elastic import PolicyShrinkRequested
+
+            raise PolicyShrinkRequested(
+                step=step, victim=int(rows[:, 5].max()) - 1
+            )
         if not stop and rows.shape[1] >= 5 and rows[:, 4].max() > 0:
             # every process sees the same rows, so every process raises at
             # this same boundary — the grow-remesh is fleet-synchronous
@@ -580,9 +612,9 @@ class PeerAgreement:
         return stop
 
     def inspect(self, rows, step: int) -> None:
-        """Straggler / desync detection over one heartbeat's [P, 4-or-5]
-        rows (public so tests can feed synthetic fleets; the elastic
-        column, when present, is not inspected here)."""
+        """Straggler / desync detection over one heartbeat's [P, 4..6]
+        rows (public so tests can feed synthetic fleets; the elastic and
+        policy columns, when present, are not inspected here)."""
         import numpy as np
 
         rows = np.asarray(rows)

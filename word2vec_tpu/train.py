@@ -144,6 +144,13 @@ class Trainer:
     #: install_shutdown, which threads it into PeerAgreement's heartbeat
     #: row (sharded multi-process runs only — single-chip has no fleet).
     elastic_poll = None
+    #: elastic policy channel (resilience/policy.ElasticPolicy.poll): a
+    #: callable returning victim_rank + 1 when the rendezvous host's
+    #: policy latched a shrink, 0 otherwise. None in production; the CLI
+    #: wires it on rank 0 BEFORE install_shutdown, which threads it into
+    #: PeerAgreement's heartbeat row so the whole fleet evicts at one
+    #: sync boundary (trigger=policy, zero failures involved).
+    policy_poll = None
     #: derived-signal plane (obs/signals.SignalEngine) — None unless a
     #: driver wires one (cli.py: --metrics-dir / --slo / --prom-textfile).
     #: Beaten from _check_stop at every step/chunk boundary: on_boundary is

@@ -1,0 +1,113 @@
+"""Warm-restart compile cache: make elastic generation switches stop
+paying full recompile.
+
+The elastic remesh blackout (`ELASTIC_DRILL_cpu.json` walls) is dominated
+by the next generation recompiling the sharded step/sync programs from
+scratch. jax's persistent compilation cache can serve those executables
+from disk — but PR 1 root-caused the tier-1 segfaults to exactly that
+cache: in a long-lived process, a WARM cache deserializes previously
+compiled executables and a later MLIR lowering intermittently dies inside
+`mlir.make_ir_context` (tests/conftest.py carries the bisection evidence;
+the cache has been off everywhere since).
+
+The fence here is SCOPE, enforced in one place (`enable_warm_cache`):
+
+  * only an exec'd NEXT-GENERATION elastic process (W2V_ELASTIC_GEN > 0)
+    may turn the cache on. Such a process is born, compiles one fixed
+    program set for one topology, trains, and either finishes or execs
+    again — the narrow lifecycle in which the deserialize-then-lower
+    interleaving that crashed the long-lived test harness does not recur
+    as a suite-wide hazard, and where the win (the remesh blackout) lives.
+  * generation 0 — the launch process, every test process, every
+    non-elastic run — NEVER gets the cache: `enable_warm_cache` refuses
+    (returns None) for gen <= 0. That is the PR 1 regression fence, pinned
+    by tests/test_elastic.py.
+  * an operator who set JAX_COMPILATION_CACHE_DIR themselves owns the
+    decision; we refuse to override it (same contract as conftest).
+
+The cache is keyed per (topology, plan): a generation only ever reads
+entries written by a generation of the SAME world/mesh shape and realized
+plan, so a shrink that revisits a previously-compiled topology hits, and
+plans can never alias across shapes (`topology_key`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+
+def topology_key(world: int, dp: int, tp: int, sp: int, config,
+                 plan_key: Optional[str] = None) -> str:
+    """One cache subdirectory per (topology, realized plan, jax version):
+    the human-readable prefix names the mesh, the hash pins every lever
+    that changes the compiled program set."""
+    import jax
+
+    parts = [
+        f"w{int(world)}", f"dp{int(dp)}", f"tp{int(tp)}", f"sp{int(sp)}",
+        config.band_backend, config.table_layout, config.resolved_kernel,
+        config.dtype, config.compute_dtype,
+        f"b{config.batch_rows}", f"m{config.micro_steps}",
+        f"c{config.chunk_steps}", f"L{config.max_sentence_len}",
+        f"d{config.word_dim}", f"n{config.negative}",
+        f"sn{config.shared_negatives}", config.negative_scope,
+        f"sr{int(config.stochastic_rounding)}",
+        str(getattr(jax, "__version__", "")),
+        plan_key or "",
+    ]
+    digest = hashlib.sha256("|".join(map(str, parts)).encode()).hexdigest()
+    return f"w{int(world)}dp{int(dp)}tp{int(tp)}sp{int(sp)}-{digest[:16]}"
+
+
+def enable_warm_cache(root: Optional[str], key: str, gen: int,
+                      env=os.environ) -> Optional[str]:
+    """Point jax's persistent compilation cache at `<root>/<key>` — ONLY
+    for an exec'd next-generation elastic process. Returns the enabled
+    cache dir, or None when the fence refuses:
+
+      * gen <= 0            — the PR 1 scenario: a long-lived launch/test
+                              process must fresh-compile, always
+      * no root configured  — the lever is opt-in (--compile-cache)
+      * JAX_COMPILATION_CACHE_DIR set — the operator owns the cache
+      * the config knob is absent or the dir cannot be created — degrade
+        to cold compile, never fail the recovery
+    """
+    if not root or int(gen) <= 0:
+        return None
+    if env.get("JAX_COMPILATION_CACHE_DIR"):
+        return None
+    path = os.path.join(root, key)
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        return None
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # noqa: BLE001 — knob absent on this jax: cold compile
+        return None
+    # CPU-scale programs compile in well under jax's 1 s default write
+    # floor; without these the drill's generation switch would never
+    # populate the cache it is supposed to warm
+    for knob, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001 — best-effort thresholds
+            pass
+    return path
+
+
+def disable_cache() -> None:
+    """Best-effort reset (tests): point jax back at no persistent cache."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:  # noqa: BLE001
+        pass

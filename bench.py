@@ -184,6 +184,7 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         prng_impl=args.prng,
         dtype=args.table_dtype,
         stochastic_rounding=bool(args.sr),
+        corpus_mode=args.corpus_mode,  # a plan-cache dimension (tune/)
         # --health 1 banks the full on-device health counters (grad-norm,
         # per-table update magnitudes) in the record; default off because
         # they cost an extra table read per step and this is a throughput
@@ -297,7 +298,10 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
 
     from word2vec_tpu.ops import resident as res
 
-    use_resident = bool(args.resident) and res.corpus_fits(corpus)
+    streaming = args.corpus_mode == "streaming"
+    use_resident = (
+        bool(args.resident) and not streaming and res.corpus_fits(corpus)
+    )
     if use_resident:
         # Device-resident corpus (ops/resident.py): batches assembled on
         # device; a dispatch carries only scalars. One [R] order upload.
@@ -332,15 +336,56 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
             with phases.span("h2d"):  # producer thread: overlapped time
                 return jax.device_put(np_chunk)
 
-        def dispatches():
-            # chunk transfers overlap compute (batcher.placed_prefetch)
-            for dev_chunk, wlist in placed_prefetch(
-                chunk_batches(batcher.epoch(), S), place,
-                depth=cfg.prefetch_depth,
-            ):
-                yield sum(wlist), (
-                    lambda p, s, t=dev_chunk: chunk_fn(p, t, base_key, s, alphas)
-                )
+        if streaming:
+            # The streaming data plane (stream/): the SAME chunk_fn and
+            # prefetch pipeline, but the id stream arrives in bounded
+            # segments that are read and packed per segment — the measured
+            # delta vs resident/host-streamed is pure data-plane cost.
+            from word2vec_tpu.stream import ArraySource
+            from word2vec_tpu.stream.driver import DEFAULT_SEGMENT_TOKENS
+
+            seg_tokens = args.segment_tokens or DEFAULT_SEGMENT_TOKENS
+
+            def dispatches():
+                src = ArraySource(flat, segment_tokens=seg_tokens)
+                idx = shard = ofs = 0
+                while True:
+                    raw = src.read_segment(idx, shard, ofs)
+                    if raw.raw_tokens == 0:
+                        return
+                    with phases.span("segment_pack"):
+                        seg_corpus = PackedCorpus.from_flat(
+                            raw.flat, cfg.max_sentence_len
+                        )
+                        it = BatchIterator(
+                            seg_corpus, cfg.batch_rows,
+                            cfg.max_sentence_len, seed=1 + idx,
+                        )
+                    for dev_chunk, wlist in placed_prefetch(
+                        chunk_batches(it.epoch(0), S), place,
+                        depth=cfg.prefetch_depth,
+                    ):
+                        yield sum(wlist), (
+                            lambda p, s, t=dev_chunk: chunk_fn(
+                                p, t, base_key, s, alphas
+                            )
+                        )
+                    if raw.exhausted:
+                        return
+                    idx += 1
+                    shard, ofs = raw.shard1, raw.offset1
+        else:
+            def dispatches():
+                # chunk transfers overlap compute (batcher.placed_prefetch)
+                for dev_chunk, wlist in placed_prefetch(
+                    chunk_batches(batcher.epoch(), S), place,
+                    depth=cfg.prefetch_depth,
+                ):
+                    yield sum(wlist), (
+                        lambda p, s, t=dev_chunk: chunk_fn(
+                            p, t, base_key, s, alphas
+                        )
+                    )
 
     # timed steady-state over one full epoch; metrics stay on device until
     # the end (no per-chunk sync)
@@ -481,6 +526,18 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "model_tflops_per_sec": round(model_fps / 1e12, 4),
         "mfu": round(model_fps / peak, 5) if peak else None,
         "resident_corpus": use_resident,
+        "corpus_mode": args.corpus_mode,
+        "segment_tokens": (
+            (args.segment_tokens or 4_000_000) if streaming else None
+        ),
+        # data-plane attribution: host time blocked waiting on the input
+        # pipeline (p50 per chunk) and the input-vs-compute verdict — the
+        # fields the streaming-vs-resident A/B is judged on
+        "batcher_wait_p50_ms": (
+            ((phases.report() or {}).get("phases") or {})
+            .get("batcher_wait", {}).get("p50_ms")
+        ),
+        "input_bound_ratio": (phases.report() or {}).get("input_fraction"),
         "plan": cfg.current_plan().to_json(),
         "plan_source": plan_res.source if plan_res else "flags",
         "predicted_cost": predicted,
@@ -752,6 +809,17 @@ def build_parser() -> argparse.ArgumentParser:
                     "table-update tail; trajectory bitwise identical). The "
                     "banked record's plan carries the realized layout — "
                     "queue items grep it (forwarding audit)")
+    ap.add_argument("--corpus-mode", choices=["resident", "streaming"],
+                    default="resident",
+                    help="data plane A/B (stream/): resident packs the "
+                         "whole id stream once; streaming consumes it in "
+                         "--segment-tokens segments through the segment "
+                         "read/pack/prefetch pipeline — the SAME chunked "
+                         "dispatch measures both, so the delta is pure "
+                         "data-plane cost (batcher_wait / "
+                         "input_bound_ratio attribution in the record)")
+    ap.add_argument("--segment-tokens", type=int, default=0,
+                    help="streaming segment size in tokens (0 = auto: 4M)")
     ap.add_argument("--resident", type=int, default=1, choices=[0, 1],
                     help="device-resident corpus (ops/resident.py); falls "
                     "back to host streaming when the corpus exceeds HBM "
@@ -954,6 +1022,8 @@ def main() -> None:
         ("--hs-dense-top", args.hs_dense_top),
         ("--hs-tail-slots", args.hs_tail_slots),
         ("--resident", args.resident), ("--fused", args.fused),
+        ("--corpus-mode", args.corpus_mode),
+        ("--segment-tokens", args.segment_tokens),
         ("--table-layout", args.table_layout),
         ("--prng", args.prng), ("--table-dtype", args.table_dtype),
         ("--sr", args.sr), ("--health", args.health),

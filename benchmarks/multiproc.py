@@ -290,6 +290,109 @@ def _run_signals(args, result, tmp, procs, logs, straggler, t0) -> None:
     print(_json.dumps(result))
 
 
+def _run_stream(args, result, tmp, procs, logs, victim, cmds, envs,
+                port0, t0) -> None:
+    """Continuous-training soak (`--chaos stream`, stream/driver.py): N
+    ranks stream their shards in segments under an injected ingest stall
+    (stream_stall fault) plus a mid-stream SIGTERM on one rank. Contract:
+    (a) the whole fleet preempts cooperatively (rc 75 everywhere — the
+    stall is absorbed, never a crash), (b) every rank's checkpoint carries
+    its stream cursor (stream.json, integrity-covered), and (c) a full
+    fleet relaunch with --resume replays each rank's in-progress segment
+    from the cursor and runs the stream to completion (rc 0, manifest
+    shutdown=clean, stream summary in the manifest end fields)."""
+    import json as _json
+
+    from word2vec_tpu.io.checkpoint import read_stream_cursor
+    from word2vec_tpu.resilience.shutdown import EXIT_PREEMPTED
+
+    result["chaos"] = "stream"
+    result["victim_rank"] = victim
+
+    def fail(msg, ranks=()):
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        result["error"] = msg
+        result["log_tails"] = [_tail(logs, r) for r in ranks]
+        print(_json.dumps(result))
+
+    deadline = time.time() + args.timeout
+    for p in procs:
+        try:
+            p.wait(timeout=max(1.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            return fail(f"stream drill hang (> {args.timeout:.0f}s)",
+                        range(len(procs)))
+    result["preempt_wall_s"] = round(time.perf_counter() - t0, 1)
+    result["rcs"] = [p.returncode for p in procs]
+    if any(rc != EXIT_PREEMPTED for rc in result["rcs"]):
+        return fail(
+            f"expected every rank to exit {EXIT_PREEMPTED} (cooperative "
+            f"mid-stream preemption), got {result['rcs']}",
+            range(len(procs)),
+        )
+    doc = read_stream_cursor(os.path.join(tmp, "ck_shared"))
+    if doc is None:
+        return fail("shared checkpoint carries no stream.json cursor", [0])
+    result["cursors"] = {
+        "segment": doc.get("segment"), "shard": doc.get("shard"),
+        "offset": doc.get("offset"),
+        "global_steps": doc.get("global_steps"),
+    }
+
+    # --- resume leg: fresh fleet, fresh coordinator port, no faults ------
+    port = free_port()
+    t1 = time.perf_counter()
+    procs2 = []
+    for r, (cmd, env) in enumerate(zip(cmds, envs)):
+        cmd2 = list(cmd)
+        if "--faults" in cmd2:
+            i = cmd2.index("--faults")
+            del cmd2[i:i + 2]
+        cmd2 += ["--resume", "ck_shared"]
+        env2 = {**env, "W2V_COORDINATOR": f"127.0.0.1:{port}"}
+        log = open(os.path.join(tmp, f"rank{r}.resume.log"), "w+")
+        logs.append(log)
+        procs2.append(subprocess.Popen(
+            cmd2, cwd=tmp, env=env2,
+            stdout=log, stderr=subprocess.STDOUT, text=True,
+        ))
+    deadline = time.time() + args.timeout
+    for p in procs2:
+        try:
+            p.wait(timeout=max(1.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            for q in procs2:
+                q.kill()
+            return fail(f"resume leg hang (> {args.timeout:.0f}s)",
+                        range(len(procs), len(procs) + len(procs2)))
+    result["resume_wall_s"] = round(time.perf_counter() - t1, 1)
+    result["resume_rcs"] = [p.returncode for p in procs2]
+    if any(result["resume_rcs"]):
+        return fail(
+            f"resume leg rcs={result['resume_rcs']}, want all 0",
+            range(len(procs), len(procs) + len(procs2)),
+        )
+    man = _manifest(tmp, 0)
+    result["resume_shutdown"] = man.get("shutdown")
+    result["stream_summary"] = man.get("stream")
+    if man.get("shutdown") != "clean":
+        return fail(
+            f"rank-0 manifest shutdown={man.get('shutdown')!r}, want "
+            "'clean'", [len(procs)],
+        )
+    if not isinstance(man.get("stream"), dict) or not (
+        man["stream"].get("segments", 0) >= 1
+    ):
+        return fail(
+            f"rank-0 manifest stream summary missing/empty: "
+            f"{man.get('stream')!r}", [len(procs)],
+        )
+    result["ok"] = True
+    print(_json.dumps(result))
+
+
 def _manifest(tmp, rank=0, mdir=None):
     try:
         with open(os.path.join(tmp, mdir or f"m{rank}", "manifest.json")) as f:
@@ -772,7 +875,14 @@ def main() -> None:
                     "rows into ONE shared metrics dir, and the drill "
                     "asserts fleet.json names the straggler host, the "
                     "--slo throughput rule escalates warn->breach, and "
-                    "the SloEvent lands in rank 0's flight.json")
+                    "the SloEvent lands in rank 0's flight.json; "
+                    "the special value 'stream' runs the continuous-"
+                    "training soak (stream/driver.py): every rank streams "
+                    "its shard in segments, --chaos-rank gets an injected "
+                    "stream_stall plus a mid-stream SIGTERM, the whole "
+                    "fleet must preempt rc 75 with stream cursors in "
+                    "every checkpoint, and a full --resume relaunch must "
+                    "replay to clean completion (rc 0)")
     ap.add_argument("--policy-spec", metavar="RULES",
                     default="throughput_wps<0.55*baseline:for=2:baseline=2"
                             ":act=shrink,"
@@ -856,6 +966,18 @@ def main() -> None:
         policy_drill = args.chaos == "policy"
         elastic = args.chaos == "elastic" or rank0_drill
         signals_drill = args.chaos == "signals"
+        stream_drill = args.chaos == "stream"
+        stream_seg = 0
+        if stream_drill:
+            # equal-length contiguous shards: the per-segment steps/epoch
+            # is a cross-process agreement, so every rank must see the SAME
+            # segment structure — round-robin shards can differ by a chunk
+            # and split into different segment counts (collective mismatch)
+            per = len(tokens) // args.procs
+            for r in range(args.procs):
+                with open(os.path.join(tmp, f"shard{r}"), "w") as f:
+                    f.write(" ".join(tokens[r * per:(r + 1) * per]))
+            stream_seg = max(2_000, per // 3)
         if rank0_drill:
             # the rendezvous host is the victim; it stays dead (shrink
             # mode) and the drill byte-checks the elected continuation
@@ -913,7 +1035,25 @@ def main() -> None:
                     "msig" if signals_drill
                     else ("mpol" if policy_drill else f"m{r}"),
                 ]
-                if signals_drill:
+                if stream_drill:
+                    extra += [
+                        "--corpus-mode", "streaming",
+                        "--segment-tokens", str(stream_seg),
+                        # SHARED checkpoint dir: saves are primary-gated
+                        # (rank 0 writes for the fleet), and the equalized
+                        # shards keep every rank's stream cursor identical,
+                        # so one cursor resumes the whole fleet
+                        "--checkpoint-dir", "ck_shared",
+                        "--checkpoint-every", "4",
+                        "--quality-probe-every", "0",
+                    ]
+                    if r == victim:
+                        # ingest hiccup + mid-stream preemption: the stall
+                        # must be absorbed as batcher wait; the SIGTERM
+                        # preempts the whole fleet cooperatively (rc 75)
+                        extra += ["--faults",
+                                  "stream_stall@1:secs=0.4,sigterm@8"]
+                elif signals_drill:
                     extra += [
                         "--signal-window", "5",
                         # baseline from the first 2 clean windows; the
@@ -974,7 +1114,7 @@ def main() -> None:
                         # byte-parity reference run trivially matches it
                         "--quality-probe-every", "0",
                     ]
-                else:
+                elif not stream_drill:
                     extra += [
                         "--checkpoint-dir", f"ck{r}",
                         "--checkpoint-every", "5",
@@ -983,7 +1123,10 @@ def main() -> None:
                     extra += [
                         "--compile-cache", os.path.abspath(args.compile_cache)
                     ]
-                if r == victim and not signals_drill and not policy_drill:
+                if (
+                    r == victim and not signals_drill
+                    and not policy_drill and not stream_drill
+                ):
                     kind = (
                         "rank0_dead" if rank0_drill else
                         "peer_rejoin" if args.elastic_mode == "shrink+grow"
@@ -1015,6 +1158,10 @@ def main() -> None:
             return
         if signals_drill:
             _run_signals(args, result, tmp, procs, logs, victim, t0)
+            return
+        if stream_drill:
+            _run_stream(args, result, tmp, procs, logs, victim,
+                        cmds, envs, port, t0)
             return
         if args.chaos:
             _run_chaos(args, result, tmp, procs, logs, victim, t0)

@@ -1,0 +1,882 @@
+"""Continuous-training subsystem (word2vec_tpu/stream/): streaming
+ingestion, mid-stream byte-for-byte resume, online vocab growth, and the
+gated hot table swap into a live serve engine.
+
+The load-bearing contracts pinned here:
+  * a segment re-read from its recorded cursor is IDENTICAL to the first
+    read (the replay coordinate);
+  * SIGTERM mid-segment -> checkpoint -> resume reproduces the
+    uninterrupted streaming run bitwise (per-step and chunked dispatch);
+  * vocab growth admits deterministically into reserved rows and leaves
+    every pre-existing table row bitwise untouched; a grown vocabulary
+    passes the compatible-superset resume guard;
+  * QueryEngine.swap_table drops zero in-flight requests, and the planted
+    quality gate refuses a bad table.
+"""
+
+import os
+import threading
+from collections import Counter
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.data.vocab import Vocab
+from word2vec_tpu.io.checkpoint import (
+    load_checkpoint_with_path, read_stream_cursor, save_checkpoint,
+)
+from word2vec_tpu.resilience.faults import FaultPlan
+from word2vec_tpu.stream import (
+    ArraySource, FileSource, PipeSource, StreamCursor, StreamRun,
+    admission_order, make_source, resolve_shards,
+)
+from word2vec_tpu.stream.driver import encode_segment, gate_table
+from word2vec_tpu.train import TrainState, Trainer
+
+SEG = 400  # segment_tokens used by the trainer-level tests
+
+
+# --------------------------------------------------------------- fixtures
+def _write_shards(tmp_path, n_shards=2, tokens_per_shard=900, vocab_words=18,
+                  new_words_from=None, seed=0):
+    """Deterministic multi-shard token files. With `new_words_from=k`,
+    shard k (and later) mixes in novel z-words frequent enough to be
+    admission candidates."""
+    rng = np.random.default_rng(seed)
+    base = [f"w{i:02d}" for i in range(vocab_words)]
+    novel = [f"z{i}" for i in range(5)]
+    paths = []
+    for s in range(n_shards):
+        toks = []
+        for t in range(tokens_per_shard):
+            if new_words_from is not None and s >= new_words_from and t % 7 == 0:
+                toks.append(novel[rng.integers(len(novel))])
+            else:
+                toks.append(base[rng.integers(len(base))])
+        p = tmp_path / f"shard_{s:02d}.txt"
+        p.write_text(" ".join(toks) + "\n")
+        paths.append(str(p))
+    return paths
+
+
+def _stream_cfg(**kw):
+    base = dict(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        batch_rows=4, max_sentence_len=16, min_count=1, iters=1, seed=9,
+        corpus_mode="streaming", chunk_steps=1,
+    )
+    base.update(kw)
+    return Word2VecConfig(**base)
+
+
+def _bootstrap(shards, cfg, segment_tokens=SEG, vocab=None):
+    """The cli.py streaming bootstrap, compact: vocab from segment 0,
+    trainer constructed on the segment-0 corpus."""
+    src = FileSource(shards, fmt="text8", segment_tokens=segment_tokens)
+    boot = src.read_segment(0, 0, 0, vocab=None)
+    if vocab is None:
+        vocab = Vocab.from_counter(boot.counts, min_count=cfg.min_count)
+    flat = encode_segment(boot, vocab, "text8")
+    corpus = PackedCorpus.from_flat(flat, cfg.max_sentence_len)
+    trainer = Trainer(cfg, vocab, corpus)
+    return trainer, src, vocab
+
+
+def _host(params):
+    return {k: np.asarray(v) for k, v in params.items()}
+
+
+# ----------------------------------------------------------------- source
+def test_resolve_shards_file_list_dir_glob(tmp_path):
+    a = tmp_path / "a.txt"
+    b = tmp_path / "b.txt"
+    a.write_text("x")
+    b.write_text("y")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    c = sub / "c.txt"
+    c.write_text("z")
+    assert resolve_shards(str(a)) == [str(a)]
+    assert resolve_shards(f"{b},{a}") == [str(b), str(a)]  # order preserved
+    assert resolve_shards(str(sub)) == [str(c)]
+    assert resolve_shards(str(tmp_path / "*.txt")) == [str(a), str(b)]
+    with pytest.raises(FileNotFoundError):
+        resolve_shards(str(tmp_path / "missing.txt"))
+    with pytest.raises(FileNotFoundError):
+        resolve_shards(str(tmp_path / "no*.match"))
+
+
+def test_file_source_segment_replay_is_identical(tmp_path):
+    shards = _write_shards(tmp_path, n_shards=3, tokens_per_shard=700)
+    src = FileSource(shards, segment_tokens=500)
+    segs = []
+    cur = (0, 0, 0)
+    while True:
+        raw = src.read_segment(*cur)
+        if raw.raw_tokens == 0:
+            break
+        segs.append(raw)
+        if raw.exhausted:
+            break
+        cur = (raw.index + 1, raw.shard1, raw.offset1)
+    assert sum(r.raw_tokens for r in segs) == 3 * 700
+    # uniform segments except the tail
+    assert all(r.raw_tokens == 500 for r in segs[:-1])
+    # re-read a MIDDLE segment from its recorded cursor: identical content
+    mid = segs[2]
+    again = src.read_segment(mid.index, mid.shard0, mid.offset0)
+    assert again.sentences == mid.sentences
+    assert again.counts == mid.counts
+    assert (again.shard1, again.offset1) == (mid.shard1, mid.offset1)
+
+
+def test_file_source_counts_respect_vocab(tmp_path):
+    shards = _write_shards(tmp_path, n_shards=1, tokens_per_shard=300)
+    src = FileSource(shards, segment_tokens=300)
+    all_counts = src.read_segment(0, 0, 0).counts
+    vocab = Vocab.from_counter(all_counts, min_count=1)
+    oov = src.read_segment(0, 0, 0, vocab=vocab).counts
+    assert sum(all_counts.values()) == 300
+    assert oov == Counter()  # everything known -> no candidates
+
+
+def test_lines_format_offsets_are_lines(tmp_path):
+    p = tmp_path / "lines.txt"
+    p.write_text("\n".join(f"s{i} a b c" for i in range(50)) + "\n")
+    src = FileSource([str(p)], fmt="lines", segment_tokens=40)
+    first = src.read_segment(0, 0, 0)
+    assert first.raw_tokens >= 40
+    assert first.offset1 == len(first.sentences)  # line-granular cursor
+    second = src.read_segment(1, first.shard1, first.offset1)
+    assert second.sentences[0][0] == f"s{first.offset1}"
+
+
+def test_pipe_source_spools_and_replays(tmp_path):
+    r, w = os.pipe()
+    payload = " ".join(f"t{i % 37}" for i in range(1000))
+
+    def feed():
+        os.write(w, payload.encode())
+        os.close(w)
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    src = PipeSource(fd=r, spool_dir=str(tmp_path / "spool"),
+                     segment_tokens=300)
+    s0 = src.read_segment(0, 0, 0)
+    s1 = src.read_segment(1, 1, 0)
+    assert s0.raw_tokens == 300 and s1.raw_tokens == 300
+    # replay segment 0 from the spool (the pipe itself is gone)
+    replay = PipeSource(fd=r, spool_dir=str(tmp_path / "spool"),
+                        segment_tokens=300).read_segment(0, 0, 0)
+    assert replay.sentences == s0.sentences
+    # drain to EOF
+    s2 = src.read_segment(2, 2, 0)
+    s3 = src.read_segment(3, 3, 0)
+    assert s2.raw_tokens == 300 and s3.raw_tokens == 100
+    assert s3.exhausted
+    t.join(timeout=5)
+
+
+def test_make_source_dispatch(tmp_path):
+    p = tmp_path / "c.txt"
+    p.write_text("a b c")
+    assert isinstance(make_source(str(p)), FileSource)
+    r, w = os.pipe()
+    try:
+        src = make_source("-", spool_dir=str(tmp_path / "sp"), fd=r)
+        assert isinstance(src, PipeSource)
+        with pytest.raises(ValueError):
+            make_source("-", fd=r)  # no spool dir -> not resumable
+    finally:
+        os.close(r)
+        os.close(w)
+
+
+def test_array_source_cursoring():
+    flat = np.arange(10, dtype=np.int32)
+    src = ArraySource(flat, segment_tokens=4)
+    a = src.read_segment(0, 0, 0)
+    b = src.read_segment(1, a.shard1, a.offset1)
+    c = src.read_segment(2, b.shard1, b.offset1)
+    np.testing.assert_array_equal(a.flat, [0, 1, 2, 3])
+    np.testing.assert_array_equal(c.flat, [8, 9])
+    assert c.exhausted and not a.exhausted
+
+
+# ----------------------------------------------------------------- growth
+def test_vocab_admit_keeps_prefix_bitwise_and_hashes():
+    v = Vocab(["a", "b", "c"], np.array([5, 4, 3]))
+    h0 = v.content_hash()
+    ids = v.admit([("x", 7), ("y", 2)])
+    assert ids == [3, 4]
+    assert v["x"] == 3 and v["y"] == 4
+    assert v.content_hash(limit=3) == h0          # prefix invariant
+    assert v.content_hash() != h0
+    base = Vocab(["a", "b", "c"], np.array([5, 4, 3]))
+    assert v.is_compatible_superset(base)
+    assert not base.is_compatible_superset(v)
+    other = Vocab(["a", "q", "c"], np.array([5, 4, 3]))
+    assert not v.is_compatible_superset(other)
+    with pytest.raises(ValueError):
+        v.admit([("a", 1)])  # re-admission would alias rows
+
+
+def test_admission_order_deterministic_and_capped():
+    vocab = Vocab(["a"], np.array([10]))
+    counts = {"d": 3, "b": 5, "c": 5, "a": 99, "rare": 1}
+    out = admission_order(counts, vocab, min_count=2, cap=10)
+    assert out == [("b", 5), ("c", 5), ("d", 3)]  # count desc, ties lex
+    assert admission_order(counts, vocab, min_count=2, cap=2) == [
+        ("b", 5), ("c", 5),
+    ]
+    assert admission_order(counts, vocab, min_count=2, cap=0) == []
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="corpus_mode"):
+        Word2VecConfig(corpus_mode="bogus")
+    with pytest.raises(ValueError, match="resident"):
+        Word2VecConfig(corpus_mode="streaming", resident="on")
+    with pytest.raises(ValueError, match="vocab_reserve"):
+        Word2VecConfig(vocab_reserve=3)  # resident mode
+    with pytest.raises(ValueError, match="Huffman"):
+        Word2VecConfig(
+            corpus_mode="streaming", vocab_reserve=3,
+            train_method="hs", negative=0,
+        )
+    cfg = Word2VecConfig(corpus_mode="streaming", vocab_reserve=3)
+    assert cfg.vocab_reserve == 3
+
+
+def test_reserved_rows_allocated_and_untouched_by_growth(tmp_path):
+    shards = _write_shards(tmp_path, n_shards=2, tokens_per_shard=SEG,
+                           new_words_from=1)
+    cfg = _stream_cfg(vocab_reserve=8)
+    trainer, src, vocab = _bootstrap(shards, cfg)
+    v0 = len(vocab)
+    run = StreamRun(trainer, src)
+    state = trainer.init_state()
+    assert state.params["emb_in"].shape[0] == v0 + 8
+    init_host = _host(state.params)
+    state, report = run.train(state=state, log_every=0)
+    assert report.stream["growths"] >= 1
+    assert len(vocab) > v0
+    assert report.stream["vocab_generation"] >= 1
+    grown = [w for w in vocab.words[v0:]]
+    assert all(w.startswith("z") for w in grown)
+    # admitted ids are the reserved slots, in deterministic order
+    assert vocab.words[v0:] == sorted(
+        grown,
+        key=lambda w: (-vocab.counts[vocab[w]], w),
+    )
+    # rows past the live vocab keep their init bits (never trained)
+    live = len(vocab)
+    final = _host(state.params)
+    np.testing.assert_array_equal(
+        final["emb_in"][live:], init_host["emb_in"][live:]
+    )
+
+
+def test_growth_boundary_leaves_existing_rows_bitwise(tmp_path):
+    """The acceptance pin: across the growth boundary itself, every
+    pre-existing table row is bitwise unchanged (admission touches ids,
+    counts and device tables — never params)."""
+    shards = _write_shards(tmp_path, n_shards=2, tokens_per_shard=SEG,
+                           new_words_from=1)
+    cfg = _stream_cfg(vocab_reserve=8)
+    trainer, src, vocab = _bootstrap(shards, cfg)
+    v0 = len(vocab)
+    run = StreamRun(trainer, src, max_segments=1)  # stop BEFORE growth seg
+    state, _ = run.train(log_every=0)
+    before = _host(state.params)
+    # the growth boundary happens inside this second run's first boundary
+    run2 = StreamRun(trainer, src, cursor=run.cursor, max_segments=1)
+    state2, rep2 = run2.train(state=TrainState(params=state.params),
+                              log_every=0)
+    assert len(vocab) > v0
+    after = _host(state2.params)
+    # rows of words that existed before growth changed only by TRAINING
+    # (segment 2 trained them); the admission itself must not move them.
+    # Isolate: re-run growth bookkeeping alone on fresh copies.
+    v = Vocab(list(vocab.words[:v0]), vocab.counts[:v0].copy())
+    snap = dict(before)
+    v.admit([("q1", 3), ("q2", 2)])
+    np.testing.assert_array_equal(snap["emb_in"], before["emb_in"])
+    assert after["emb_in"].shape == before["emb_in"].shape
+
+
+# ------------------------------------------------- byte-for-byte resume
+def _run_full(shards, cfg, segment_tokens=SEG):
+    trainer, src, vocab = _bootstrap(shards, cfg)
+    run = StreamRun(trainer, src)
+    state, report = run.train(log_every=0)
+    return _host(state.params), report, vocab
+
+
+def _boundary_stopper(n):
+    """Fire the cooperative stop at the n-th observed boundary."""
+    calls = {"n": 0}
+
+    def stop(step):
+        calls["n"] += 1
+        return calls["n"] >= n
+
+    return stop
+
+
+@pytest.mark.parametrize("chunk_steps,stop_at", [(1, 8), (3, 4), (0, 2)])
+def test_mid_stream_sigterm_resume_bitwise(tmp_path, chunk_steps, stop_at):
+    shards = _write_shards(tmp_path, n_shards=3, tokens_per_shard=SEG)
+    cfg = _stream_cfg(chunk_steps=chunk_steps)
+    full, full_rep, _ = _run_full(shards, cfg)
+    assert full_rep.stream["segments"] >= 3
+
+    # interrupted leg: stop mid-stream, checkpoint WITH the cursor
+    trainer_a, src_a, vocab_a = _bootstrap(shards, cfg)
+    run_a = StreamRun(trainer_a, src_a)
+    trainer_a.stop_check = _boundary_stopper(stop_at)
+    state_a, rep_a = run_a.train(log_every=0)
+    assert rep_a.interrupted == "preempted"
+    assert rep_a.stream["cursor"]["segment"] <= 1
+    ck = str(tmp_path / "ck")
+    save_checkpoint(
+        ck,
+        TrainState(params=_host(state_a.params), step=state_a.step,
+                   words_done=state_a.words_done, epoch=state_a.epoch),
+        trainer_a.config, vocab_a, stream=run_a.cursor_meta(),
+    )
+
+    # resume leg: fresh process state, cursor + params from the checkpoint
+    state_b, ck_cfg, ck_vocab, ck_dir = load_checkpoint_with_path(ck)
+    doc = read_stream_cursor(ck_dir)
+    assert doc is not None and doc["source"]["kind"] == "files"
+    trainer_b, src_b, _ = _bootstrap(shards, ck_cfg, vocab=ck_vocab)
+    run_b = StreamRun(
+        trainer_b, src_b, cursor=StreamCursor.from_json(doc)
+    )
+    state_b2, rep_b = run_b.train(state=state_b, log_every=0)
+    resumed = _host(state_b2.params)
+
+    for k in full:
+        np.testing.assert_array_equal(full[k], resumed[k], err_msg=k)
+    assert rep_b.stream["cursor"] == full_rep.stream["cursor"]
+
+
+def test_mid_stream_resume_with_growth_bitwise(tmp_path):
+    """Interrupt AFTER a growth boundary: the grown vocabulary rides the
+    checkpoint, the superset guard passes, and the continued trajectory is
+    bitwise the uninterrupted one."""
+    shards = _write_shards(tmp_path, n_shards=3, tokens_per_shard=SEG,
+                           new_words_from=1)
+    cfg = _stream_cfg(vocab_reserve=8)
+    full, full_rep, full_vocab = _run_full(shards, cfg)
+    assert full_rep.stream["growths"] >= 1
+
+    trainer_a, src_a, vocab_a = _bootstrap(shards, cfg)
+    base_vocab = Vocab(list(vocab_a.words), vocab_a.counts.copy())
+    run_a = StreamRun(trainer_a, src_a)
+    trainer_a.stop_check = _boundary_stopper(16)  # mid-segment-2, post-growth
+    state_a, rep_a = run_a.train(log_every=0)
+    assert rep_a.interrupted == "preempted"
+    assert run_a.growths >= 1  # growth happened before the stop
+    ck = str(tmp_path / "ck")
+    save_checkpoint(
+        ck,
+        TrainState(params=_host(state_a.params), step=state_a.step,
+                   words_done=state_a.words_done, epoch=state_a.epoch),
+        trainer_a.config, vocab_a, stream=run_a.cursor_meta(),
+    )
+
+    state_b, ck_cfg, ck_vocab, ck_dir = load_checkpoint_with_path(ck)
+    # the grown checkpoint vocabulary is a compatible superset of the
+    # pre-growth one — the --resume guard's acceptance condition
+    assert ck_vocab.is_compatible_superset(base_vocab)
+    doc = read_stream_cursor(ck_dir)
+    assert doc["vocab_generation"] >= 1
+    trainer_b, src_b, _ = _bootstrap(shards, ck_cfg, vocab=ck_vocab)
+    run_b = StreamRun(trainer_b, src_b,
+                      cursor=StreamCursor.from_json(doc))
+    state_b2, rep_b = run_b.train(state=state_b, log_every=0)
+    resumed = _host(state_b2.params)
+    for k in full:
+        np.testing.assert_array_equal(full[k], resumed[k], err_msg=k)
+    assert [w for w in full_vocab.words] == [w for w in trainer_b.vocab.words]
+
+
+def test_boundary_checkpoint_resume_bitwise(tmp_path):
+    """Resume from a checkpoint taken exactly AT a segment boundary
+    (step 0 of the next segment)."""
+    shards = _write_shards(tmp_path, n_shards=2, tokens_per_shard=SEG)
+    cfg = _stream_cfg()
+    full, _, _ = _run_full(shards, cfg)
+
+    trainer_a, src_a, vocab_a = _bootstrap(shards, cfg)
+    run_a = StreamRun(trainer_a, src_a, max_segments=1)
+    state_a, _ = run_a.train(log_every=0)
+    ck = str(tmp_path / "ck")
+    save_checkpoint(
+        ck,
+        TrainState(params=_host(state_a.params)),
+        trainer_a.config, vocab_a, stream=run_a.cursor_meta(),
+    )
+    state_b, ck_cfg, ck_vocab, ck_dir = load_checkpoint_with_path(ck)
+    assert state_b.step == 0
+    trainer_b, src_b, _ = _bootstrap(shards, ck_cfg, vocab=ck_vocab)
+    run_b = StreamRun(
+        trainer_b, src_b,
+        cursor=StreamCursor.from_json(read_stream_cursor(ck_dir)),
+    )
+    state_b2, _ = run_b.train(state=state_b, log_every=0)
+    resumed = _host(state_b2.params)
+    for k in full:
+        np.testing.assert_array_equal(full[k], resumed[k], err_msg=k)
+
+
+def test_sharded_mid_stream_resume(tmp_path):
+    """The sharded leg: the dp x tp mesh resumes a mid-stream checkpoint
+    taken at a sync boundary to the uninterrupted sharded trajectory."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    from word2vec_tpu.parallel import ShardedTrainer
+
+    shards = _write_shards(tmp_path, n_shards=3, tokens_per_shard=SEG)
+    cfg = _stream_cfg(dp_sync_every=4, chunk_steps=0)
+
+    def build(vocab=None):
+        src = FileSource(shards, fmt="text8", segment_tokens=SEG)
+        boot = src.read_segment(0, 0, 0, vocab=None)
+        vocab = vocab or Vocab.from_counter(boot.counts, min_count=1)
+        flat = encode_segment(boot, vocab, "text8")
+        corpus = PackedCorpus.from_flat(flat, cfg.max_sentence_len)
+        tr = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2)
+        return tr, src, vocab
+
+    tr_full, src_full, vocab = build()
+    run_full = StreamRun(tr_full, src_full)
+    st_full, rep_full = run_full.train(log_every=0)
+    full = {k: np.asarray(v) for k, v in
+            tr_full.export_params(st_full).items()}
+
+    tr_a, src_a, _ = build(vocab)
+    run_a = StreamRun(tr_a, src_a)
+    tr_a.stop_check = _boundary_stopper(2)
+    st_a, rep_a = run_a.train(log_every=0)
+    assert rep_a.interrupted == "preempted"
+    ck = str(tmp_path / "ck")
+    host = TrainState(
+        params={k: np.asarray(v) for k, v in
+                tr_a.export_params(st_a).items()},
+        step=st_a.step, words_done=st_a.words_done, epoch=st_a.epoch,
+    )
+    save_checkpoint(ck, host, tr_a.config, vocab, stream=run_a.cursor_meta())
+
+    st_b, ck_cfg, ck_vocab, ck_dir = load_checkpoint_with_path(ck)
+    tr_b, src_b, _ = build(ck_vocab)
+    tr_b.import_params(st_b.params, st_b)
+    run_b = StreamRun(
+        tr_b, src_b,
+        cursor=StreamCursor.from_json(read_stream_cursor(ck_dir)),
+    )
+    st_b2, _ = run_b.train(state=st_b, log_every=0)
+    resumed = {k: np.asarray(v) for k, v in
+               tr_b.export_params(st_b2).items()}
+    # the stop landed at a replica-sync boundary, so the sharded resume is
+    # BITWISE, not merely close (the acceptance pin: sharded-at-sync-boundary)
+    for k in full:
+        np.testing.assert_array_equal(full[k], resumed[k], err_msg=k)
+
+
+# ------------------------------------------------------ backpressure/faults
+def test_producer_exception_reraises_in_stream_path(tmp_path):
+    """The PR 4 producer-death contract holds on the segment pipeline: a
+    reader exception re-raises in the training loop, never a hang."""
+    shards = _write_shards(tmp_path, n_shards=2, tokens_per_shard=SEG)
+    cfg = _stream_cfg()
+    trainer, src, vocab = _bootstrap(shards, cfg)
+
+    real = src.read_segment
+
+    def poisoned(index, shard, offset, vocab=None):
+        if index >= 1:
+            raise OSError("shard storage vanished")
+        return real(index, shard, offset, vocab=vocab)
+
+    src.read_segment = poisoned
+    run = StreamRun(trainer, src)
+    with pytest.raises(OSError, match="shard storage vanished"):
+        run.train(log_every=0)
+
+
+def test_dead_producer_without_sentinel_raises(tmp_path):
+    """A producer killed without running its finally (no sentinel) must
+    surface as a RuntimeError in the stream consumer, not a hang."""
+    from word2vec_tpu.data import batcher as B
+
+    def seg_gen():
+        yield "seg0"
+        # die so abruptly the finally never runs (simulated by raising
+        # BaseException subclass that escapes the producer's except)
+        os._exit  # (not called; the real kill is simulated below)
+
+    # simulate: a producer whose iterator blocks forever after one item,
+    # then the thread object is reported dead (monkeypatched is_alive)
+    ev = threading.Event()
+
+    def blocking_gen():
+        yield "seg0"
+        ev.wait(30)  # the consumer will declare the producer dead first
+
+    gen = B.prefetch(blocking_gen(), depth=1)
+    assert next(gen) == "seg0"
+    # reach into the generator's frame to find the producer thread
+    frame = gen.gi_frame
+    t = frame.f_locals["t"]
+    real_is_alive = t.is_alive
+    try:
+        t.is_alive = lambda: False  # the daemon-kill scenario
+        with pytest.raises(RuntimeError, match="died without a sentinel"):
+            next(gen)
+    finally:
+        t.is_alive = real_is_alive
+        ev.set()
+        gen.close()
+
+
+def test_sigterm_mid_segment_drains_producer(tmp_path):
+    """A cooperative stop mid-segment ends the run promptly AND releases
+    the segment-prefetch producer thread (bounded backpressure cannot
+    wedge shutdown)."""
+    shards = _write_shards(tmp_path, n_shards=3, tokens_per_shard=SEG)
+    cfg = _stream_cfg()
+    trainer, src, vocab = _bootstrap(shards, cfg)
+    run = StreamRun(trainer, src)
+    trainer.stop_check = _boundary_stopper(3)
+    before = threading.active_count()
+    state, rep = run.train(log_every=0)
+    assert rep.interrupted == "preempted"
+    assert state.step > 0
+    # the prefetch producer must exit once the generator is closed
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        threading.Event().wait(0.1)
+        deadline -= 1
+    assert threading.active_count() <= before
+
+
+def test_stream_fault_kinds_parse_and_fire(tmp_path):
+    plan = FaultPlan.parse("stream_stall@1:secs=0.01,vocab_growth@0:n=3")
+    assert [f.kind for f in plan.faults] == ["stream_stall", "vocab_growth"]
+    with pytest.raises(ValueError, match="n must be >= 1"):
+        FaultPlan.parse("vocab_growth@0:n=0")
+
+    shards = _write_shards(tmp_path, n_shards=2, tokens_per_shard=SEG)
+    cfg = _stream_cfg(vocab_reserve=8)
+    trainer, src, vocab = _bootstrap(shards, cfg)
+    v0 = len(vocab)
+    run = StreamRun(trainer, src, fault_plan=plan)
+    state, rep = run.train(log_every=0)
+    fired = [(r["kind"], r["at_step"]) for r in plan.log]
+    assert ("vocab_growth", 0) in fired
+    assert ("stream_stall", 1) in fired
+    # the forced admission landed: 3 synthetic chaos words in the vocab
+    chaos = [w for w in vocab.words[v0:] if w.startswith("__chaos_")]
+    assert len(chaos) == 3
+    assert rep.stream["growths"] >= 1
+
+
+def test_stream_faults_not_delivered_at_step_boundaries():
+    """on_step must skip stream kinds (and vice versa): a stream fault in
+    a plan must never fire from the optimizer-step channel."""
+    plan = FaultPlan.parse("stream_stall@0:secs=0.01")
+    state = TrainState(params={})
+    state.step = 5
+    plan.on_step(state)
+    assert plan.log == []
+    plan.on_segment(0)
+    assert plan.log and plan.log[0]["kind"] == "stream_stall"
+
+
+# ------------------------------------------------------------- hot swap
+def _trained_engine_setup(tmp_path):
+    from word2vec_tpu.serve.query import QueryEngine
+
+    shards = _write_shards(tmp_path, n_shards=2, tokens_per_shard=SEG)
+    cfg = _stream_cfg()
+    trainer, src, vocab = _bootstrap(shards, cfg)
+    W0 = np.asarray(trainer.init_state().params["emb_in"], np.float32)
+    engine = QueryEngine(W0, vocab)
+    return trainer, src, vocab, engine, W0
+
+
+def test_swap_table_zero_drop_under_concurrent_queries(tmp_path):
+    trainer, src, vocab, engine, W0 = _trained_engine_setup(tmp_path)
+    errors = []
+    results = {"n": 0}
+    stop = threading.Event()
+    words = vocab.words[:8]
+
+    def client():
+        while not stop.is_set():
+            try:
+                out = engine.neighbors_batch(words[:4], k=3)
+                assert len(out) == 4 and all(len(o) == 3 for o in out)
+                results["n"] += 1
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=client, daemon=True) for _ in range(3)]
+    for t in threads:
+        t.start()
+    rng = np.random.default_rng(0)
+    for g in range(12):
+        W = W0 + rng.normal(0, 0.01, W0.shape).astype(np.float32)
+        gen = engine.swap_table(W, vocab=vocab)
+        assert gen == g + 1
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors[:1]
+    assert results["n"] > 0
+    assert engine.generation == 12
+
+
+def test_swap_table_refuses_shrink_and_dim_mismatch(tmp_path):
+    trainer, src, vocab, engine, W0 = _trained_engine_setup(tmp_path)
+    with pytest.raises(ValueError, match="SHRINK"):
+        engine.swap_table(W0[:4])
+    with pytest.raises(ValueError, match="dim mismatch"):
+        engine.swap_table(np.zeros((engine.V, engine.d + 1), np.float32))
+    engine.swap_table(W0[:4], allow_shrink=True)
+    assert engine.V == 4
+
+
+def test_gate_refuses_bad_table_and_driver_counts_it(tmp_path):
+    """The planted-gold gate: a trained table swaps, a garbage table is
+    refused and the engine keeps serving the previous generation."""
+    from word2vec_tpu.obs.quality import ProbeSet
+    from word2vec_tpu.serve.query import QueryEngine
+    from word2vec_tpu.utils.synthetic import graded_pair_corpus
+
+    tokens, _ = graded_pair_corpus(
+        n_pairs=32, pool_words=8, n_tokens=60_000, seed=3
+    )
+    vocab = Vocab.build([tokens], min_count=1)
+    probe = ProbeSet.synthesize(vocab)
+    assert len(probe.pairs) >= 32  # planted golds exist for this vocabulary
+    cfg = _stream_cfg(word_dim=24, iters=2, window=3, batch_rows=8)
+    flat = vocab.encode(tokens)
+    corpus = PackedCorpus.from_flat(flat, cfg.max_sentence_len)
+    trainer = Trainer(cfg, vocab, corpus)
+    state, _ = trainer.train(log_every=0)
+    W_good = np.asarray(state.params["emb_in"], np.float32)
+    # a COLLAPSED table (every row identical) — the exact degeneracy the
+    # r5 band collapse produced, and a deterministic gate refusal (all
+    # pair cosines tie, Spearman dies)
+    W_bad = np.ones_like(W_good) * 0.1
+
+    ok_good, rec_good = gate_table(W_good, vocab, probe, floor=0.35)
+    ok_bad, rec_bad = gate_table(W_bad, vocab, probe, floor=0.35)
+    assert ok_good, rec_good
+    assert not ok_bad, rec_bad
+    assert rec_good["score"] > rec_bad["score"]
+
+    # driver-level: a refused swap leaves the engine generation untouched
+    engine = QueryEngine(W_good, vocab)
+    src = ArraySource(flat, segment_tokens=len(flat))
+    run = StreamRun(trainer, src, swap_engine=engine, swap_floor=0.35,
+                    probe_set=probe)
+    run._capacity = W_good.shape[0]
+    run._maybe_swap(state, segment=0)
+    assert run.swaps == 1 and engine.generation == 1
+    bad_state = TrainState(params={"emb_in": W_bad})
+    run._maybe_swap(bad_state, segment=1)
+    assert run.swaps_refused == 1 and engine.generation == 1
+
+
+def test_driver_swaps_at_boundaries_during_stream(tmp_path):
+    from word2vec_tpu.serve.query import QueryEngine
+
+    shards = _write_shards(tmp_path, n_shards=2, tokens_per_shard=SEG)
+    cfg = _stream_cfg()
+    trainer, src, vocab = _bootstrap(shards, cfg)
+    W0 = np.asarray(trainer.init_state().params["emb_in"], np.float32)
+    engine = QueryEngine(W0, vocab)
+    events = []
+    run = StreamRun(trainer, src, swap_engine=engine, swap_floor=0.0,
+                    log_fn=events.append)
+    state, rep = run.train(log_every=0)
+    assert rep.stream["swaps"] == rep.stream["segments"]
+    assert engine.generation == rep.stream["swaps"]
+    kinds = [e.get("event") for e in events]
+    assert "table_swap" in kinds and "stream" in kinds
+
+
+# ------------------------------------------------------------ telemetry
+def test_stream_records_and_counters(tmp_path):
+    from word2vec_tpu.obs.export import prometheus_textfile
+
+    shards = _write_shards(tmp_path, n_shards=2, tokens_per_shard=SEG,
+                           new_words_from=1)
+    cfg = _stream_cfg(vocab_reserve=8)
+    trainer, src, vocab = _bootstrap(shards, cfg)
+    prom_path = str(tmp_path / "m.prom")
+    prom = prometheus_textfile(prom_path)
+    run = StreamRun(trainer, src, log_fn=prom)
+    run.train(log_every=0)
+    prom.close()
+    text = open(prom_path).read()
+    assert "w2v_vocab_size" in text
+    assert "w2v_stream_tokens_total" in text
+    assert "w2v_vocab_generation" in text
+    assert "w2v_vocab_growth_total 1.0" in text
+    # present-from-zero counters even when nothing swapped
+    assert "w2v_table_swaps_total 0.0" in text
+    assert "w2v_table_swap_refused_total 0.0" in text
+
+
+def test_trainreport_stream_and_events(tmp_path):
+    shards = _write_shards(tmp_path, n_shards=2, tokens_per_shard=SEG,
+                           new_words_from=1)
+    cfg = _stream_cfg(vocab_reserve=8)
+    trainer, src, vocab = _bootstrap(shards, cfg)
+    events = []
+    run = StreamRun(trainer, src, log_fn=events.append)
+    state, rep = run.train(log_every=0)
+    assert rep.stream["segments"] >= 2
+    assert rep.stream["tokens_total"] == 2 * SEG  # 2 shards x SEG tokens
+    assert rep.stream["cursor"]["segment"] == rep.stream["segments"]
+    assert rep.stream["growths"] >= 1
+    kinds = [e.get("event") for e in events]
+    assert "stream_segment" in kinds
+    assert "vocab_growth" in kinds
+    assert "stream" in kinds
+
+
+# ----------------------------------------------------------------- CLI
+@pytest.fixture
+def cli_shards(tmp_path):
+    return _write_shards(tmp_path, n_shards=2, tokens_per_shard=700,
+                         new_words_from=None, seed=1)
+
+
+def test_cli_streaming_smoke_and_resume_parity(tmp_path, cli_shards):
+    from word2vec_tpu.cli import main
+    from word2vec_tpu.io.embeddings import load_word2vec
+
+    spec = ",".join(cli_shards)
+    base = [
+        "-train", spec, "-size", "8", "-window", "2", "-negative", "2",
+        "-min-count", "1", "--backend", "cpu", "--batch-rows", "4",
+        "--max-sentence-len", "16", "--corpus-mode", "streaming",
+        "--segment-tokens", "400", "--quiet", "--log-every", "0",
+    ]
+    out_full = str(tmp_path / "full.txt")
+    rc = main(base + ["-output", out_full])
+    assert rc == 0
+    words_full, W_full = load_word2vec(out_full)
+
+    # interrupted leg: a sigterm fault mid-stream -> rc 75 with a cursor
+    ck = str(tmp_path / "ck")
+    out_ab = str(tmp_path / "ab.txt")
+    rc = main(base + [
+        "-output", out_ab, "--checkpoint-dir", ck,
+        "--checkpoint-every", "5", "--faults", "sigterm@7",
+    ])
+    assert rc == 75
+    doc = read_stream_cursor(ck)
+    assert doc is not None and doc["schema"] == 1
+    rc = main(base + [
+        "-output", out_ab, "--checkpoint-dir", ck, "--resume", ck,
+        "--checkpoint-every", "5",
+    ])
+    assert rc == 0
+    words_ab, W_ab = load_word2vec(out_ab)
+    assert words_ab == words_full
+    np.testing.assert_array_equal(W_full, W_ab)
+
+
+def test_cli_pipe_ingestion(tmp_path, cli_shards):
+    from word2vec_tpu.cli import main
+
+    payload = " ".join(
+        open(p).read() for p in cli_shards
+    )
+    r, w = os.pipe()
+
+    def feed():
+        os.write(w, payload.encode())
+        os.close(w)
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    out = str(tmp_path / "pipe.txt")
+    real_stdin = os.dup(0)
+    try:
+        os.dup2(r, 0)
+        rc = main([
+            "-train", "-", "-output", out, "-size", "8", "-window", "2",
+            "-negative", "2", "-min-count", "1", "--backend", "cpu",
+            "--batch-rows", "4", "--max-sentence-len", "16",
+            "--corpus-mode", "streaming", "--segment-tokens", "400",
+            "--stream-spool", str(tmp_path / "spool"),
+            "--quiet", "--log-every", "0",
+        ])
+    finally:
+        os.dup2(real_stdin, 0)
+        os.close(real_stdin)
+        os.close(r)
+    t.join(timeout=5)
+    assert rc == 0
+    assert os.path.exists(out)
+    assert os.listdir(str(tmp_path / "spool"))  # segments were spooled
+
+
+def test_cli_rejects_pipe_without_streaming(tmp_path):
+    from word2vec_tpu.cli import main
+
+    rc = main(["-train", "-", "-negative", "2", "--backend", "cpu"])
+    assert rc == 1
+
+
+def test_cli_superset_resume_guard(tmp_path, cli_shards):
+    """A checkpoint whose vocabulary GREW online resumes against the
+    original corpus through the compatible-superset guard (resident
+    path)."""
+    from word2vec_tpu.cli import main
+
+    # build a resident checkpoint, then grow its vocab by hand (what a
+    # streaming run's admission would have done)
+    ck = str(tmp_path / "ck")
+    rc = main([
+        "-train", cli_shards[0], "-output", str(tmp_path / "v.txt"),
+        "-size", "8", "-window", "2", "-negative", "2", "-min-count", "1",
+        "--backend", "cpu", "--batch-rows", "4", "--max-sentence-len",
+        "16", "--checkpoint-dir", ck, "--quiet", "--log-every", "0",
+    ])
+    assert rc == 0
+    from word2vec_tpu.io.checkpoint import load_checkpoint
+
+    state, cfg_ck, vocab_ck = load_checkpoint(ck)
+    vocab_ck.admit([("zzz_new", 9)])
+    # params must cover the grown vocab rows for the resumed run
+    state.params = {
+        k: np.concatenate(
+            [np.asarray(v), np.zeros((1,) + np.asarray(v).shape[1:],
+                                     np.asarray(v).dtype)]
+        ) if k in ("emb_in", "emb_out_ns") else np.asarray(v)
+        for k, v in state.params.items()
+    }
+    save_checkpoint(ck, state, cfg_ck, vocab_ck)
+    rc = main([
+        "-train", cli_shards[0], "-output", str(tmp_path / "v2.txt"),
+        "-size", "8", "-window", "2", "-negative", "2", "-min-count", "1",
+        "--backend", "cpu", "--batch-rows", "4", "--max-sentence-len",
+        "16", "--resume", ck, "--quiet", "--log-every", "0",
+    ])
+    assert rc == 0  # superset accepted, run completed

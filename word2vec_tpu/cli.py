@@ -192,6 +192,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device-resident corpus: keep the packed corpus in "
                         "HBM and assemble batches on device (single-chip "
                         "chunked path; ops/resident.py)")
+    p.add_argument("--corpus-mode", choices=["resident", "streaming"],
+                   default="resident",
+                   help="how the corpus reaches the device (stream/): "
+                        "resident = read+pack the whole corpus up front "
+                        "(the historical path; requires corpus-fits-in-"
+                        "RAM); streaming = consume it in bounded segments "
+                        "from a file set / comma list / directory / glob "
+                        "(-train accepts all of those) or a pipe "
+                        "(-train -), with host read/pack/copy overlapping "
+                        "device compute, mid-stream cursor checkpoints "
+                        "(byte-for-byte SIGTERM resume), and online vocab "
+                        "growth into --vocab-reserve rows. A streaming "
+                        "checkpoint resumes streaming automatically")
+    p.add_argument("--segment-tokens", type=int, default=0, metavar="N",
+                   help="streaming segment size in raw corpus tokens "
+                        "(config.segment_tokens; 0 = auto, 4M). The "
+                        "segment is the growth/swap/resume boundary unit "
+                        "and the per-'epoch' alpha-schedule horizon")
+    p.add_argument("--vocab-reserve", type=int, default=0, metavar="N",
+                   help="reserve N embedding rows for online vocabulary "
+                        "growth (streaming only): new words seen in a "
+                        "consumed segment are admitted into reserved rows "
+                        "at the next segment boundary, deterministically, "
+                        "leaving existing rows bitwise untouched; a grown "
+                        "vocab resumes through the compatible-superset "
+                        "content-hash guard (0 = fixed vocabulary)")
+    p.add_argument("--stream-spool", metavar="DIR", default="",
+                   help="pipe-ingest spool directory (-train - only): "
+                        "segments read from the pipe are spooled here so "
+                        "a mid-stream resume can replay them (default: "
+                        "<--checkpoint-dir>/stream_spool, else a temp dir "
+                        "— resumable only while it survives)")
     p.add_argument("--max-sentence-len", type=int, default=192)
     p.add_argument("--corpus-format", choices=["text8", "lines"], default="text8",
                    help="text8: 1000-word chunks (main.cpp:63-92); "
@@ -555,7 +587,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .config import Word2VecConfig
     from .data.batcher import PackedCorpus
     from .data.vocab import Vocab
-    from .io.checkpoint import CheckpointError, load_checkpoint, save_checkpoint
+    from .io.checkpoint import (
+        CheckpointError, load_checkpoint_with_path, read_stream_cursor,
+        save_checkpoint,
+    )
     from .io.embeddings import save_word2vec
     from .models.params import export_matrix
     from .resilience.faults import Fault, FaultPlan
@@ -623,14 +658,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     state = None
     ck_cfg = None
     ck_vocab = None
+    stream_doc = None
     if args.resume:
         try:
-            state, ck_cfg, ck_vocab = load_checkpoint(args.resume)
+            state, ck_cfg, ck_vocab, ck_dir = load_checkpoint_with_path(
+                args.resume
+            )
         except CheckpointError as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
+        # streaming checkpoints carry their replay cursor NEXT TO the
+        # params (same integrity manifest, same backup rotation) — read it
+        # from the dir that actually loaded, which may be a .old fallback
+        stream_doc = read_stream_cursor(ck_dir)
         if not args.quiet:
-            print(f"resumed from {args.resume} at step {state.step}")
+            print(
+                f"resumed from {args.resume} at step {state.step}"
+                + (
+                    f" (stream segment {stream_doc.get('segment')}, "
+                    f"vocab generation {stream_doc.get('vocab_generation')})"
+                    if stream_doc else ""
+                )
+            )
 
     # validation mirrors main.cpp:164-181 (raised by Word2VecConfig)
     alpha = args.alpha
@@ -674,6 +723,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         hs_dense_top=args.hs_dense_top,
         hs_tail_slots=args.hs_tail_slots,
         resident=args.resident,
+        corpus_mode=args.corpus_mode,
+        segment_tokens=args.segment_tokens,
+        vocab_reserve=args.vocab_reserve,
         autotune=args.autotune,
         plan_cache=args.plan_cache,
         clip_row_update=args.clip_row_update,
@@ -828,7 +880,101 @@ def main(argv: Optional[List[str]] = None) -> int:
             "from the corpus; the loaded vocabulary (checkpoint/-read-vocab) "
             "is used as-is", file=sys.stderr,
         )
-    if ck_vocab is not None:
+    streaming = cfg.corpus_mode == "streaming"
+    stream_source = None
+    stream_cursor = None
+    stream_run = None  # set after the trainer exists; save sites read it lazily
+    if args.train == "-" and not streaming:
+        print(
+            "error: -train - (pipe ingestion) requires --corpus-mode "
+            "streaming: a pipe cannot be packed resident",
+            file=sys.stderr,
+        )
+        return 1
+    if streaming:
+        import numpy as _np
+
+        from .stream import DEFAULT_SEGMENT_TOKENS, StreamCursor, make_source
+        from .stream.driver import encode_segment
+
+        seg_tokens = cfg.segment_tokens or DEFAULT_SEGMENT_TOKENS
+        spool = args.stream_spool
+        if not spool and args.train == "-":
+            import tempfile
+
+            spool = (
+                os.path.join(args.checkpoint_dir, "stream_spool")
+                if args.checkpoint_dir
+                else os.path.join(
+                    tempfile.gettempdir(), f"w2v_stream_spool_{os.getpid()}"
+                )
+            )
+            if args.checkpoint_dir and jax.process_count() > 1:
+                spool += f"_p{jax.process_index()}"
+        try:
+            stream_source = make_source(
+                args.train, fmt=args.corpus_format,
+                segment_tokens=seg_tokens, spool_dir=spool,
+            )
+        except (FileNotFoundError, ValueError, OSError) as e:
+            print(f"error: bad streaming corpus spec: {e}", file=sys.stderr)
+            return 1
+        stream_cursor = (
+            StreamCursor.from_json(stream_doc) if stream_doc
+            else StreamCursor()
+        )
+        if args.resume and stream_doc is None and not args.quiet:
+            print(
+                "warning: resuming a non-streaming checkpoint into "
+                "--corpus-mode streaming: the stream starts from its "
+                "beginning (no cursor to replay)",
+                file=sys.stderr,
+            )
+        # Vocabulary bootstrap: checkpoint > -read-vocab > first segment.
+        # The streaming resume skips the full-corpus rebuild guard (a
+        # stream cannot be re-counted mid-flight); identity is pinned by
+        # the cursor + the checkpoint's own vocab instead.
+        boot = None
+        if ck_vocab is not None:
+            vocab = ck_vocab
+        elif args.read_vocab:
+            vocab = Vocab.load(args.read_vocab)
+        else:
+            boot = stream_source.read_segment(
+                stream_cursor.segment, stream_cursor.shard,
+                stream_cursor.offset, vocab=None,
+            )
+            if boot.raw_tokens == 0:
+                print(
+                    "error: the streaming corpus produced no tokens "
+                    "(empty stream at the start cursor)", file=sys.stderr,
+                )
+                return 1
+            vocab = Vocab.from_counter(
+                boot.counts or {}, min_count=cfg.min_count,
+                max_vocab=args.max_vocab,
+            )
+            if len(vocab) == 0:
+                print(
+                    "error: the first streaming segment built an empty "
+                    "vocabulary (every word under -min-count "
+                    f"{cfg.min_count}); lower -min-count or enlarge "
+                    "--segment-tokens", file=sys.stderr,
+                )
+                return 1
+        if boot is None:
+            boot = stream_source.read_segment(
+                stream_cursor.segment, stream_cursor.shard,
+                stream_cursor.offset, vocab=vocab,
+            )
+        # bootstrap corpus: feeds plan shapes / auto geometry / hazard
+        # warnings at construction; the driver replaces it per segment
+        flat = encode_segment(
+            boot, vocab, getattr(stream_source, "fmt", "text8")
+        )
+        if flat.size == 0 or not (flat >= 0).any():
+            flat = _np.zeros(1, dtype=_np.int32)
+    elif ck_vocab is not None:
         vocab = ck_vocab
         if args.read_vocab and Vocab.load(
             args.read_vocab
@@ -854,12 +1000,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                 args.train, fmt=args.corpus_format, min_count=cfg.min_count,
                 max_vocab=args.max_vocab,
             )
-            if rb_vocab.content_hash() != vocab.content_hash():
+            if rb_vocab.content_hash() == vocab.content_hash():
+                flat = rb_flat
+            elif vocab.is_compatible_superset(rb_vocab):
+                # Compatible superset: the checkpoint's vocabulary extends
+                # what this corpus rebuilds to — exactly what online vocab
+                # growth produces (stream/driver.py admits new words into
+                # reserved rows without disturbing existing ones). The
+                # grown vocabulary stays authoritative; re-encode with it
+                # so any grown word present in the corpus keeps its row.
+                print(
+                    f"resume: checkpoint vocabulary ({len(vocab)} words) is "
+                    f"a compatible superset of the corpus rebuild "
+                    f"({len(rb_vocab)} words) — an online-growth "
+                    "checkpoint; resuming with the grown vocabulary",
+                    file=sys.stderr,
+                )
+                flat = native.encode_file(args.train, vocab, mode)
+            else:
                 print(
                     f"error: the corpus at {args.train} rebuilds to a "
                     f"different vocabulary ({len(rb_vocab)} words) than the "
                     f"checkpoint at {args.resume} pins ({len(vocab)} words, "
-                    "content-hash mismatch): this is not the corpus the "
+                    "content-hash mismatch, not a compatible superset): "
+                    "this is not the corpus the "
                     "checkpoint was trained on (or -min-count/--max-vocab "
                     "differ from the original run). Resuming would silently "
                     "re-attribute embedding rows; pass "
@@ -868,7 +1032,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                     file=sys.stderr,
                 )
                 return 1
-            flat = rb_flat
         else:
             flat = native.encode_file(args.train, vocab, mode)
     elif args.read_vocab:
@@ -1021,6 +1184,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         extra = {
             "corpus_tokens": corpus.num_tokens,
             "corpus_rows": corpus.num_rows,
+            # the data plane: resident (corpus_tokens = the whole corpus)
+            # or streaming (corpus_tokens = the bootstrap segment; the
+            # stream record below carries the live cursor)
+            "corpus_mode": cfg.corpus_mode,
             "resumed_from": args.resume or None,
             # the kernel auto-selection record, when the degeneracy
             # domain re-routed a kernel='auto' run to 'pair' (the
@@ -1033,6 +1200,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             "elastic_generation": elastic_gen,
             "compile_cache": warm_cache_dir,
         }
+        if streaming:
+            extra["stream"] = {
+                "segment_tokens": cfg.segment_tokens or DEFAULT_SEGMENT_TOKENS,
+                "vocab_reserve": cfg.vocab_reserve,
+                "source": stream_source.describe(),
+                "resume_cursor": stream_doc,
+            }
         if args.elastic != "off":
             # mesh_events survive the exec between generations: carry the
             # prior generations' rows forward before this rewrite, and
@@ -1118,6 +1292,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
         return s
 
+    def _stream_meta():
+        # read lazily at save time: the driver's cursor advances per
+        # segment, and every checkpoint must carry the cursor of the
+        # segment it was taken IN (None on resident runs)
+        return stream_run.cursor_meta() if stream_run is not None else None
+
+    def _save_ckpt(snap):
+        save_checkpoint(
+            args.checkpoint_dir, snap, trainer.config, vocab,
+            keep=args.checkpoint_keep, stream=_stream_meta(),
+        )
+
     ckpt_cb = None
     if args.checkpoint_dir and args.checkpoint_every:
         def ckpt_cb(s):
@@ -1128,10 +1314,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # checkpoint must pin what the run is ACTUALLY using.
             snap = unreplicated(s)
             if is_primary:
-                save_checkpoint(
-                    args.checkpoint_dir, snap, trainer.config, vocab,
-                    keep=args.checkpoint_keep,
-                )
+                _save_ckpt(snap)
 
     # Quality-probe wiring: the CLI's flags are authoritative over the
     # trainer's config-built default (telemetry is runtime wiring, like
@@ -1412,8 +1595,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     # Supervised auto-recovery: DivergenceError rolls back to the last-good
     # checkpoint and retries instead of killing the run.
     run_train = trainer.train
+    if streaming:
+        # the continuous-training driver (stream/): segments in, the same
+        # (state, report) contract out — everything below (preemption,
+        # divergence, manifest, export) works unchanged, and every
+        # checkpoint the run writes carries the stream cursor (_save_ckpt)
+        from .stream import StreamRun
+
+        try:
+            stream_run = StreamRun(
+                trainer, stream_source, cursor=stream_cursor,
+                fault_plan=fault_plan if fault_plan else None,
+                log_fn=log_fn,
+            )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            hub.close()
+            return 1
+        run_train = stream_run.train
     supervisor = None
-    if args.auto_recover:
+    if args.auto_recover and streaming:
+        print(
+            "warning: --auto-recover is not supported with --corpus-mode "
+            "streaming yet (the supervisor's rollback replays a resident "
+            "epoch, not a stream cursor); continuing without it",
+            file=sys.stderr,
+        )
+    elif args.auto_recover:
         from .resilience.supervisor import Supervisor
 
         if not (args.checkpoint_dir and args.checkpoint_every) and not args.quiet:
@@ -1528,10 +1736,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             try:
                 snap = unreplicated(last)  # collective: all ranks enter
                 if is_primary:
-                    save_checkpoint(
-                        args.checkpoint_dir, snap, trainer.config, vocab,
-                        keep=args.checkpoint_keep,
-                    )
+                    _save_ckpt(snap)
                 grow_saved = True
             except Exception as ce:  # noqa: BLE001 — degrade to last periodic
                 print(
@@ -1573,10 +1778,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             try:
                 snap = unreplicated(last)  # collective: all ranks enter
                 if is_primary:
-                    save_checkpoint(
-                        args.checkpoint_dir, snap, trainer.config, vocab,
-                        keep=args.checkpoint_keep,
-                    )
+                    _save_ckpt(snap)
             except Exception as ce:  # noqa: BLE001 — degrade to last periodic
                 print(
                     f"warning: policy-shrink checkpoint failed ({ce}); the "
@@ -1684,10 +1886,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # peer those can hang too, hence the bounded wrapper
                 snap = unreplicated(last)
                 if is_primary:
-                    save_checkpoint(
-                        args.checkpoint_dir, snap, trainer.config, vocab,
-                        keep=args.checkpoint_keep,
-                    )
+                    _save_ckpt(snap)
 
             try:
                 _watchdog.bounded_call(
@@ -1759,6 +1958,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             summary["interrupted"] = report.interrupted
         if report.recoveries:
             summary["recoveries"] = len(report.recoveries)
+        if report.stream:
+            summary.update(
+                stream_segments=report.stream.get("segments"),
+                vocab_size=report.stream.get("vocab_size"),
+                table_swaps=report.stream.get("swaps"),
+            )
         if report.signals:
             # the signal plane's one-line verdict: did the run stay inside
             # its SLOs, and who lagged (obs/signals.FleetHealth)
@@ -1788,6 +1993,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             # restart (train._resume_skip) — recorded so the manifest shows
             # data was re-trained, not resumed
             end_fields["resume_fallback"] = trainer.resume_fallback
+        if report.stream:
+            # the continuous-training verdict: segments consumed, final
+            # cursor, vocab generation, growth/swap counts — one manifest
+            # read answers "where did the stream stop"
+            end_fields["stream"] = report.stream
         if report.signals:
             # the SLO summary + fleet-health verdict land where how the run
             # started already is — one manifest read answers "did it hold
@@ -1804,10 +2014,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.checkpoint_dir:
             snap = unreplicated(state)  # collective-capable: all processes
             if is_primary:
-                save_checkpoint(
-                    args.checkpoint_dir, snap, trainer.config, vocab,
-                    keep=args.checkpoint_keep,
-                )
+                _save_ckpt(snap)
         sig = handler.signum
         dump_flight("preempted", failure_step=state.step)
         export_trace()
@@ -1838,10 +2045,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.checkpoint_dir:
         snap = unreplicated(state)  # collective-capable: all processes enter
         if is_primary:
-            save_checkpoint(
-                args.checkpoint_dir, snap, trainer.config, vocab,
-                keep=args.checkpoint_keep,
-            )
+            _save_ckpt(snap)
 
     # matrix choice per main.cpp:196-202
     if hasattr(trainer, "export_params"):
@@ -1849,6 +2053,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         params = {k: v for k, v in state.params.items()}
     matrix = export_matrix(params, cfg, side=args.export_side)
+    if matrix.shape[0] > len(vocab):
+        # unadmitted online-growth reserve rows are not words
+        matrix = matrix[: len(vocab)]
     if args.output and is_primary:
         save_word2vec(
             args.output, vocab, matrix,

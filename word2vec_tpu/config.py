@@ -278,6 +278,47 @@ class Word2VecConfig:
     # parity) are bitwise unaffected.
     clip_row_update: float = 1.0
 
+    # How the corpus reaches the device step (the data plane, not the
+    # kernel):
+    #   "resident"  — the historical default: the whole corpus is read,
+    #                 encoded and packed ONCE before training; `resident`
+    #                 below then decides host-streamed vs HBM-resident
+    #                 batches. Requires corpus-fits-in-RAM.
+    #   "streaming" — the continuous-training data plane (stream/): the
+    #                 corpus is consumed in bounded SEGMENTS from a shard
+    #                 set / directory glob / pipe, each segment packed and
+    #                 trained through the placed_prefetch host batcher
+    #                 (host shard/pack/copy overlaps device compute), with
+    #                 mid-stream cursor checkpoints, optional online vocab
+    #                 growth (vocab_reserve), and hot table swaps into a
+    #                 live serve engine at segment boundaries. Forces the
+    #                 HBM-resident corpus OFF (segments replace each other;
+    #                 `resident='on'` is rejected). `iters` becomes passes
+    #                 per segment (1 for a true stream).
+    # Also a plan-cache dimension (tune/planner.py): streaming runs get
+    # their own cached plans — prefetch depth and chunk shape trade
+    # differently when the host is also reading shards.
+    corpus_mode: str = "resident"
+
+    # Streaming segment size in raw corpus tokens (corpus_mode="streaming"):
+    # each segment is read, packed and trained as a unit; the mid-stream
+    # checkpoint cursor points at segment starts, so the segment is also
+    # the resume/replay granule. 0 = auto (stream/driver.DEFAULT_SEGMENT_
+    # TOKENS). Uniform segments keep the dispatched chunk shapes constant
+    # across segments (one compiled program; only a trailing partial
+    # segment retraces).
+    segment_tokens: int = 0
+
+    # Online vocabulary growth headroom (corpus_mode="streaming"): reserve
+    # this many embedding-table rows beyond the initial vocabulary at init.
+    # New words observed in a consumed segment are admitted into reserved
+    # rows at the NEXT segment boundary (deterministic id assignment:
+    # count desc, ties lexicographic — stream/driver.py), leaving every
+    # pre-existing row bitwise untouched; a grown vocabulary resumes
+    # through the compatible-superset content-hash guard
+    # (data/vocab.Vocab.content_hash(limit=...)). 0 = fixed vocabulary.
+    vocab_reserve: int = 0
+
     # Device-resident corpus (ops/resident.py): keep the packed corpus in
     # HBM and assemble every [B, L] batch on device inside the scanned chunk
     # — a dispatch then carries only scalars plus one [R] row-order upload
@@ -571,6 +612,36 @@ class Word2VecConfig:
         if self.resident not in ("auto", "on", "off"):
             raise ValueError(
                 f"resident must be auto|on|off, got {self.resident!r}"
+            )
+        if self.corpus_mode not in ("resident", "streaming"):
+            raise ValueError(
+                f"corpus_mode must be 'resident' or 'streaming', "
+                f"got {self.corpus_mode!r}"
+            )
+        if self.corpus_mode == "streaming" and self.resident == "on":
+            raise ValueError(
+                "corpus_mode='streaming' is incompatible with "
+                "resident='on': segments replace each other, so the "
+                "corpus cannot be pinned in HBM — use resident='off' "
+                "(or 'auto', which streaming resolves to 'off')"
+            )
+        if self.segment_tokens < 0:
+            raise ValueError("segment_tokens must be >= 0 (0 = auto)")
+        if self.vocab_reserve < 0:
+            raise ValueError("vocab_reserve must be >= 0 (0 = fixed vocab)")
+        if self.vocab_reserve and self.corpus_mode != "streaming":
+            raise ValueError(
+                "vocab_reserve applies to the streaming data plane only "
+                "(corpus_mode='streaming'): a resident run builds its "
+                "whole vocabulary up front and never grows it"
+            )
+        if self.vocab_reserve and self.train_method == "hs":
+            raise ValueError(
+                "vocab_reserve requires negative sampling: admitting a "
+                "word under hierarchical softmax would rebuild the Huffman "
+                "tree and re-attribute every internal-node row "
+                "(data/huffman.py) — the growth invariant (existing rows "
+                "bitwise untouched) cannot hold"
             )
         if self.stochastic_rounding and self.dtype != "bfloat16":
             raise ValueError(

@@ -10,7 +10,7 @@ float32 arrays that ship to the device once and stay in HBM.
 from __future__ import annotations
 
 from collections import Counter
-from typing import Dict, Iterable, Iterator, List, Sequence
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -122,7 +122,7 @@ class Vocab:
         for sentence in sentences:
             yield self.encode(sentence)
 
-    def content_hash(self) -> str:
+    def content_hash(self, limit: Optional[int] = None) -> str:
         """sha256 over the ordered (index, word, count) content.
 
         The resume-compatibility fingerprint: two Vocab objects hash equal
@@ -131,13 +131,57 @@ class Vocab:
         rows keep their meaning and the deterministic corpus encoding is
         identical. Stored in every checkpoint's integrity.json metadata
         (io/checkpoint.py) and compared by the CLI's --resume guard against
-        the vocabulary the current corpus rebuilds to."""
+        the vocabulary the current corpus rebuilds to.
+
+        `limit` hashes only the first `limit` rows — the compatible-superset
+        check: a vocabulary GROWN online (stream/driver.py admits new words
+        into reserved rows, never touching existing ones) satisfies
+        grown.content_hash(limit=len(base)) == base.content_hash(), so a
+        grown checkpoint still resumes against the original corpus."""
         import hashlib
 
+        n = len(self.words) if limit is None else min(int(limit), len(self.words))
         h = hashlib.sha256()
-        for i, (w, c) in enumerate(zip(self.words, self.counts)):
-            h.update(f"{i}\t{w}\t{int(c)}\n".encode("utf-8"))
+        for i in range(n):
+            h.update(
+                f"{i}\t{self.words[i]}\t{int(self.counts[i])}\n".encode("utf-8")
+            )
         return h.hexdigest()
+
+    def is_compatible_superset(self, base: "Vocab") -> bool:
+        """True iff this vocabulary extends `base` without disturbing it:
+        same words at the same rows with the same counts for base's full
+        index range (the online-growth invariant — the --resume guard
+        accepts a grown checkpoint against the original corpus on this)."""
+        return len(self) >= len(base) and (
+            self.content_hash(limit=len(base)) == base.content_hash()
+        )
+
+    # ------------------------------------------------------------- growth
+    def admit(self, items: Sequence[tuple]) -> List[int]:
+        """Admit `(word, count)` pairs IN PLACE at the next free ids
+        (deterministic: callers pass an already-ordered admission list —
+        stream/driver.admission_order). Existing rows are untouched: ids,
+        words and counts 0..V-1 keep their exact values, so embedding-table
+        rows keep their meaning and content_hash(limit=V) is invariant.
+        Returns the assigned ids. Duplicate or already-present words are
+        rejected loudly (silent re-admission would alias two rows)."""
+        ids: List[int] = []
+        new_counts: List[int] = []
+        for w, c in items:
+            if w in self.word2id:
+                raise ValueError(f"cannot admit {w!r}: already in vocabulary")
+            i = len(self.words)
+            self.words.append(w)
+            self.word2id[w] = i
+            ids.append(i)
+            new_counts.append(int(c))
+        if new_counts:
+            self.counts = np.concatenate(
+                [self.counts, np.asarray(new_counts, dtype=np.int64)]
+            )
+            self.total_words = int(self.counts.sum())
+        return ids
 
     # ------------------------------------------------------------ persistence
     def save(self, path: str) -> None:

@@ -174,9 +174,13 @@ def _quarantine(path: str) -> Optional[str]:
 
 
 # ------------------------------------------------------------------- save
+STREAM_FILE = "stream.json"
+
+
 def save_checkpoint(path: str, state: TrainState, config: Word2VecConfig,
                     vocab: Optional[Vocab] = None, keep: int = 1,
-                    retries: int = 3, backoff: float = 0.05) -> None:
+                    retries: int = 3, backoff: float = 0.05,
+                    stream: Optional[dict] = None) -> None:
     """Atomic checkpoint write with integrity manifest and retention.
 
     `keep` previous checkpoints are retained (`.old` ... `.old{keep}`);
@@ -185,6 +189,14 @@ def save_checkpoint(path: str, state: TrainState, config: Word2VecConfig,
     fault) is retried up to `retries` times with exponential backoff before
     surfacing — a checkpoint that fails to land must be loud, but not
     because of one transient error.
+
+    `stream` (corpus_mode="streaming" runs) is the stream cursor document
+    — segment index, shard, in-shard offset, vocab generation, global
+    counters (stream/source.StreamCursor.to_json) — written as
+    `stream.json` INSIDE the checkpoint dir before the integrity manifest,
+    so the cursor is covered by the same sha256 manifest, rotates with the
+    same backup chain, and can never describe a different checkpoint than
+    the params next to it. Read it back with `read_stream_cursor`.
     """
     if keep < 0:
         raise ValueError(f"keep must be >= 0, got {keep}")
@@ -200,15 +212,28 @@ def save_checkpoint(path: str, state: TrainState, config: Word2VecConfig,
             )
             time.sleep(backoff * (2 ** (attempt - 1)))
         try:
-            _save_once(path, state, config, vocab, keep)
+            _save_once(path, state, config, vocab, keep, stream)
             return
         except OSError as e:
             last = e
     raise last  # type: ignore[misc]
 
 
+def read_stream_cursor(path: str) -> Optional[dict]:
+    """The stream-cursor document of the checkpoint dir `path` (None for
+    non-streaming checkpoints). `path` must be the dir that actually
+    LOADED — use load_checkpoint_with_path, not the nominal path, or a
+    fallback to `.old` would pair new params with a stale cursor."""
+    fp = os.path.join(path, STREAM_FILE)
+    if not os.path.exists(fp):
+        return None
+    with open(fp) as f:
+        return json.load(f)
+
+
 def _save_once(path: str, state: TrainState, config: Word2VecConfig,
-               vocab: Optional[Vocab], keep: int) -> None:
+               vocab: Optional[Vocab], keep: int,
+               stream: Optional[dict] = None) -> None:
     _faults.raise_if_active("ckpt_oserror", where=path)
     parent = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(parent, exist_ok=True)
@@ -239,6 +264,13 @@ def _save_once(path: str, state: TrainState, config: Word2VecConfig,
             json.dump(dataclasses.asdict(config), f, indent=2)
         if vocab is not None:
             vocab.save(os.path.join(tmp, "vocab.txt"))
+        if stream is not None:
+            # the mid-stream cursor rides inside the dir so the integrity
+            # manifest below covers it (a torn cursor quarantines the whole
+            # candidate, exactly like a torn state.npz)
+            with open(os.path.join(tmp, STREAM_FILE), "w") as f:
+                json.dump(dict(stream), f, indent=2)
+                f.write("\n")
         from ..models.params import params_layout
 
         # the realized table layout (split [V, d] pair vs unified [V, 2, d]
@@ -249,6 +281,10 @@ def _save_once(path: str, state: TrainState, config: Word2VecConfig,
         meta = {"table_layout": params_layout(state.params)}
         if vocab is not None:
             meta["vocab_hash"] = vocab.content_hash()
+            # the live vocab size, so external tools can run the
+            # compatible-superset check (content_hash(limit=...)) without
+            # parsing vocab.txt
+            meta["vocab_size"] = len(vocab)
         # written last: its presence certifies a complete write; the meta
         # block carries the vocab fingerprint for the --resume corpus guard
         write_integrity(tmp, meta=meta)
@@ -331,6 +367,25 @@ def load_checkpoint(
     rotation never resurrects them; `fallback=False` restricts the search
     to `path` itself. Raises CheckpointError when nothing loads.
     """
+    state, config, vocab, _ = load_checkpoint_with_path(
+        path, fallback=fallback, quarantine=quarantine, validate=validate
+    )
+    return state, config, vocab
+
+
+def load_checkpoint_with_path(
+    path: str,
+    fallback: bool = True,
+    quarantine: bool = True,
+    validate: Optional[
+        Callable[[TrainState, Word2VecConfig, Optional[Vocab]], None]
+    ] = None,
+) -> Tuple[TrainState, Word2VecConfig, Optional[Vocab], str]:
+    """load_checkpoint, additionally returning the DIRECTORY that loaded
+    (`path` itself, or the `.old*` backup the fallback walked to) — the
+    streaming resume reads its cursor sidecar (read_stream_cursor) from
+    this dir, never the nominal path, so params and cursor always come
+    from the same write."""
     tried: List[str] = []
     for cand in checkpoint_candidates(path):
         if not os.path.exists(os.path.join(cand, "state.npz")):
@@ -343,7 +398,7 @@ def load_checkpoint(
             out = _load_dir(cand)
             if validate is not None:
                 validate(*out)
-            return out
+            return out + (cand,)
         except _CORRUPT_ERRORS as e:
             import warnings
 

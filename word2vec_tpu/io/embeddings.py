@@ -324,7 +324,14 @@ def save_word2vec(
     binary: bool = False,
     layout: str = "reference",
 ) -> None:
-    """CLI-level save in vocab order (reference: main.cpp:196-202 + :398)."""
+    """CLI-level save in vocab order (reference: main.cpp:196-202 + :398).
+
+    A table with MORE rows than the vocabulary carries unadmitted
+    online-growth reserve rows (config.vocab_reserve) — they are not words
+    and are not exported; fewer rows than words is still an error."""
+    matrix = np.asarray(matrix)
+    if matrix.shape[0] > len(vocab.words):
+        matrix = matrix[: len(vocab.words)]
     if binary:
         save_embeddings_binary(path, vocab.words, matrix, layout=layout)
     else:

@@ -133,10 +133,16 @@ def logical_table(params: Params, name: str) -> jnp.ndarray:
 def init_params(config: Word2VecConfig, vocab_size: int, key: jax.Array) -> Params:
     d = config.word_dim
     dtype = jnp.dtype(config.dtype)
+    # Online-growth headroom (config.vocab_reserve, stream/driver.py): the
+    # word tables carry `reserve` extra rows from init, randomly
+    # initialized by the SAME draw as live rows — admission later only
+    # makes ids live, it never touches table bits, so pre-existing rows
+    # stay bitwise identical across a growth boundary.
+    cap = vocab_size + getattr(config, "vocab_reserve", 0)
     uniform = (
-        jax.random.uniform(key, (vocab_size, d), jnp.float32, -0.5, 0.5) / d
+        jax.random.uniform(key, (cap, d), jnp.float32, -0.5, 0.5) / d
     ).astype(dtype)
-    zeros = jnp.zeros((vocab_size, d), dtype)
+    zeros = jnp.zeros((cap, d), dtype)
 
     params: Params = {}
     if config.model == "sg":

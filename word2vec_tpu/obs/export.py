@@ -67,6 +67,13 @@ EVENT_COUNTERS = {
     # budget of consecutive windows. A breach is a log + event, never an
     # exit — but a dashboard must be able to alert on increase() from zero.
     "slo_breach": "w2v_slo_breaches_total",
+    # continuous training (stream/driver.py): online-growth admissions,
+    # and hot table swaps into a live serve engine — accepted swaps and
+    # quality-gate refusals counted separately, so a dashboard can alert
+    # on refusals climbing while swaps stall (a degrading trainer).
+    "vocab_growth": "w2v_vocab_growth_total",
+    "table_swap": "w2v_table_swaps_total",
+    "table_swap_refused": "w2v_table_swap_refused_total",
 }
 
 #: event kinds whose NUMERIC fields also land as gauges. Mesh topology
@@ -76,8 +83,12 @@ EVENT_COUNTERS = {
 #: line by the console sink) but must still be scrapeable as a gauge.
 #: "signals" rows (obs/signals.py, one per closed window: w2v_signal_*)
 #: and "fleet" rows (obs/fleet.py rank-0 aggregation: w2v_fleet_*) are the
-#: signal plane's continuous outputs and ride the same channel.
-GAUGE_EVENTS = ("mesh", "signals", "fleet")
+#: signal plane's continuous outputs and ride the same channel. "stream"
+#: rows (stream/driver.py, one per segment boundary) carry the
+#: continuous-training gauges: w2v_vocab_size / w2v_stream_tokens_total /
+#: w2v_stream_segment / w2v_vocab_generation — emitted once at run start
+#: too, so the gauges are present from zero.
+GAUGE_EVENTS = ("mesh", "signals", "fleet", "stream")
 
 #: seconds one sink call may take before the hub detaches it as wedged —
 #: generous (a prom textfile rewrite is microseconds; a hung NFS mount or

@@ -174,6 +174,12 @@ def score_table(
     from ..serve.query import get_engine
 
     W = np.asarray(W)
+    if W.shape[0] > len(vocab):
+        # unadmitted online-growth reserve rows (config.vocab_reserve) are
+        # not words: scoring/health stats must not see their random init
+        W = W[: len(vocab)]
+        if W_out is not None:
+            W_out = np.asarray(W_out)[: len(vocab)]
     rec: Dict[str, float] = {}
     eng = get_engine(W, vocab, restrict=len(vocab))
 

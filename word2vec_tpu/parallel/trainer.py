@@ -829,6 +829,19 @@ class ShardedTrainer(Trainer):
                 state.params = self._run_sync(state.params)
             self._last_sync_step = state.step
 
+    def set_corpus(self, corpus) -> None:
+        """Segment swap (stream/driver.py). The per-segment TrainState
+        counters restart at 0, so the sync bookkeeping must restart with
+        them: a stale `_last_sync_step` from the previous segment makes
+        the distance check (`step - last >= every`) permanently negative
+        and replica syncs silently STOP after the first segment — caught
+        by the sharded mid-stream resume parity test. Steps/epoch is a
+        per-corpus agreement (cross-process min of shard capacity), so it
+        re-agrees per segment — the boundary is a sync boundary anyway."""
+        super().set_corpus(corpus)
+        self._last_sync_step = None
+        self._epoch_steps = None
+
     def _probe_params(self, state: TrainState) -> Params:
         """Quality probes score the synced, de-replicated host export —
         the same table export/eval/checkpoints see — so a (dp, tp) mesh

@@ -79,7 +79,21 @@ STEP_KINDS = (
 #: batch executor's injection point; the server must fail the affected
 #: requests 503 and keep serving, never die)
 EVENT_KINDS = ("ckpt_oserror", "oom")
-KINDS = STEP_KINDS + EVENT_KINDS
+#: fault kinds delivered at streaming SEGMENT boundaries by the
+#: continuous-training driver (stream/driver.py calls
+#: FaultPlan.on_segment at every segment start; `@k` pins the segment
+#: index, not an optimizer step):
+#:   stream_stall@k[:secs=S]  sleep S in the segment pipeline — an ingest
+#:                            hiccup (slow shard storage, a stalled pipe
+#:                            producer) the run must absorb as batcher
+#:                            wait, never as a crash
+#:   vocab_growth@k[:n=N]     force an online-growth admission of N
+#:                            synthetic words at the next boundary, so the
+#:                            chaos matrix exercises the growth path
+#:                            (reserved-row admission, device-table
+#:                            rebuild, generation bump) on any stream
+STREAM_KINDS = ("stream_stall", "vocab_growth")
+KINDS = STEP_KINDS + EVENT_KINDS + STREAM_KINDS
 
 #: default `secs` per kind: a stall is a measured slow-batcher blip, a hang
 #: is meant to OUTLIVE any sane step deadline (the watchdog shoots the
@@ -93,6 +107,7 @@ class Fault:
     step: int = 0                    # boundary at/after which a step fault fires
     times: int = 1                   # firings before the fault is spent
     secs: Optional[float] = None     # stall/hang duration (kind default)
+    n: int = 1                       # vocab_growth: synthetic words to admit
     fired: int = 0                   # firings so far (mutable state)
 
     def __post_init__(self) -> None:
@@ -108,6 +123,8 @@ class Fault:
             self.secs = _DEFAULT_SECS.get(self.kind, 0.25)
         if self.secs < 0:
             raise ValueError(f"fault secs must be >= 0, got {self.secs}")
+        if self.n < 1:
+            raise ValueError(f"fault n must be >= 1, got {self.n}")
 
     @property
     def spent(self) -> bool:
@@ -116,7 +133,7 @@ class Fault:
     def to_json(self) -> Dict:
         return {
             "kind": self.kind, "step": self.step, "times": self.times,
-            "secs": self.secs, "fired": self.fired,
+            "secs": self.secs, "n": self.n, "fired": self.fired,
         }
 
 
@@ -144,9 +161,11 @@ def _parse_token(tok: str) -> Fault:
                 kwargs["times"] = int(val)
             elif key == "secs":
                 kwargs["secs"] = float(val)
+            elif key == "n":
+                kwargs["n"] = int(val)
             else:
                 raise ValueError(
-                    f"unknown key {key!r} (known: times, secs)"
+                    f"unknown key {key!r} (known: times, secs, n)"
                 )
         except ValueError as e:
             if "unknown key" in str(e):
@@ -265,6 +284,26 @@ class FaultPlan:
                     f"injected sync_timeout fault at step {state.step}",
                     f.secs,
                 )
+
+    # -------------------------------------------------- segment delivery
+    def on_segment(self, segment_index: int, driver=None) -> None:
+        """Deliver due stream faults at a streaming segment boundary
+        (stream/driver.py). `@k` pins the SEGMENT index — the stream
+        plane's boundary unit, like the chunk is the dispatch atom."""
+        for f in self.faults:
+            if (
+                f.kind not in STREAM_KINDS or f.spent
+                or segment_index < f.step
+            ):
+                continue
+            f.fired += 1
+            self.log.append({
+                "kind": f.kind, "step": f.step, "at_step": segment_index,
+            })
+            if f.kind == "stream_stall":
+                time.sleep(f.secs)
+            elif f.kind == "vocab_growth" and driver is not None:
+                driver.force_growth(f.n)
 
     # ---------------------------------------------------- event delivery
     def fire_event(self, kind: str, where: str = "") -> bool:

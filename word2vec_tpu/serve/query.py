@@ -167,6 +167,54 @@ class QueryEngine:
         self.table = jax.device_put(jnp.asarray(Wn, dtype=dt))
         self.table_dtype = table_dtype
         self.V, self.d = int(V), int(Wn.shape[1])
+        #: monotonically increasing swap generation (0 = the construction
+        #: table); /stats and the streaming driver's swap events expose it
+        self.generation = 0
+        self._swap_lock = threading.Lock()
+
+    # ------------------------------------------------------------ hot swap
+    def swap_table(self, W: np.ndarray, vocab: Optional[Vocab] = None,
+                   allow_shrink: bool = False) -> int:
+        """Atomically replace the resident table with fresh embeddings —
+        the continuous-training hot swap (stream/driver.py): normalize and
+        place the NEW table first (the expensive part happens while the old
+        one keeps serving), then flip the references. In-flight queries
+        snapshot the (table, V, vocab) triple once at entry (batch_topk),
+        so every request is answered entirely by one table generation and
+        ZERO requests drop across a swap.
+
+        The new vocabulary may only EXTEND the old one (grow-only): ids
+        resolved against the old vocab stay valid against the new table.
+        A shrinking swap would let a concurrently-admitted id index past
+        the new V — refused unless `allow_shrink` (single-threaded
+        callers). Returns the new generation."""
+        Wn = unit_norm(np.asarray(W))
+        if vocab is not None and len(vocab) < Wn.shape[0]:
+            Wn = Wn[: len(vocab)]
+        if Wn.shape[0] < self.V and not allow_shrink:
+            raise ValueError(
+                f"swap_table would SHRINK the table ({self.V} -> "
+                f"{Wn.shape[0]} rows): ids resolved against the old "
+                "vocabulary could index past the new one mid-flight; pass "
+                "allow_shrink=True only from single-threaded callers"
+            )
+        if Wn.shape[1] != self.d:
+            raise ValueError(
+                f"swap_table dim mismatch: engine serves d={self.d}, new "
+                f"table has d={Wn.shape[1]}"
+            )
+        dt = jnp.bfloat16 if self.table_dtype == "bfloat16" else jnp.float32
+        new_table = jax.device_put(jnp.asarray(Wn, dtype=dt))
+        with self._swap_lock:
+            # the flip: queries already past their snapshot keep the old
+            # device table alive (jax arrays are immutable); new requests
+            # see the new triple
+            self.table = new_table
+            self.V = int(Wn.shape[0])
+            if vocab is not None:
+                self.vocab = vocab
+            self.generation += 1
+            return self.generation
 
     # ------------------------------------------------------------- lookup
     def ids_of(self, words: Sequence[str]) -> np.ndarray:
@@ -186,7 +234,12 @@ class QueryEngine:
         """The serving entry point: [B, 3] ids + weights -> per-row
         (indices, scores), already k-clamped, -inf-filtered, and
         tie-stable (score desc, index asc). Pads B and k to power-of-two
-        buckets so the compiled-program set stays small."""
+        buckets so the compiled-program set stays small.
+
+        The (table, V) pair is snapshotted ONCE here, so a concurrent
+        swap_table never splits one request across two table generations
+        — the zero-drop hot-swap contract (tests/test_stream.py)."""
+        table, V = self.table, self.V
         B = int(ids.shape[0])
         if B == 0:
             return []
@@ -197,15 +250,15 @@ class QueryEngine:
                 + self.batch_topk(ids[self.MAX_BATCH_BUCKET:],
                                   weights[self.MAX_BATCH_BUCKET:], k)
             )
-        k = max(1, min(int(k), self.V))
-        kb = min(self.V, _next_pow2(k))
+        k = max(1, min(int(k), V))
+        kb = min(V, _next_pow2(k))
         Bb = _next_pow2(B)
         ids_p = np.full((Bb, 3), -1, dtype=np.int32)
         w_p = np.zeros((Bb, 3), dtype=np.float32)
         ids_p[:B] = ids
         w_p[:B] = weights
-        q = _combine_queries(self.table, ids_p, w_p)
-        vals, top = _topk_kernel(self.table, q, ids_p, kb)
+        q = _combine_queries(table, ids_p, w_p)
+        vals, top = _topk_kernel(table, q, ids_p, kb)
         vals = np.asarray(vals)[:B]
         top = np.asarray(top)[:B]
         out: List[Tuple[np.ndarray, np.ndarray]] = []

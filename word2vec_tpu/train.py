@@ -81,6 +81,10 @@ class TrainReport:
     #: None unless a driver wired trainer.signals (cli.py does with
     #: --metrics-dir or --slo)
     signals: Optional[Dict] = None
+    #: continuous-training summary (stream.StreamRun.train): segments
+    #: consumed, stream cursor, vocab generation, growth/swap counts.
+    #: None on resident-corpus runs.
+    stream: Optional[Dict] = None
 
 
 class Trainer:
@@ -151,6 +155,14 @@ class Trainer:
     #: PeerAgreement's heartbeat row so the whole fleet evicts at one
     #: sync boundary (trigger=policy, zero failures involved).
     policy_poll = None
+    #: additive offset on config.seed for the shuffle/draw streams. The
+    #: streaming driver (stream/driver.py) sets it to the SEGMENT index
+    #: before each per-segment train() call, so every segment gets a
+    #: distinct draw/shuffle stream that is still a pure function of
+    #: (config.seed, segment) — which is what makes a mid-segment resume
+    #: replay the exact stream the uninterrupted run used. 0 (resident
+    #: runs) preserves the historical streams bit-for-bit.
+    seed_offset: int = 0
     #: derived-signal plane (obs/signals.SignalEngine) — None unless a
     #: driver wires one (cli.py: --metrics-dir / --slo / --prom-textfile).
     #: Beaten from _check_stop at every step/chunk boundary: on_boundary is
@@ -250,8 +262,15 @@ class Trainer:
     # ------------------------------------------------------------- planning
     def plan_constraints(self) -> Dict:
         """What the planner's candidate grid must respect for this trainer
-        (the sharded trainer narrows these from its mesh)."""
-        return {"dp": 1, "sp": 1, "tp": 1, "allow_pallas": True}
+        (the sharded trainer narrows these from its mesh). corpus_mode is a
+        plan dimension: streaming runs get their own cached plans — the
+        host is also reading shards, so prefetch depth and chunk shape
+        trade differently than on a resident corpus (tune/planner.py keys
+        on it)."""
+        return {
+            "dp": 1, "sp": 1, "tp": 1, "allow_pallas": True,
+            "corpus_mode": self.config.corpus_mode,
+        }
 
     def plan_shapes(self) -> Dict:
         """The realized per-dispatch step shapes (for the planner's records
@@ -354,6 +373,36 @@ class Trainer:
     def _build_step(self) -> None:
         self.step_fn = jit_train_step(self.config, self.tables)
         self.chunk_fn = None  # built lazily (geometry needs the corpus)
+
+    def set_corpus(self, corpus: PackedCorpus) -> None:
+        """Swap the training corpus between train() calls — the streaming
+        driver's per-segment hook (stream/driver.py). The compiled step
+        functions survive (jit respecializes per token shape, and uniform
+        segments keep shapes constant); only the resident-corpus cache is
+        invalidated, since it pinned the OLD corpus in HBM."""
+        self.corpus = corpus
+        self.total_words = corpus.num_tokens
+        self._resident = None
+        self._resident_cache = None
+        self._resident_ready = False
+        self.resident_resolution = None
+
+    def refresh_vocab_tables(self) -> None:
+        """Rebuild the frequency-derived device tables after an online
+        vocabulary admission (stream/driver.py growth boundary): the
+        keep-probability and alias-sampler arrays must cover the admitted
+        rows or new words would never be subsample-gated or drawn as
+        negatives. The jit step is rebuilt (the tables are captured
+        constants), costing one recompile at the boundary — growth is
+        rare, and the boundary is a sync boundary anyway. Embedding-table
+        params are NOT touched: reserved rows were initialized at
+        init_params time and keep their exact bits through admission
+        (pinned by tests/test_stream.py)."""
+        self.tables = DeviceTables.build(self.vocab, self.config)
+        self._resident = None
+        self._resident_cache = None
+        self._resident_ready = False
+        self._build_step()
 
     def _init_params(self, key: jax.Array) -> Params:
         return init_params(self.config, len(self.vocab), key)
@@ -491,6 +540,12 @@ class Trainer:
         """Called once after the last epoch (sharded: final sync)."""
 
     # ----------------------------------------------------------------- api
+    @property
+    def run_seed(self) -> int:
+        """The seed the CURRENT train() call's shuffle/draw streams derive
+        from (config.seed + seed_offset; see the seed_offset class note)."""
+        return int(self.config.seed) + int(self.seed_offset)
+
     def init_state(self, seed: Optional[int] = None) -> TrainState:
         key = jax.random.key(
             self.config.seed if seed is None else seed,
@@ -569,11 +624,14 @@ class Trainer:
             # dispatch — the --inject-nan semantics, generalized
             self.fault_plan.on_step(state, self)
         batcher = BatchIterator(
-            self.corpus, cfg.batch_rows, cfg.max_sentence_len, seed=cfg.seed
+            self.corpus, cfg.batch_rows, cfg.max_sentence_len,
+            seed=self.run_seed,
         )
         # the root of the device draw streams; impl comes from the config so
         # checkpoints pin it and a resumed run keeps one consistent stream
-        base_key = jax.random.key(cfg.seed ^ 0x5EED, impl=cfg.jax_prng_impl)
+        base_key = jax.random.key(
+            self.run_seed ^ 0x5EED, impl=cfg.jax_prng_impl
+        )
 
         t0 = time.perf_counter()
         loss_hist: List[float] = []
@@ -899,6 +957,11 @@ class Trainer:
 
         cfg = self.config
         if cfg.resident == "off":
+            return None
+        if cfg.corpus_mode == "streaming":
+            # segments replace each other — pinning one in HBM would train
+            # the same segment forever (resident='on' is already rejected
+            # at config validation; 'auto' resolves off here)
             return None
         if not self.supports_resident:
             if cfg.resident == "on":

@@ -421,6 +421,13 @@ def resolve_plan(
         shared_negatives=config.shared_negatives,
         band_backend=config.band_backend,
     )
+    if config.corpus_mode == "streaming":
+        # corpus_mode is a plan dimension: the streaming data plane's host
+        # is also reading/tokenizing shards, so prefetch depth and chunk
+        # shapes trade differently — streaming runs get their own cached
+        # plans. Appended (not a new positional key part) so every banked
+        # resident-plan key stays valid.
+        key += "+stream"
     fp = config_fingerprint(config)
 
     if mode == "cached":

@@ -296,6 +296,35 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         slo=SloEvaluator(slo_rules) if slo_rules else None,
     )
 
+    # Device-truth observability (obs/devmem.py + obs/harvest.py): the HBM
+    # memory ledger (per-phase watermarks — init / table placement / the
+    # measured epoch — banked as `device_memory`; statless CPU backends
+    # degrade to available=false, never a crash) and the compiled-program
+    # cost harvest (XLA's own FLOPs/bytes/temp/code-size per executable,
+    # banked as `cost_harvest` and fed to the anchor-drift gate below).
+    from word2vec_tpu.obs.devmem import MemoryLedger, table_row_bytes
+    from word2vec_tpu.obs.harvest import CostHarvest
+
+    mem_ledger = MemoryLedger(
+        sample_every=max(1, S), flight=flight,
+        row_bytes=table_row_bytes(cfg),
+    )
+    mem_ledger.sample("init")
+    harvest = CostHarvest()
+
+    # Bounded profiler window over the measured epoch (--profile-steps A:B;
+    # obs/profiler.py): the capture manifest lands in --profile-dir next to
+    # the banked record's trace artifacts.
+    prof_capture = None
+    if args.profile_steps:
+        from word2vec_tpu.obs.profiler import ProfilerCapture
+
+        a_s, _, b_s = args.profile_steps.partition(":")
+        prof_capture = ProfilerCapture(
+            args.profile_dir or "bench_profile", flight=flight,
+        )
+        prof_capture.schedule(int(a_s), int(b_s))
+
     from word2vec_tpu.ops import resident as res
 
     streaming = args.corpus_mode == "streaming"
@@ -312,6 +341,10 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         order_dev = jnp.asarray(order.astype(np.int32))
         spe = len(step_words)
 
+        harvest.capture(
+            "resident_chunk", chunk_fn,
+            (params, corpus_dev, order_dev, base_key, 0, spe, alphas),
+        )
         params, m = chunk_fn(  # warmup / compile (no-op pad steps)
             params, corpus_dev, order_dev, base_key, 0, spe, alphas
         )
@@ -329,7 +362,11 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
 
         # warmup / compile on a throwaway chunk
         warm = next(chunk_batches(batcher.epoch(), S))
-        params, m = chunk_fn(params, jnp.asarray(warm[0]), base_key, 0, alphas)
+        warm_dev = jnp.asarray(warm[0])
+        harvest.capture(
+            "train_chunk", chunk_fn, (params, warm_dev, base_key, 0, alphas)
+        )
+        params, m = chunk_fn(params, warm_dev, base_key, 0, alphas)
         jax.block_until_ready(params)
 
         def place(np_chunk):
@@ -405,6 +442,8 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     # prime the window clock at the measurement start so even a one-chunk
     # --smoke epoch closes a window (the trainers' first boundary opens)
     signals.on_boundary(0, 0)
+    # tables + warmup buffers are placed: the table-placement watermark
+    mem_ledger.sample("table_place")
     for chunk_words, dispatch in phases.timed_iter(dispatches(), "batcher_wait"):
         with phases.span("dispatch"):
             params, m = dispatch(params, steps)
@@ -420,6 +459,9 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         flight.note_step(steps, t_chunk, now - t_chunk, kind="chunk", steps=S)
         t_chunk = now
         signals.on_boundary(steps, words)
+        mem_ledger.on_boundary(steps)
+        if prof_capture is not None:
+            prof_capture.on_boundary(steps)
         if qprobe is not None and qprobe.due(steps):
             with phases.span("quality_probe"):
                 qprobe.probe(params, steps)
@@ -430,6 +472,9 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
     dt = time.perf_counter() - t0
     wps = words / dt
     signals.finish(steps, words)
+    if prof_capture is not None:
+        prof_capture.finish(steps)
+    harvest_report = harvest.finalize()
     def sum_device(xs):
         return float(sum(float(np.sum(jax.device_get(x))) for x in xs))
 
@@ -491,6 +536,17 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
 
     trace_summary = _tracediff.summarize(flight.ring.events())
     cost_attribution = _cm.attribution_rows(predicted_est, trace_summary)
+    # Anchor-drift gate (tune/cost_model.cost_calibrate): the measured
+    # device step inverted against the three hand anchors, each banked with
+    # an ok|drift|stale verdict — and any DRIFTED anchor's attribution rows
+    # refused (apply_calibration), so a stale constant cannot bank a
+    # silently-wrong attribution as evidence.
+    cost_calibration = _cm.cost_calibrate(
+        predicted_est, _cm.measured_device_ms(trace_summary)
+    )
+    cost_attribution = _cm.apply_calibration(
+        cost_attribution, cost_calibration
+    )
     if args.trace:
         from word2vec_tpu.obs.trace import chrome_trace_doc, write_trace
 
@@ -545,6 +601,12 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         "phases": phases.report(),
         "trace_summary": trace_summary,
         "cost_attribution": cost_attribution,
+        "cost_calibrate": cost_calibration,
+        # device truth (obs/devmem.py + obs/harvest.py): the measured
+        # epoch's HBM watermarks and XLA's own per-executable costs, in the
+        # same record as the analytic prediction they audit
+        "device_memory": mem_ledger.summary(),
+        "cost_harvest": harvest_report,
         "health": health,
         # the signal plane's windowed view of the measured epoch (and the
         # SLO rule states when --slo was set): fleet-aggregatable evidence
@@ -577,6 +639,22 @@ def run(args: argparse.Namespace, platform_note: str | None) -> dict:
         assert trace_summary["spans"] and trace_summary["steps"] > 0, (
             f"--smoke: empty trace_summary {trace_summary!r}"
         )
+        # device-truth contract (CI devmem job): the ledger and harvest
+        # fields must bank even on statless CPU (available=false, but the
+        # phases and at least one analyzed program are real), and every
+        # anchor must carry a verdict
+        dm = record["device_memory"]
+        assert dm and dm["samples"] > 0 and "train_step" in dm["phases"], (
+            f"--smoke: empty device_memory {dm!r}"
+        )
+        ch = record["cost_harvest"]
+        assert ch and ch["programs_ok"] >= 1, (
+            f"--smoke: cost_harvest analyzed no program: {ch!r}"
+        )
+        cal = record["cost_calibrate"]
+        assert cal and len(cal["anchors"]) == 3 and all(
+            a["verdict"] in ("ok", "drift", "stale") for a in cal["anchors"]
+        ), f"--smoke: bad cost_calibrate {cal!r}"
     if tables.hs_msig is not None:
         # two-tier hs observability: the banked record shows what share of
         # token-weighted path entries the measured dense tier covered, and
@@ -859,6 +937,15 @@ def build_parser() -> argparse.ArgumentParser:
                     "diff two plans with python -m "
                     "word2vec_tpu.obs.tracediff). The in-record "
                     "trace_summary is banked regardless")
+    ap.add_argument("--profile-steps", default="", metavar="A:B",
+                    help="bounded jax.profiler window over the measured "
+                    "epoch (obs/profiler.py): arm at step A, stop at step "
+                    "B, capture manifest (capture_<n>.json) into "
+                    "--profile-dir. The in-record device_memory / "
+                    "cost_harvest fields bank regardless")
+    ap.add_argument("--profile-dir", default="bench_profile", metavar="DIR",
+                    help="where --profile-steps writes its trace + "
+                    "capture manifest")
     ap.add_argument("--smoke", action="store_true",
                     help="CI smoke preset: shrink the synthetic corpus to "
                     "~60s of CPU wall time (still the real pipeline at the "
@@ -1039,6 +1126,11 @@ def main() -> None:
         child_cmd += ["--faults", args.faults]
     if args.trace:
         child_cmd += ["--trace", args.trace]
+    if args.profile_steps:
+        # forwarded outer->inner like every measurement flag (the r4
+        # lesson): the inner child is the process that actually profiles
+        child_cmd += ["--profile-steps", args.profile_steps,
+                      "--profile-dir", args.profile_dir]
     try:
         out = subprocess.run(
             child_cmd, capture_output=True, text=True, timeout=args.run_timeout

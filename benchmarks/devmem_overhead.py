@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Measure the device-truth observability overhead on the CPU drill shape.
+
+The contract (obs/devmem.py + obs/harvest.py + obs/profiler.py) is the same
+standing one as trace/watchdog/signals/quality before it: the per-boundary
+work is an integer compare (ledger cadence), a None-check pair (idle
+profiler), and one set lookup (harvest capture latch) — zero device
+dispatches on non-sample boundaries; the ledger SAMPLE is one host-side
+client call per local device on its cadence, and the harvest's
+lower+compile runs once, AFTER the measured loop. This harness pins the
+<1% wall number the PR 5/6/9/11 way: train the same synthetic shape with
+the full wiring attached (ledger at the default cadence, harvest capturing,
+an idle profiler armed for SIGUSR2) and detached, order-fair alternating
+reps, median wall; then time the per-boundary beats directly.
+
+One JSON line to stdout (bank as benchmarks/DEVMEM_OVERHEAD_cpu.json):
+    python benchmarks/devmem_overhead.py [--tokens 200000] [--reps 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=200_000)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--batch-rows", type=int, default=64)
+    ap.add_argument("--sample-every", type=int, default=50)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    from word2vec_tpu.config import Word2VecConfig
+    from word2vec_tpu.data.batcher import PackedCorpus
+    from word2vec_tpu.obs.devmem import MemoryLedger, table_row_bytes
+    from word2vec_tpu.obs.harvest import CostHarvest
+    from word2vec_tpu.obs.profiler import ProfilerCapture
+    from word2vec_tpu.train import Trainer
+    from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=5, word_dim=args.dim,
+        window=5, batch_rows=args.batch_rows, max_sentence_len=192,
+        min_count=1, iters=1, seed=0,
+        chunk_steps=1,  # per-step boundaries: the worst case for beat count
+    )
+    vocab = zipf_vocab(71000, 17_000_000)
+    flat = np.concatenate(zipf_corpus_ids(vocab, args.tokens, seed=0))
+    ids = [flat[i:i + 1000] for i in range(0, len(flat), 1000)]
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    trainer = Trainer(cfg, vocab, corpus)
+    tmp = tempfile.mkdtemp(prefix="w2v_devmem_overhead_")
+
+    def wire(on: bool):
+        if on:
+            trainer.devmem = MemoryLedger(
+                sample_every=args.sample_every,
+                flight=trainer.flight,
+                row_bytes=table_row_bytes(cfg),
+            )
+            trainer.harvest = CostHarvest()
+            trainer.profiler = ProfilerCapture(tmp)  # idle: never armed
+        else:
+            trainer.devmem = None
+            trainer.harvest = None
+            trainer.profiler = None
+
+    def timed_run(wired: bool):
+        wire(wired)
+        t0 = time.perf_counter()
+        _, rep = trainer.train(state=trainer.init_state(), log_every=0)
+        wall = time.perf_counter() - t0
+        if wired:
+            # the harvest's one-time analysis runs after the loop in
+            # production (cli/bench finalize there too) — include it in the
+            # wired wall so the banked number is the WHOLE cost
+            trainer.harvest.finalize()
+        return time.perf_counter() - t0, wall, rep
+
+    timed_run(True)  # warmup: compile out of the measurement
+    base_walls, wired_walls, wired_loop_walls, steps, samples = [], [], [], 0, 0
+    for i in range(args.reps):
+        # ORDER-FAIR alternation (the signal_overhead.py discipline): the
+        # second run of a back-to-back pair is systematically slower on
+        # this host; flipping the order per rep cancels the bias
+        for wired in ((False, True) if i % 2 == 0 else (True, False)):
+            total, loop, rep = timed_run(wired)
+            if wired:
+                wired_walls.append(total)
+                wired_loop_walls.append(loop)
+                samples = (rep.device_memory or {}).get("samples", 0)
+            else:
+                base_walls.append(total)
+                steps = rep.steps
+
+    # per-boundary microcosts: the in-suite contract test enforces these
+    # (the wall A/B straddles zero inside host noise on the shared bench
+    # host, exactly like the signal plane's)
+    _, _, rep = timed_run(False)
+    step_durs_ms = sorted(
+        e["dur"] / 1e3
+        for e in trainer.flight.ring.events()
+        if e.get("ph") == "X" and e["name"] == "step"
+    )
+    p50_step_ms = step_durs_ms[len(step_durs_ms) // 2]
+    ledger = MemoryLedger(sample_every=10_000_000)  # beat cost only
+    ledger.on_boundary(0)  # consume the first-boundary sample
+    n = 100_000
+    t0 = time.perf_counter()
+    for i in range(1, n + 1):
+        ledger.on_boundary(i)
+    per_beat_us = 1e6 * (time.perf_counter() - t0) / n
+    idle_prof = ProfilerCapture(tmp)
+    t0 = time.perf_counter()
+    for i in range(n):
+        idle_prof.on_boundary(i)
+    per_prof_us = 1e6 * (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    sample_reps = 200
+    for _ in range(sample_reps):
+        ledger.sample("train_step")
+    per_sample_ms = 1e3 * (time.perf_counter() - t0) / sample_reps
+
+    base = statistics.median(base_walls)
+    wired = statistics.median(wired_walls)
+    overhead_pct = 100.0 * (wired - base) / base
+    min_overhead_pct = 100.0 * (min(wired_walls) - min(base_walls)) / min(
+        base_walls
+    )
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": f"device-truth observability overhead "
+                  f"({args.tokens // 1000}k zipf, {dev.platform})",
+        "value": round(overhead_pct, 2),
+        "unit": "% wall",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "steps_per_run": steps,
+        "ledger_samples_per_run": samples,
+        "sample_every": args.sample_every,
+        "reps": args.reps,
+        "base_wall_s": [round(w, 3) for w in base_walls],
+        "wired_wall_s": [round(w, 3) for w in wired_walls],
+        "wired_loop_wall_s": [round(w, 3) for w in wired_loop_walls],
+        "median_base_s": round(base, 3),
+        "median_wired_s": round(wired, 3),
+        "min_overhead_pct": round(min_overhead_pct, 2),
+        "p50_step_ms": round(p50_step_ms, 3),
+        "ledger_beat_us": round(per_beat_us, 3),
+        "ledger_beat_pct_of_step": round(
+            100.0 * per_beat_us / (1e3 * p50_step_ms), 4
+        ),
+        "profiler_idle_beat_us": round(per_prof_us, 3),
+        "ledger_sample_ms": round(per_sample_ms, 4),
+        # one sample amortizes over `sample_every` steps
+        "ledger_sample_pct_of_cadence": round(
+            100.0 * per_sample_ms / (args.sample_every * p50_step_ms), 4
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

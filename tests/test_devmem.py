@@ -1,0 +1,746 @@
+"""Device-truth observability (obs/devmem.py + obs/harvest.py +
+obs/profiler.py + tune/cost_model.cost_calibrate).
+
+Pins the ISSUE-15 contracts: the HBM ledger's worst-device merge, phase
+attribution, present-from-zero statless degrade and growth forecast; the
+zero-added-device-fetch beat (ledger + harvest latch + idle profiler); the
+compiled-program harvest's aval capture surviving buffer donation, its
+structural per-program degrade, and the ShardedTrainer / Pallas-interpret
+paths; bounded breach-triggered profiler captures (one per episode,
+cooldown-gated, schema-checked manifests, error-path manifests); the
+SIGUSR2 on-demand window; and the anchor-drift calibration's round-trip /
+counterfactual-flip / refusal semantics.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from word2vec_tpu.config import Word2VecConfig
+from word2vec_tpu.data.batcher import PackedCorpus
+from word2vec_tpu.obs import devmem as devmem_mod
+from word2vec_tpu.obs.devmem import (
+    FAKE_STATS_ENV, MemoryLedger, device_memory_stats, headroom_fraction,
+    table_row_bytes,
+)
+from word2vec_tpu.obs.export import MetricsHub, PrometheusTextfile
+from word2vec_tpu.obs.harvest import CostHarvest, _normalize_cost
+from word2vec_tpu.obs.profiler import ProfilerCapture, validate_capture_doc
+from word2vec_tpu.obs.signals import SignalBus, SignalEngine
+from word2vec_tpu.train import Trainer
+from word2vec_tpu.tune import cost_model as cm
+from word2vec_tpu.utils.synthetic import zipf_corpus_ids, zipf_vocab
+
+
+def _setup(**kw):
+    kw.setdefault("iters", 2)
+    cfg = Word2VecConfig(
+        model="sg", train_method="ns", negative=3, word_dim=16, window=2,
+        batch_rows=4, max_sentence_len=16, min_count=1, seed=9, **kw,
+    )
+    vocab = zipf_vocab(40, 4000)
+    ids = zipf_corpus_ids(vocab, 3000, seed=5)
+    corpus = PackedCorpus.pack(ids, cfg.max_sentence_len)
+    return cfg, vocab, corpus
+
+
+class _StubDevice:
+    """A device whose memory_stats we control (and can count)."""
+
+    def __init__(self, stats):
+        self._stats = stats
+        self.calls = 0
+
+    def memory_stats(self):
+        self.calls += 1
+        return self._stats
+
+
+# ----------------------------------------------------------- stats funnel
+class TestDeviceMemoryStats:
+    def test_cpu_backend_reports_none(self, monkeypatch):
+        monkeypatch.delenv(FAKE_STATS_ENV, raising=False)
+        # the CPU test backend has no memory_stats — the canonical degrade
+        assert device_memory_stats(jax.local_devices()[0]) is None
+
+    def test_stub_device_normalizes(self):
+        s = device_memory_stats(_StubDevice(
+            {"bytes_in_use": 10, "peak_bytes_in_use": 20, "bytes_limit": 100,
+             "largest_free_block_bytes": 999}
+        ))
+        assert s == {
+            "bytes_in_use": 10, "peak_bytes_in_use": 20, "bytes_limit": 100,
+        }
+
+    def test_raising_device_degrades_to_none(self):
+        class Bad:
+            def memory_stats(self):
+                raise RuntimeError("unaddressable")
+
+        assert device_memory_stats(Bad()) is None
+
+    def test_fake_env_overrides(self, monkeypatch):
+        monkeypatch.setenv(
+            FAKE_STATS_ENV, "bytes_limit=100,bytes_in_use=40"
+        )
+        s = device_memory_stats(jax.local_devices()[0])
+        assert s == {"bytes_limit": 100, "bytes_in_use": 40}
+        assert headroom_fraction(s) == pytest.approx(0.6)
+
+    def test_resident_budget_probe_shares_the_funnel(self, monkeypatch):
+        """Satellite: ops/resident.py's budget gate reads the SAME stats
+        funnel as the ledger — the fake hook moves both or neither."""
+        from word2vec_tpu.ops import resident as res
+
+        monkeypatch.setenv(
+            FAKE_STATS_ENV, "bytes_limit=1000000,bytes_in_use=200000"
+        )
+        # free = 800k, halved for workspace
+        assert res.resident_budget_bytes() == 400_000
+
+    def test_row_bytes_both_dtypes(self):
+        cfg, _, _ = _setup()
+        assert table_row_bytes(cfg) == 2 * 16 * 4
+        cfg_bf = dataclasses.replace(cfg, dtype="bfloat16")
+        assert table_row_bytes(cfg_bf) == 2 * 16 * 2
+
+
+# ---------------------------------------------------------------- ledger
+class TestMemoryLedger:
+    def test_worst_device_merge(self):
+        """Multi-device rows take the WORST device: max in_use/peak, min
+        limit — per-rank attribution reports the device about to OOM."""
+        led = MemoryLedger(devices=[
+            _StubDevice({"bytes_in_use": 10, "peak_bytes_in_use": 15,
+                         "bytes_limit": 100}),
+            _StubDevice({"bytes_in_use": 60, "peak_bytes_in_use": 70,
+                         "bytes_limit": 90}),
+        ])
+        row = led.sample("train_step", step=3)
+        assert row["mem_bytes_in_use"] == 60
+        assert row["mem_peak_bytes"] == 70
+        assert row["mem_bytes_limit"] == 90
+        assert row["mem_headroom_frac"] == pytest.approx(30 / 90)
+        assert led.available
+
+    def test_statless_degrade_present_from_zero(self, monkeypatch, tmp_path):
+        """CPU (no stats): the row still emits, zeroed, mem_available=0 —
+        and the Prometheus sink renders the gauges from zero."""
+        monkeypatch.delenv(FAKE_STATS_ENV, raising=False)
+        prom = PrometheusTextfile(str(tmp_path / "m.prom"))
+        hub = MetricsHub(prom)
+        led = MemoryLedger(log_fn=hub)
+        row = led.sample("init")
+        assert row["mem_available"] == 0
+        assert row["mem_bytes_in_use"] == 0
+        assert "mem_headroom_frac" not in row
+        assert not led.available
+        text = open(str(tmp_path / "m.prom")).read()
+        assert "w2v_mem_bytes_in_use 0.0" in text
+        assert "w2v_mem_available 0.0" in text
+        # no crash anywhere, and the summary says why the zeros are zeros
+        assert led.summary()["available"] is False
+
+    def test_phase_watermarks_and_summary(self):
+        dev = _StubDevice({"bytes_in_use": 50, "peak_bytes_in_use": 80,
+                           "bytes_limit": 200})
+        led = MemoryLedger(devices=[dev])
+        led.sample("init")
+        dev._stats = {"bytes_in_use": 120, "peak_bytes_in_use": 150,
+                      "bytes_limit": 200}
+        led.sample("vocab_growth")
+        s = led.summary()
+        assert s["phases"]["init"]["peak_bytes_max"] == 80
+        assert s["phases"]["vocab_growth"]["peak_bytes_max"] == 150
+        assert s["peak_bytes"] == 150
+        assert s["headroom_frac_min"] == pytest.approx(80 / 200)
+
+    def test_boundary_cadence_counts_client_calls(self):
+        """Non-sample boundaries are one integer compare: the stub device
+        is consulted exactly once per cadence window."""
+        dev = _StubDevice({"bytes_in_use": 1, "bytes_limit": 10})
+        led = MemoryLedger(sample_every=10, devices=[dev])
+        for step in range(35):
+            led.on_boundary(step)
+        # first boundary samples, then steps 10/20/30
+        assert dev.calls == 4
+        assert led.phases["train_step"]["samples"] == 4
+
+    def test_growth_forecast(self):
+        led = MemoryLedger(
+            devices=[_StubDevice(
+                {"bytes_in_use": 400, "bytes_limit": 1000}
+            )],
+            row_bytes=100, vocab_reserve=3,
+        )
+        row = led.sample("table_place")
+        assert row["mem_growth_rows_remaining"] == 6
+        fc = led.forecast()
+        assert fc["rows_remaining"] == 6
+        assert fc["reserve_bytes"] == 300
+        assert fc["reserve_fits"] is True
+
+    def test_dump_writes_ledger_doc(self, tmp_path):
+        led = MemoryLedger(devices=[_StubDevice(
+            {"bytes_in_use": 5, "bytes_limit": 10}
+        )])
+        led.sample("init")
+        path = led.dump(str(tmp_path / "mem.json"), reason="sigusr2")
+        doc = json.load(open(path))
+        assert doc["reason"] == "sigusr2"
+        assert doc["rows"][0]["mem_bytes_in_use"] == 5
+
+    def test_activate_slot(self):
+        led = MemoryLedger(devices=[_StubDevice(
+            {"bytes_in_use": 5, "bytes_limit": 10}
+        )])
+        prev = devmem_mod.activate(led)
+        try:
+            row = devmem_mod.sample_active("serve_swap")
+            assert row["phase"] == "serve_swap"
+        finally:
+            devmem_mod.activate(prev)
+        assert led.phases["serve_swap"]["samples"] == 1
+
+
+# -------------------------------------------------------- signal plumbing
+class TestMemSignals:
+    def test_engine_harvests_available_mem_rows(self):
+        eng = SignalEngine(window=4)
+        eng({"event": "mem", "mem_available": 1,
+             "mem_headroom_frac": 0.25, "mem_peak_bytes": 512})
+        eng.on_boundary(0, 0)
+        eng.on_boundary(4, 400)
+        eng.on_boundary(8, 800)
+        stats = eng.signal_stats()
+        assert stats["mem_headroom_frac"]["last"] == pytest.approx(0.25)
+        assert stats["mem_peak_bytes"]["last"] == 512
+
+    def test_engine_ignores_statless_rows(self):
+        """A zeroed unavailable row must NOT read as a full device and
+        breach every headroom SLO."""
+        eng = SignalEngine(window=4)
+        eng({"event": "mem", "mem_available": 0, "mem_bytes_in_use": 0})
+        eng.on_boundary(0, 0)
+        eng.on_boundary(4, 400)
+        eng.on_boundary(8, 800)
+        assert "mem_headroom_frac" not in eng.signal_stats()
+
+    def test_mem_slo_breaches_like_any_rule(self):
+        from word2vec_tpu.obs.slo import SloEvaluator, parse_slo
+
+        eng = SignalEngine(
+            window=2,
+            slo=SloEvaluator(parse_slo("mem_headroom_frac<0.1:for=2")),
+        )
+        events = []
+        eng.bus.subscribe("slo", events.append)
+        eng({"event": "mem", "mem_available": 1, "mem_headroom_frac": 0.02})
+        for step in range(0, 13, 2):
+            eng.on_boundary(step, step * 10)
+        kinds = [e["event"] for e in events]
+        assert "slo_warn" in kinds and "slo_breach" in kinds
+
+    def test_fleet_merge_names_worst_memory_host(self):
+        from word2vec_tpu.obs.fleet import fleet_doc, merge_rows
+
+        rows = [
+            {"event": "signals", "window": 1, "host": 0,
+             "signal_mem_headroom_frac": 0.5,
+             "signal_mem_peak_bytes": 100.0},
+            {"event": "signals", "window": 1, "host": 1,
+             "signal_mem_headroom_frac": 0.05,
+             "signal_mem_peak_bytes": 900.0},
+        ]
+        merged = merge_rows(rows)
+        assert merged[0]["mem_headroom_frac_min"] == pytest.approx(0.05)
+        assert merged[0]["mem_worst_host"] == 1
+        assert merged[0]["mem_peak_bytes_max"] == 900.0
+        rec = __import__(
+            "word2vec_tpu.obs.fleet", fromlist=["FleetAggregator"]
+        ).FleetAggregator.gauge_record(fleet_doc(merged))
+        assert rec["fleet_mem_headroom_frac"] == pytest.approx(0.05)
+        assert rec["fleet_mem_worst_host"] == 1
+
+    def test_watch_renders_memory_rows(self):
+        from word2vec_tpu.obs.fleet import fleet_doc, merge_rows
+        from word2vec_tpu.obs.watch import render
+
+        rows = [{"event": "signals", "window": 1, "host": 2,
+                 "signal_mem_headroom_frac": 0.07,
+                 "signal_mem_peak_bytes": 123.0}]
+        out = render(fleet_doc(merge_rows(rows)))
+        assert "mem_headroom" in out
+        assert "mem worst host   host 2" in out
+
+
+# --------------------------------------------------------------- trainer
+class TestTrainerLedger:
+    def test_e2e_rows_flight_and_report(self, monkeypatch):
+        monkeypatch.setenv(
+            FAKE_STATS_ENV,
+            "bytes_limit=1000000,bytes_in_use=300000,"
+            "peak_bytes_in_use=400000",
+        )
+        cfg, vocab, corpus = _setup(chunk_steps=1)
+        t = Trainer(cfg, vocab, corpus)
+        t.devmem = MemoryLedger(
+            sample_every=8, flight=t.flight, row_bytes=table_row_bytes(cfg),
+        )
+        state, rep = t.train(log_every=0)
+        dm = rep.device_memory
+        assert dm["available"] is True
+        assert dm["phases"]["table_place"]["samples"] == 1
+        assert dm["phases"]["train_step"]["samples"] >= 2
+        assert dm["peak_bytes"] == 400000
+        assert dm["growth_forecast"]["rows_remaining"] == 700000 // (2 * 16 * 4)
+        # the flight dump carries the memory ring
+        snap = t.flight.snapshot("test")
+        mems = snap["memory"]
+        assert mems and all(r["event"] == "mem" for r in mems)
+
+    def test_vocab_growth_phase_sampled(self, monkeypatch):
+        monkeypatch.setenv(
+            FAKE_STATS_ENV, "bytes_limit=1000,bytes_in_use=100"
+        )
+        cfg, vocab, corpus = _setup()
+        t = Trainer(cfg, vocab, corpus)
+        t.devmem = MemoryLedger()
+        t.refresh_vocab_tables()
+        assert t.devmem.phases["vocab_growth"]["samples"] == 1
+
+    def test_no_added_device_get(self, monkeypatch):
+        """Dispatch-count pin: ledger + harvest latch + idle profiler add
+        ZERO device fetches to the boundary (the signals/watchdog bound)."""
+        cfg, vocab, corpus = _setup(chunk_steps=1)
+        t = Trainer(cfg, vocab, corpus)
+        t.devmem = MemoryLedger(sample_every=8)
+        t.harvest = CostHarvest()
+        t.profiler = ProfilerCapture("/tmp/unused_devmem_prof")
+        calls = {"n": 0}
+        real = jax.device_get
+
+        def counted(x):
+            calls["n"] += 1
+            return real(x)
+
+        monkeypatch.setattr(jax, "device_get", counted)
+        state, rep = t.train(log_every=0)
+        assert calls["n"] <= rep.steps + 2
+        assert rep.device_memory["samples"] > 0
+
+    def test_overhead_contract(self):
+        """Satellite acceptance: per-boundary microcosts < 1% of the run's
+        own p50 step time (the banked artifact is
+        benchmarks/DEVMEM_OVERHEAD_cpu.json via devmem_overhead.py)."""
+        cfg, vocab, corpus = _setup(chunk_steps=1)
+        t = Trainer(cfg, vocab, corpus)
+        state, rep = t.train(log_every=0)
+        step_ms = sorted(
+            e["dur"] / 1e3 for e in t.flight.ring.events()
+            if e.get("ph") == "X" and e["name"] == "step"
+        )
+        p50_ms = step_ms[len(step_ms) // 2]
+        led = MemoryLedger(sample_every=10_000_000)
+        led.on_boundary(0)
+        prof = ProfilerCapture("/tmp/unused_devmem_prof2")
+        n = 20_000
+        t0 = time.perf_counter()
+        for i in range(1, n + 1):
+            led.on_boundary(i)
+            prof.on_boundary(i)
+        per_beat_us = 1e6 * (time.perf_counter() - t0) / n
+        assert per_beat_us < 0.01 * p50_ms * 1e3, (
+            f"boundary beat {per_beat_us:.2f}us vs p50 step {p50_ms:.2f}ms"
+        )
+
+
+# --------------------------------------------------------------- harvest
+class TestCostHarvest:
+    def test_normalize_both_shapes(self):
+        assert _normalize_cost([{"flops": 2.0, "bytes accessed": 4.0}]) == {
+            "flops": 2.0, "bytes_accessed": 4.0,
+        }
+        assert _normalize_cost({"flops": 3.0}) == {"flops": 3.0}
+        assert _normalize_cost(None) == {}
+
+    def test_capture_finalize_simple_jit(self):
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: jnp.sin(x) @ x.T)
+        x = jnp.ones((32, 32))
+        h = CostHarvest()
+        h.capture("toy", f, (x,))
+        rep = h.finalize()
+        row = rep["programs"][0]
+        assert row["program"] == "toy" and row["ok"]
+        assert row["flops"] > 0 and row["bytes_accessed"] > 0
+        assert rep["totals"]["flops"] == row["flops"]
+        assert rep["programs_ok"] == 1
+
+    def test_capture_survives_donation(self):
+        """The capture holds avals, not arrays: donating (and deleting)
+        the captured buffers before finalize() must not matter."""
+        import jax.numpy as jnp
+
+        f = jax.jit(lambda x: x * 2, donate_argnums=0)
+        x = jnp.ones((16,))
+        h = CostHarvest()
+        h.capture("donated", f, (x,))
+        f(x)  # consumes x
+        rep = h.finalize()
+        assert rep["programs"][0]["ok"]
+
+    def test_idempotent_per_name(self):
+        f = jax.jit(lambda x: x + 1)
+        h = CostHarvest()
+        h.capture("p", f, (np.float32(1.0),))
+        assert not h.want("p")
+        h.capture("p", f, (np.float32(2.0),))  # ignored
+        rep = h.finalize()
+        assert len(rep["programs"]) == 1
+
+    def test_failing_program_degrades_structurally(self):
+        h = CostHarvest()
+        h.capture("broken", object(), (1,))  # no .lower
+        rep = h.finalize()
+        row = rep["programs"][0]
+        assert row["ok"] is False and "error" in row
+        assert rep["programs_failed"] == 1
+
+    def test_trainer_e2e_per_step_and_chunked(self):
+        cfg, vocab, corpus = _setup(chunk_steps=1)
+        t = Trainer(cfg, vocab, corpus)
+        t.harvest = CostHarvest()
+        t.train(log_every=0)
+        rep = t.harvest.finalize()
+        names = [p["program"] for p in rep["programs"]]
+        assert names == ["train_step"]
+        assert rep["programs"][0]["ok"]
+
+        cfg2, vocab2, corpus2 = _setup(chunk_steps=4, resident="off")
+        t2 = Trainer(cfg2, vocab2, corpus2)
+        t2.harvest = CostHarvest()
+        t2.train(log_every=0)
+        rep2 = t2.harvest.finalize()
+        names2 = [p["program"] for p in rep2["programs"]]
+        assert names2 == ["train_chunk"]
+        assert rep2["programs"][0]["ok"]
+
+    def test_trainer_e2e_resident(self):
+        cfg, vocab, corpus = _setup(chunk_steps=4, resident="on")
+        t = Trainer(cfg, vocab, corpus)
+        t.harvest = CostHarvest()
+        t.train(log_every=0)
+        rep = t.harvest.finalize()
+        names = [p["program"] for p in rep["programs"]]
+        assert names == ["resident_chunk"]
+        assert rep["programs"][0]["ok"]
+
+    def test_pallas_interpret_path(self):
+        """The harvest walks a pallas_oa (interpret-mode) program without
+        special-casing: either the analysis banks, or the row degrades
+        structurally — never a crash."""
+        cfg, vocab, corpus = _setup(
+            chunk_steps=1, band_backend="pallas_oa", kernel="band",
+            band_chunk=8,  # short test rows resolve dense without it
+        )
+        t = Trainer(cfg, vocab, corpus)
+        t.harvest = CostHarvest()
+        t.train(log_every=0)
+        rep = t.harvest.finalize()
+        row = rep["programs"][0]
+        assert row["program"] == "train_step"
+        assert row.get("ok") or "error" in row
+
+    def test_sharded_trainer_per_rank_attribution(self):
+        from word2vec_tpu.parallel import ShardedTrainer
+
+        cfg, vocab, corpus = _setup(chunk_steps=1)
+        t = ShardedTrainer(cfg, vocab, corpus, dp=2, tp=2)
+        t.harvest = CostHarvest(host=jax.process_index())
+        t.devmem = MemoryLedger(sample_every=8)
+        state, rep = t.train(log_every=0)
+        hrep = t.harvest.finalize()
+        names = [p["program"] for p in hrep["programs"]]
+        assert "train_step" in names
+        assert "replica_sync" in names
+        for p in hrep["programs"]:
+            assert p.get("ok") or "error" in p
+        assert hrep["host"] == jax.process_index()
+        # the ledger rode the same boundaries (statless CPU: zero rows,
+        # but the per-rank plumbing held)
+        assert rep.device_memory["samples"] > 0
+
+    def test_gauge_record(self):
+        f = jax.jit(lambda x: x + 1)
+        h = CostHarvest()
+        h.capture("p", f, (np.zeros((4,), np.float32),))
+        h.finalize()
+        rec = h.gauge_record()
+        assert rec["event"] == "cost_harvest"
+        assert rec["cost_harvest_programs"] == 1
+
+
+# -------------------------------------------------------------- profiler
+class TestProfilerCapture:
+    def _drive(self, cap, start, n):
+        for s in range(start, start + n):
+            cap.on_boundary(s)
+
+    def test_request_arms_and_bounds(self, tmp_path):
+        cap = ProfilerCapture(str(tmp_path), steps=4, cooldown_s=0.0)
+        cap.on_boundary(0)  # idle: nothing
+        assert cap.request("unit_test")
+        cap.on_boundary(10)  # arms here
+        assert cap.active
+        self._drive(cap, 11, 2)
+        assert cap.active  # inside the budget
+        cap.on_boundary(14)  # 10 + 4 reached: stops
+        assert not cap.active
+        doc = json.load(open(cap.manifests[0]))
+        counts = validate_capture_doc(doc)
+        assert doc["reason"] == "unit_test"
+        assert doc["armed_step"] == 10 and doc["stopped_step"] == 14
+        assert counts["steps"] == 4
+        # a real jax trace landed on the CPU backend
+        assert doc["status"] == "ok" and doc["files"]
+
+    def test_one_capture_per_breach_episode_with_cooldown(self, tmp_path):
+        cap = ProfilerCapture(str(tmp_path), steps=2, cooldown_s=3600.0)
+        bus = SignalBus()
+        cap.attach(bus)
+        bus.publish("slo", {"event": "slo_breach", "rule": "r1"})
+        bus.publish("slo", {"event": "slo_warn", "rule": "r1"})  # ignored
+        cap.on_boundary(5)
+        self._drive(cap, 6, 3)
+        assert cap.captures == 1 and not cap.active
+        # second episode inside the cooldown: suppressed, not captured
+        bus.publish("slo", {"event": "slo_breach", "rule": "r1"})
+        self._drive(cap, 10, 5)
+        assert cap.captures == 1
+        assert cap.suppressed >= 1
+
+    def test_scheduled_window(self, tmp_path):
+        cap = ProfilerCapture(str(tmp_path), steps=99)
+        cap.schedule(6, 9)
+        self._drive(cap, 0, 6)
+        assert not cap.active
+        cap.on_boundary(6)
+        assert cap.active
+        cap.on_boundary(9)
+        assert not cap.active
+        doc = json.load(open(cap.manifests[0]))
+        validate_capture_doc(doc)
+        assert doc["reason"] == "scheduled"
+        assert (doc["armed_step"], doc["stopped_step"]) == (6, 9)
+
+    def test_finish_stops_mid_window(self, tmp_path):
+        cap = ProfilerCapture(str(tmp_path), steps=100, cooldown_s=0.0)
+        cap.request("unit_test")
+        cap.on_boundary(1)
+        assert cap.active
+        cap.finish(3)
+        assert not cap.active
+        validate_capture_doc(json.load(open(cap.manifests[0])))
+
+    def test_error_path_writes_schema_valid_manifest(self, tmp_path,
+                                                     monkeypatch):
+        def boom(*a, **k):
+            raise RuntimeError("no profiler on this backend")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        cap = ProfilerCapture(str(tmp_path), steps=2, cooldown_s=0.0)
+        cap.request("unit_test")
+        cap.on_boundary(1)
+        assert not cap.active  # failed to arm — but the manifest exists
+        doc = json.load(open(cap.manifests[0]))
+        validate_capture_doc(doc)
+        assert doc["status"] == "error"
+        assert "no profiler" in doc["error"]
+
+    def test_capture_cap(self, tmp_path):
+        cap = ProfilerCapture(str(tmp_path), steps=1, cooldown_s=0.0,
+                              max_captures=2)
+        step = 0
+        for _ in range(4):
+            cap.request("unit_test")
+            cap.on_boundary(step)
+            cap.on_boundary(step + 1)
+            step += 10
+        assert cap.captures == 2 and cap.suppressed == 2
+
+    def test_validate_negatives(self):
+        with pytest.raises(ValueError, match="schema"):
+            validate_capture_doc({"schema": 99})
+        with pytest.raises(ValueError, match="reason"):
+            validate_capture_doc({
+                "schema": 1, "event": "profiler_capture", "reason": "",
+            })
+        with pytest.raises(ValueError, match="status"):
+            validate_capture_doc({
+                "schema": 1, "event": "profiler_capture", "reason": "r",
+                "status": "maybe",
+            })
+        with pytest.raises(ValueError, match="precedes"):
+            validate_capture_doc({
+                "schema": 1, "event": "profiler_capture", "reason": "r",
+                "status": "ok", "armed_step": 5, "stopped_step": 3,
+                "trace_dir": "d", "files": [], "steps_budget": 2,
+            })
+
+    def test_trainer_breach_to_capture_e2e(self, tmp_path, monkeypatch):
+        """The full loop in-process: fake low headroom -> mem SLO breach
+        -> one bounded capture whose manifest passes the schema gate."""
+        from word2vec_tpu.obs.slo import SloEvaluator, parse_slo
+
+        monkeypatch.setenv(
+            FAKE_STATS_ENV, "bytes_limit=1000,bytes_in_use=990"
+        )
+        cfg, vocab, corpus = _setup(chunk_steps=1, iters=4)
+        t = Trainer(cfg, vocab, corpus)
+        eng = SignalEngine(
+            window=4, phases=t.phases, flight=t.flight,
+            slo=SloEvaluator(parse_slo("mem_headroom_frac<0.1:for=2")),
+        )
+        t.signals = eng
+        t.devmem = MemoryLedger(sample_every=2, log_fn=eng)
+        cap = ProfilerCapture(str(tmp_path), steps=3, cooldown_s=3600.0)
+        cap.attach(eng.bus)
+        t.profiler = cap
+        state, rep = t.train(log_every=0)
+        assert cap.captures == 1, (cap.captures, cap.suppressed)
+        doc = json.load(open(cap.manifests[0]))
+        validate_capture_doc(doc)
+        assert doc["reason"].startswith("slo_breach:mem_headroom_frac")
+
+    def test_sigusr2_requests_window_and_dumps_ledger(self, tmp_path):
+        from word2vec_tpu.resilience.shutdown import install_usr2_profile
+
+        led = MemoryLedger(devices=[_StubDevice(
+            {"bytes_in_use": 5, "bytes_limit": 10}
+        )])
+        cap = ProfilerCapture(str(tmp_path), steps=2, cooldown_s=0.0)
+        uninstall = install_usr2_profile(str(tmp_path), cap, led)
+        try:
+            signal.raise_signal(signal.SIGUSR2)
+        finally:
+            uninstall()
+        # the handler only requested; the boundary arms
+        cap.on_boundary(7)
+        assert cap.active
+        cap.on_boundary(9)
+        doc = json.load(open(cap.manifests[0]))
+        validate_capture_doc(doc)
+        assert doc["reason"] == "sigusr2"
+        mem_doc = json.load(open(tmp_path / "mem_usr2.json"))
+        assert mem_doc["reason"] == "sigusr2"
+        assert led.phases["sigusr2"]["samples"] == 1
+
+
+# ------------------------------------------------------------ calibration
+class TestCostCalibrate:
+    def _fused_est(self):
+        """A shape where all three anchor terms are active and material:
+        the pallas_fused flagship geometry (dma_rows > 0 only there)."""
+        cfg = Word2VecConfig(
+            model="sg", train_method="ns", negative=5, word_dim=300,
+            window=5, batch_rows=256, max_sentence_len=192,
+            table_layout="unified", band_backend="pallas_fused",
+            kernel="band",
+        )
+        return cm.predict(cfg, 71000, "TPU v5 lite", "tpu")
+
+    def test_round_trip_reproduces_hand_anchors(self):
+        """Measurement == prediction -> every active anchor verdict ok,
+        implied values equal to the hand constants."""
+        est = self._fused_est()
+        measured = est.step_ms + est.dispatch_ms
+        cal = cm.cost_calibrate(est, measured)
+        by = {a["anchor"]: a for a in cal["anchors"]}
+        assert by["scatter_sec_per_row"]["verdict"] == "ok"
+        assert by["scatter_sec_per_row"]["implied_value"] == pytest.approx(
+            cm.SCATTER_SEC_PER_ROW, rel=1e-6
+        )
+        assert by["program_gap_ms"]["verdict"] == "ok"
+        assert by["dma_sec_per_row"]["verdict"] == "ok"
+        assert cal["verdict"] == "ok" and cal["attribution_trusted"]
+
+    def test_injected_3x_perturbation_flags_drift(self):
+        """Counterfactual pin: a measurement generated with a 3x scatter
+        anchor must flag drift; the SAME calibrate on the unperturbed
+        measurement must not (the flip is the contract)."""
+        est = self._fused_est()
+        clean = est.step_ms + est.dispatch_ms
+        perturbed = clean + 2.0 * est.scatter_ms  # scatter now costs 3x
+        cal_clean = cm.cost_calibrate(est, clean)
+        cal_drift = cm.cost_calibrate(est, perturbed)
+        by_clean = {a["anchor"]: a["verdict"] for a in cal_clean["anchors"]}
+        by_drift = {a["anchor"]: a["verdict"] for a in cal_drift["anchors"]}
+        assert by_clean["scatter_sec_per_row"] == "ok"
+        assert by_drift["scatter_sec_per_row"] == "drift"
+        assert cal_drift["verdict"] == "drift"
+        assert not cal_drift["attribution_trusted"]
+
+    def test_perturbed_constant_vs_true_measurement(self):
+        """The other direction: calibrating with a 3x-inflated anchor
+        against a truthful measurement also reads drift (ratio ~1/3)."""
+        est = self._fused_est()
+        measured = est.step_ms + est.dispatch_ms
+        cal = cm.cost_calibrate(
+            est, measured,
+            anchors={"scatter_sec_per_row": 3 * cm.SCATTER_SEC_PER_ROW},
+        )
+        by = {a["anchor"]: a for a in cal["anchors"]}
+        assert by["scatter_sec_per_row"]["verdict"] == "drift"
+        assert by["scatter_sec_per_row"]["ratio"] < 0.5
+
+    def test_inactive_and_weak_terms_are_stale(self):
+        """dma_rows = 0 on the XLA chain -> stale (no evidence), and a
+        term below the share floor -> stale with the share named."""
+        cfg, vocab, corpus = _setup()
+        est = cm.predict(cfg, len(vocab), "", "cpu")
+        # CPU smoke truth: a huge measured step dwarfs every anchor term
+        cal = cm.cost_calibrate(est, 1e4)
+        by = {a["anchor"]: a for a in cal["anchors"]}
+        assert by["dma_sec_per_row"]["verdict"] == "stale"
+        assert by["scatter_sec_per_row"]["verdict"] == "stale"
+        assert "share" in by["scatter_sec_per_row"]["why"] or (
+            "no signal" in by["scatter_sec_per_row"]["why"]
+        )
+        assert cal["verdict"] == "stale"
+        # stale never breaks trust — only drift does
+        assert cal["attribution_trusted"]
+
+    def test_no_measurement_is_stale(self):
+        est = self._fused_est()
+        cal = cm.cost_calibrate(est, None)
+        assert all(a["verdict"] == "stale" for a in cal["anchors"])
+
+    def test_apply_calibration_refuses_drifted_rows(self):
+        est = self._fused_est()
+        perturbed = est.step_ms + est.dispatch_ms + 2.0 * est.scatter_ms
+        cal = cm.cost_calibrate(est, perturbed)
+        rows = cm.attribution_rows(est, {"spans": {}})
+        out = cm.apply_calibration(rows, cal)
+        scatter = next(r for r in out if r["term"] == "table_scatter")
+        assert scatter["calibration"] == "drift"
+        assert scatter["predicted_ms"] is None
+        assert scatter["predicted_ms_uncalibrated"] is not None
+        assert "refused" in scatter
+        # untouched rows keep their prediction
+        dev = next(r for r in out if r["term"] == "device_step")
+        assert dev.get("predicted_ms") is not None
+
+    def test_measured_device_ms_mapping(self):
+        ts = {"spans": {"dispatch": {"ms_per_step": 3.0},
+                        "device_wait": {"ms_per_step": 1.5},
+                        "batcher_wait": {"ms_per_step": 99.0}}}
+        assert cm.measured_device_ms(ts) == pytest.approx(4.5)
+        assert cm.measured_device_ms({"spans": {}}) is None

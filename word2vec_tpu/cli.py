@@ -463,7 +463,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "tensorboardX)")
     p.add_argument("--profile", metavar="DIR",
                    help="capture a jax.profiler trace of training into DIR "
-                        "(view with tensorboard/xprof)")
+                        "(view with tensorboard/xprof). This traces the "
+                        "WHOLE run; for bounded windows use "
+                        "--profile-steps / --profile-on-breach")
+    p.add_argument("--profile-steps", metavar="A:B", default="",
+                   help="bounded profiler window (obs/profiler.py): arm "
+                        "jax.profiler at step A, stop at step B, and write "
+                        "a schema-checked capture manifest "
+                        "(capture_<n>.json) next to flight.json in "
+                        "--metrics-dir (required)")
+    p.add_argument("--profile-on-breach", type=int, default=0, metavar="N",
+                   help="breach-triggered profiler capture "
+                        "(obs/profiler.py): when an --slo rule enters "
+                        "breach, arm jax.profiler for N step boundaries — "
+                        "one bounded capture per breach episode, "
+                        "cooldown-gated — and dump a capture manifest next "
+                        "to flight.json. Needs --metrics-dir and --slo; "
+                        "SIGUSR2 requests the same bounded window on "
+                        "demand (plus a memory-ledger dump) without "
+                        "stopping the run")
+    p.add_argument("--mem-sample-every", type=int, default=0, metavar="N",
+                   help="HBM memory-ledger cadence (obs/devmem.py; 0 = "
+                        "auto: 50): sample device.memory_stats() every N "
+                        "step boundaries into w2v_mem_* gauges, the "
+                        "mem_headroom_frac derived signal (SLO-able), "
+                        "flight.json, and the manifest's per-phase "
+                        "watermarks + growth-headroom forecast. Non-sample "
+                        "boundaries add zero device dispatches; backends "
+                        "without memory stats (CPU) degrade to "
+                        "present-from-zero gauges")
     p.add_argument("--debug-nans", action="store_true",
                    help="enable jax_debug_nans (SURVEY §5: the batched-update "
                         "analog of a race detector/sanitizer)")
@@ -642,6 +670,47 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     if args.quality_probe_every is not None and args.quality_probe_every < 0:
         print("error: --quality-probe-every must be >= 0", file=sys.stderr)
+        return 1
+    if args.mem_sample_every < 0:
+        print("error: --mem-sample-every must be >= 0", file=sys.stderr)
+        return 1
+    if args.profile_on_breach < 0:
+        print("error: --profile-on-breach must be >= 0", file=sys.stderr)
+        return 1
+    # bounded profiler windows need a manifest destination; parse A:B
+    # before the corpus scan (the --faults/--slo fail-fast contract)
+    profile_window = None
+    if args.profile_steps:
+        try:
+            a_s, _, b_s = args.profile_steps.partition(":")
+            profile_window = (int(a_s), int(b_s))
+        except ValueError:
+            print(
+                f"error: bad --profile-steps {args.profile_steps!r} "
+                "(want A:B, two integer steps)",
+                file=sys.stderr,
+            )
+            return 1
+        if profile_window[1] <= profile_window[0]:
+            print(
+                f"error: --profile-steps window is empty: "
+                f"{args.profile_steps!r}",
+                file=sys.stderr,
+            )
+            return 1
+    if (args.profile_steps or args.profile_on_breach) and not args.metrics_dir:
+        print(
+            "error: --profile-steps/--profile-on-breach write their capture "
+            "manifests into --metrics-dir; set it",
+            file=sys.stderr,
+        )
+        return 1
+    if args.profile_on_breach and not slo_rules:
+        print(
+            "error: --profile-on-breach triggers on --slo breaches; set "
+            "--slo rules (SIGUSR2 windows work without any)",
+            file=sys.stderr,
+        )
         return 1
     # quality-probe cadence: on by default for instrumented runs
     # (--metrics-dir) and whenever the user supplies probe material
@@ -1148,6 +1217,55 @@ def main(argv: Optional[List[str]] = None) -> int:
             hit = "cache hit" if pr.source == "cache" else "probed"
             print(f"autotune ({hit}, key {pr.key}): {pr.plan.to_json()}")
 
+    # Device-truth observability (obs/devmem.py + obs/harvest.py +
+    # obs/profiler.py), on for the same instrumented runs the signal plane
+    # covers: the HBM memory ledger (per-phase watermarks, w2v_mem_*
+    # gauges, the mem_headroom_frac derived signal, the growth-headroom
+    # forecast), the compiled-program cost harvest (banked into the
+    # manifest at run end), and the bounded profiler capture (armed by SLO
+    # breaches / --profile-steps / SIGUSR2). Constructed BEFORE the
+    # manifest write so the manifest's start block carries the init
+    # watermark; installed process-wide so serve swap_table and the
+    # SIGUSR2 handler find the live ledger (obs/devmem.activate).
+    mem_ledger = None
+    cost_harvest = None
+    prof_capture = None
+    prev_ledger = None
+    if slo_rules or args.metrics_dir or args.prom_textfile:
+        from .obs import devmem as devmem_mod
+        from .obs.devmem import MemoryLedger, table_row_bytes
+        from .obs.harvest import CostHarvest
+
+        mem_ledger = MemoryLedger(
+            sample_every=args.mem_sample_every or 50,
+            # the hub directly (not the log_fn gate): the SignalEngine is
+            # itself a hub sink, and the mem rows must reach it even when
+            # no console/file sink is attached (--slo alone, --quiet)
+            log_fn=hub,
+            flight=trainer.flight,
+            host=jax.process_index(),
+            row_bytes=table_row_bytes(trainer.config),
+            vocab_reserve=trainer.config.vocab_reserve,
+        )
+        trainer.devmem = mem_ledger
+        prev_ledger = devmem_mod.activate(mem_ledger)
+        # pre-training watermark: whatever init/compile already allocated
+        mem_ledger.sample("init")
+        cost_harvest = CostHarvest(host=jax.process_index())
+        trainer.harvest = cost_harvest
+    if args.metrics_dir and is_primary:
+        from .obs.profiler import ProfilerCapture
+
+        prof_capture = ProfilerCapture(
+            metrics_dir,
+            steps=args.profile_on_breach or 8,
+            log_fn=hub,
+            flight=trainer.flight,
+        )
+        trainer.profiler = prof_capture
+        if profile_window is not None:
+            prof_capture.schedule(*profile_window)
+
     elastic_gen = int(os.environ.get("W2V_ELASTIC_GEN", "0") or 0)
     # Warm-restart compile cache: ONLY an exec'd next-generation elastic
     # process may point jax's persistent compilation cache at the
@@ -1199,6 +1317,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             "elastic_policy": args.elastic_policy or None,
             "elastic_generation": elastic_gen,
             "compile_cache": warm_cache_dir,
+            # the device-memory view at run start: availability, the init
+            # watermark, and the growth-headroom forecast (rows-remaining
+            # before table growth exhausts the budget) — the end-of-run
+            # update rewrites this with the full per-phase ledger
+            "device_memory": (
+                mem_ledger.summary() if mem_ledger is not None else None
+            ),
         }
         if streaming:
             extra["stream"] = {
@@ -1495,6 +1620,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         trainer.signals = sig_engine
         hub.add(sig_engine)  # hub.close() also closes the row file
+        if prof_capture is not None and args.profile_on_breach:
+            # the third SignalBus consumer (after FleetHealth and
+            # ElasticPolicy): an SLO breach requests one bounded profiler
+            # window, armed at the next step boundary (obs/profiler.py)
+            prof_capture.attach(sig_engine.bus)
         if not args.quiet and slo_rules:
             print(
                 f"slo: {len(slo_rules)} rule(s) over {sig_window}-step "
@@ -1539,11 +1669,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     trainer.install_shutdown(handler)
 
     # On-demand diagnostics: SIGUSR1 dumps the flight recorder + all-thread
-    # stacks into the metrics dir without stopping the run.
-    from .resilience.shutdown import install_usr1_dump
+    # stacks into the metrics dir without stopping the run; SIGUSR2 is the
+    # device-side mirror — a bounded profiler window + the memory ledger.
+    from .resilience.shutdown import install_usr1_dump, install_usr2_profile
 
     uninstall_usr1 = (
         install_usr1_dump(metrics_dir, trainer.flight)
+        if metrics_dir else (lambda: None)
+    )
+    uninstall_usr2 = (
+        install_usr2_profile(metrics_dir, prof_capture, mem_ledger)
         if metrics_dir else (lambda: None)
     )
 
@@ -1932,9 +2067,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # protects
         handler.uninstall()
         uninstall_usr1()
+        uninstall_usr2()
         _watchdog.set_sync_deadline(prev_sync_deadline)
         if fault_plan:
             _faults.activate(prev_plan)
+        if mem_ledger is not None:
+            from .obs import devmem as devmem_mod
+
+            devmem_mod.activate(prev_ledger)
     if report.health is not None or report.phases is not None:
         # final-summary event record: the run's verdict lands in the JSONL
         # tail (and the console, one line) without re-deriving it from logs
@@ -1975,8 +2115,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             if slo_rep:
                 summary["slo_state"] = slo_rep.get("state")
                 summary["slo_breaches"] = slo_rep.get("breaches_total")
+        if report.device_memory and report.device_memory.get("available"):
+            # the device-memory one-liner: worst headroom seen this run
+            summary["mem_headroom_frac_min"] = report.device_memory.get(
+                "headroom_frac_min"
+            )
+            summary["mem_peak_bytes"] = report.device_memory.get(
+                "peak_bytes"
+            )
         if log_fn is not None:
             log_fn(summary)
+
+    # Compiled-program cost harvest: analyze the captured executables NOW,
+    # after the measured loop (obs/harvest.py), and land the totals as
+    # w2v_cost_harvest_* gauges + a manifest block.
+    harvest_report = None
+    if cost_harvest is not None:
+        harvest_report = cost_harvest.finalize()
+        if log_fn is not None:
+            _hrec = cost_harvest.gauge_record()
+            if _hrec:
+                log_fn(_hrec)
 
     # How the run ended, recorded where how it started already is: the
     # manifest distinguishes a clean completion from a preempted one, and
@@ -1988,6 +2147,37 @@ def main(argv: Optional[List[str]] = None) -> int:
             "final_step": state.step,
             "recoveries": report.recoveries or [],
         }
+        if report.device_memory is not None:
+            # the full per-phase ledger replaces the start block's
+            # init-only watermark (same key, one manifest read answers
+            # "where did the HBM go")
+            end_fields["device_memory"] = report.device_memory
+        if harvest_report is not None:
+            end_fields["cost_harvest"] = harvest_report
+        if prof_capture is not None:
+            end_fields["profiler"] = prof_capture.summary()
+        if trainer.flight is not None and cost_harvest is not None:
+            # anchor-drift verdict (tune/cost_model.cost_calibrate): the
+            # run's own measured device time inverted against the three
+            # hand anchors — banked so a stale constant is visible from
+            # the manifest alone
+            try:
+                from .obs import tracediff as _tracediff
+                from .tune import cost_model as _cm
+
+                _dev = jax.devices()[0]
+                _est = _cm.predict(
+                    trainer.config, len(vocab), _dev.device_kind,
+                    _dev.platform,
+                )
+                end_fields["cost_calibrate"] = _cm.cost_calibrate(
+                    _est,
+                    _cm.measured_device_ms(
+                        _tracediff.summarize(trainer.flight.ring.events())
+                    ),
+                )
+            except Exception as _ce:  # noqa: BLE001 — advisory, never fatal
+                end_fields["cost_calibrate"] = {"error": str(_ce)}
         if getattr(trainer, "resume_fallback", None):
             # an out-of-range checkpointed step counter fell back to epoch
             # restart (train._resume_skip) — recorded so the manifest shows

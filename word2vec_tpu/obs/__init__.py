@@ -40,14 +40,30 @@ manifests, exporters, tracing, flight recorder, quality probes.
                     `python -m word2vec_tpu.obs.fleet` replica aggregator
     obs.watch     — `python -m word2vec_tpu.obs.watch --dir DIR`: terminal
                     dashboard tailing fleet.json
+    obs.devmem    — HBM memory ledger (MemoryLedger): device.memory_stats()
+                    per-phase watermarks beaten from the step loop, the
+                    mem_headroom_frac derived signal (SLO-able), w2v_mem_*
+                    gauges present from zero even on statless backends, and
+                    the growth-headroom forecast in the manifest
+    obs.harvest   — compiled-program cost harvest (CostHarvest): XLA's own
+                    cost_analysis()/memory_analysis() per jitted executable,
+                    captured as avals at first dispatch, analyzed after the
+                    run, banked next to the analytic prediction it audits
+    obs.profiler  — bounded jax.profiler windows (ProfilerCapture): armed by
+                    SLO breaches (--profile-on-breach), --profile-steps A:B,
+                    or SIGUSR2; one capture per breach episode with a
+                    schema-checked manifest next to flight.json
 
 Drivers (train.Trainer, parallel.ShardedTrainer, cli.py, bench.py) all
 route through here; utils/logging.py keeps the individual log sinks.
 """
 
+from .devmem import MemoryLedger, device_memory_stats
 from .export import MetricsHub, prometheus_textfile
 from .fleet import FleetAggregator, merge_rows, validate_fleet_doc
 from .flight import FlightRecorder
+from .harvest import CostHarvest
+from .profiler import ProfilerCapture, validate_capture_doc
 from .health import DivergenceError, HealthMonitor, health_record
 from .manifest import manifest_dict, write_manifest
 from .phases import PhaseRecorder
@@ -59,6 +75,11 @@ from .slo import SloError, SloEvaluator, SloRule, parse_slo
 from .trace import TraceRing, chrome_trace_doc, merge_traces, write_trace
 
 __all__ = [
+    "MemoryLedger",
+    "device_memory_stats",
+    "CostHarvest",
+    "ProfilerCapture",
+    "validate_capture_doc",
     "MetricsHub",
     "prometheus_textfile",
     "FleetAggregator",

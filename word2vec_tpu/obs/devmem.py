@@ -1,0 +1,403 @@
+"""HBM memory ledger: device-truth memory accounting on the existing planes.
+
+Every telemetry layer so far observes the HOST's view of the run: span
+clocks, counters the step program itself computes, windowed signals over
+both. What the DEVICE is doing with its memory — live bytes, the peak
+watermark, how much headroom a vocab-growth rebuild or a serve table swap
+actually has — was a black box, probed exactly once by the resident-corpus
+budget gate (ops/resident.py, which now routes through `device_memory_stats`
+below). This module turns that one-off probe into a ledger:
+
+  device_memory_stats — the ONE funnel for `device.memory_stats()`:
+                        normalized {bytes_in_use, peak_bytes_in_use,
+                        bytes_limit, bytes_reserved} or None on backends
+                        that report nothing (CPU returns None/{} — the
+                        graceful-degrade contract: gauges present from
+                        zero, never a crash). The resident budget gate and
+                        the ledger share it so the two can never disagree
+                        on what the device said.
+
+  MemoryLedger        — per-phase watermark accounting, beaten from
+                        `Trainer._check_stop` at step/chunk boundaries.
+                        Non-sample boundaries are ONE integer compare —
+                        zero extra device dispatches (memory_stats is a
+                        host-side client call, and even that only runs on
+                        the sample cadence; pinned by tests/test_devmem.py
+                        alongside the watchdog/signals beat contract).
+                        Every sample attributes the live/peak deltas to the
+                        phase that produced them (init, table placement,
+                        train step, vocab-growth rebuild, serve table swap)
+                        and emits ONE "mem" event record whose numeric
+                        fields become `w2v_mem_*` gauges
+                        (obs/export.GAUGE_EVENTS), a row on the flight
+                        recorder's bounded memory ring (every flight.json
+                        carries the recent memory trajectory), and — via
+                        the SignalEngine's hub-sink harvest — a
+                        `mem_headroom_frac` derived signal, which makes
+                        memory SLO-able with the existing grammar
+                        (`--slo 'mem_headroom_frac<0.1:for=2'` breaches
+                        like any other rule, and obs/fleet.py merges the
+                        per-host rows with worst-host attribution).
+
+  growth headroom     — `forecast()` projects rows-remaining until table
+                        growth exhausts the budget: free HBM divided by the
+                        realized bytes/row of the configured table layout.
+                        Landed in the manifest so a `--vocab-reserve` run
+                        can see whether its reserve even fits BEFORE the
+                        admission boundary recompiles into an OOM.
+
+Like the flight recorder, the module keeps an `activate()`/`active()`
+process-wide slot so call sites that cannot thread a reference (the serve
+engine's `swap_table`, the SIGUSR2 dump) find the live ledger.
+
+`W2V_FAKE_MEMORY_STATS` (a `key=value,...` spec) substitutes for the device
+report — the CI/chaos hook that lets a CPU run exercise the full
+mem-SLO-breach -> profiler-capture path where no real HBM exists. It is a
+test hook by contract, never set in production.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+#: default step/chunk boundaries between train-phase samples. One sample is
+#: one host-side client call per local device — cheap, but not free, so the
+#: beat dilutes it; every non-sample boundary is an integer compare.
+SAMPLE_EVERY_DEFAULT = 50
+
+#: bounded per-ledger row history (flight keeps its own ring; this one
+#: backs summary() and the SIGUSR2 dump)
+ROWS_KEPT = 256
+
+#: phase names the ledger attributes watermarks to (free-form strings are
+#: accepted; these are the wired ones)
+PHASE_INIT = "init"
+PHASE_TABLE_PLACE = "table_place"
+PHASE_TRAIN = "train_step"
+PHASE_VOCAB_GROWTH = "vocab_growth"
+PHASE_SERVE_SWAP = "serve_swap"
+
+#: the CI/test substitution hook (see module docstring)
+FAKE_STATS_ENV = "W2V_FAKE_MEMORY_STATS"
+
+_STAT_KEYS = (
+    "bytes_in_use", "peak_bytes_in_use", "bytes_limit", "bytes_reserved",
+)
+
+
+def _fake_stats() -> Optional[Dict[str, int]]:
+    spec = os.environ.get(FAKE_STATS_ENV)
+    if not spec:
+        return None
+    out: Dict[str, int] = {}
+    for clause in spec.split(","):
+        key, _, val = clause.partition("=")
+        key = key.strip()
+        if key in _STAT_KEYS:
+            try:
+                out[key] = int(float(val))
+            except ValueError:
+                continue
+    return out or None
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """Normalized memory stats of one device, or None when the backend
+    reports nothing (CPU returns None or {}). Never raises: an
+    unaddressable device (a remote mesh peer) degrades to None, same as a
+    statless backend — callers gate on the result, not on exceptions."""
+    fake = _fake_stats()
+    if fake is not None:
+        return dict(fake)
+    if device is None:
+        try:
+            import jax
+
+            device = jax.local_devices()[0]
+        except Exception:  # noqa: BLE001 — stats are advisory
+            return None
+    try:
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — see docstring
+        return None
+    if not stats:
+        return None
+    out = {k: int(stats[k]) for k in _STAT_KEYS if k in stats}
+    return out or None
+
+
+def headroom_fraction(stats: Dict[str, int]) -> Optional[float]:
+    """free / limit of one normalized stats dict; None without a limit."""
+    limit = stats.get("bytes_limit")
+    if not limit:
+        return None
+    free = max(0, int(limit) - int(stats.get("bytes_in_use", 0)))
+    return free / float(limit)
+
+
+def table_row_bytes(config) -> int:
+    """Realized bytes one vocabulary row costs in the embedding tables:
+    both planes (input + output — split pair or unified slab, same total)
+    at the configured storage dtype. The growth-forecast denominator.
+    (bfloat16 is not a numpy dtype name; sized explicitly.)"""
+    import numpy as np
+
+    dtype = str(getattr(config, "dtype", "float32"))
+    itemsize = 2 if dtype == "bfloat16" else np.dtype(dtype).itemsize
+    return 2 * int(config.word_dim) * int(itemsize)
+
+
+class MemoryLedger:
+    """Per-phase device-memory watermarks on the run's existing planes."""
+
+    def __init__(
+        self,
+        sample_every: int = SAMPLE_EVERY_DEFAULT,
+        devices=None,
+        log_fn: Optional[Callable[[Dict], None]] = None,
+        flight=None,
+        host: int = 0,
+        row_bytes: int = 0,
+        vocab_reserve: int = 0,
+    ):
+        self.sample_every = max(1, int(sample_every))
+        #: explicit device list (tests pass stubs; None = lazy local devices
+        #: — resolved per sample so a remesh'd process follows its mesh)
+        self.devices = devices
+        self.log_fn = log_fn
+        self.flight = flight
+        self.host = int(host)
+        #: growth-forecast inputs (0 disables the forecast fields)
+        self.row_bytes = int(row_bytes)
+        self.vocab_reserve = int(vocab_reserve)
+        #: False until a sample actually returned stats; the CPU degrade is
+        #: available=False with zeroed gauges, never an error
+        self.available = False
+        self._lock = threading.Lock()
+        self.rows: collections.deque = collections.deque(maxlen=ROWS_KEPT)
+        #: phase -> {"samples", "bytes_in_use_max", "peak_bytes_max"}
+        self.phases: Dict[str, Dict[str, float]] = {}
+        self.samples = 0
+        self._next_sample_step: Optional[int] = None
+        self._last_stats: Optional[Dict[str, int]] = None
+
+    # ------------------------------------------------------------- sampling
+    def _device_list(self) -> List:
+        if self.devices is not None:
+            return list(self.devices)
+        try:
+            import jax
+
+            return list(jax.local_devices())
+        except Exception:  # noqa: BLE001 — stats are advisory
+            return []
+
+    def _read(self) -> Optional[Dict[str, int]]:
+        """Worst-local-device stats: max bytes_in_use/peak, min limit —
+        the per-process attribution the fleet merge needs (each rank
+        reports ITS local devices; obs/fleet.py names the worst host)."""
+        fake = _fake_stats()
+        if fake is not None:
+            return dict(fake)
+        per_dev = [
+            s for s in (
+                device_memory_stats(d) for d in self._device_list()
+            ) if s
+        ]
+        if not per_dev:
+            return None
+        out: Dict[str, int] = {}
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_reserved"):
+            vals = [s[key] for s in per_dev if key in s]
+            if vals:
+                out[key] = max(vals)
+        limits = [s["bytes_limit"] for s in per_dev if "bytes_limit" in s]
+        if limits:
+            out["bytes_limit"] = min(limits)
+        return out or None
+
+    def sample(self, phase: str, step: Optional[int] = None) -> Dict:
+        """One ledger sample attributed to `phase`. Always returns a row
+        (and emits the gauges) — on a statless backend the byte fields are
+        zero and `mem_available` is 0, so dashboards see the series exist
+        from the first scrape (present-from-zero), and nothing crashes."""
+        stats = self._read()
+        row: Dict = {
+            "event": "mem",
+            "phase": str(phase),
+            "host": self.host,
+            "mem_available": int(stats is not None),
+            "mem_bytes_in_use": 0,
+            "mem_peak_bytes": 0,
+            "mem_bytes_limit": 0,
+        }
+        if step is not None:
+            row["step"] = int(step)
+        if stats is not None:
+            self.available = True
+            self._last_stats = dict(stats)
+            row["mem_bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+            row["mem_peak_bytes"] = int(
+                stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+            )
+            if stats.get("bytes_limit"):
+                row["mem_bytes_limit"] = int(stats["bytes_limit"])
+                hf = headroom_fraction(stats)
+                if hf is not None:
+                    row["mem_headroom_frac"] = round(hf, 6)
+            rows_left = self._rows_remaining(stats)
+            if rows_left is not None:
+                row["mem_growth_rows_remaining"] = rows_left
+        with self._lock:
+            self.samples += 1
+            self.rows.append(dict(row))
+            ph = self.phases.setdefault(
+                str(phase),
+                {"samples": 0, "bytes_in_use_max": 0, "peak_bytes_max": 0},
+            )
+            ph["samples"] += 1
+            ph["bytes_in_use_max"] = max(
+                ph["bytes_in_use_max"], row["mem_bytes_in_use"]
+            )
+            ph["peak_bytes_max"] = max(
+                ph["peak_bytes_max"], row["mem_peak_bytes"]
+            )
+        if self.flight is not None:
+            note = getattr(self.flight, "note_mem", None)
+            if note is not None:
+                note(row)
+        if self.log_fn is not None:
+            self.log_fn(dict(row))
+        return row
+
+    def on_boundary(self, step: int) -> None:
+        """The trainer beat (Trainer._check_stop): one integer compare on
+        non-sample boundaries — no client call, no dispatch, nothing."""
+        if self._next_sample_step is None:
+            # first boundary: sample immediately so short runs still land
+            # one train-phase row (the signals first-window discipline)
+            self._next_sample_step = int(step) + self.sample_every
+            self.sample(PHASE_TRAIN, step=step)
+            return
+        if step < self._next_sample_step:
+            return
+        self._next_sample_step = int(step) + self.sample_every
+        self.sample(PHASE_TRAIN, step=step)
+
+    # ------------------------------------------------------------ forecast
+    def _rows_remaining(self, stats: Dict[str, int]) -> Optional[int]:
+        """Rows of table growth the CURRENT free memory could still hold at
+        the realized bytes/row (0 disables). The vocab-growth headroom
+        forecast: reserve rows are pre-allocated at init, so this measures
+        how far a FUTURE re-init (a bigger --vocab-reserve, a table
+        rebuild) could stretch before the budget is gone."""
+        if self.row_bytes <= 0:
+            return None
+        limit = stats.get("bytes_limit")
+        if not limit:
+            return None
+        free = max(0, int(limit) - int(stats.get("bytes_in_use", 0)))
+        return int(free // self.row_bytes)
+
+    def forecast(self) -> Optional[Dict]:
+        """The manifest's growth-headroom block (None before any live
+        sample or without row-bytes wiring)."""
+        if self.row_bytes <= 0:
+            return None
+        stats = self._last_stats
+        rows_left = self._rows_remaining(stats) if stats else None
+        out: Dict = {
+            "row_bytes": self.row_bytes,
+            "vocab_reserve": self.vocab_reserve,
+            "reserve_bytes": self.row_bytes * self.vocab_reserve,
+            "rows_remaining": rows_left,
+        }
+        if rows_left is not None and self.vocab_reserve > 0:
+            out["reserve_fits"] = bool(rows_left >= 0)
+        return out
+
+    # ------------------------------------------------------------- summary
+    def summary(self) -> Dict:
+        """TrainReport.device_memory / manifest payload: availability, the
+        overall and per-phase watermarks, and the growth forecast."""
+        with self._lock:
+            rows = list(self.rows)
+            phases = {k: dict(v) for k, v in self.phases.items()}
+        out: Dict = {
+            "available": self.available,
+            "samples": self.samples,
+            "sample_every": self.sample_every,
+            "phases": phases,
+        }
+        if rows:
+            out["peak_bytes"] = max(r["mem_peak_bytes"] for r in rows)
+            out["last_bytes_in_use"] = rows[-1]["mem_bytes_in_use"]
+            hfs = [
+                r["mem_headroom_frac"] for r in rows
+                if "mem_headroom_frac" in r
+            ]
+            if hfs:
+                out["headroom_frac_min"] = round(min(hfs), 6)
+                out["headroom_frac_last"] = hfs[-1]
+        fc = self.forecast()
+        if fc is not None:
+            out["growth_forecast"] = fc
+        return out
+
+    def dump(self, path: str, reason: str = "on_demand") -> Optional[str]:
+        """Write the ledger (summary + recent rows) as one JSON file — the
+        SIGUSR2 on-demand artifact. Best-effort like a flight dump."""
+        import json
+
+        try:
+            parent = os.path.dirname(os.path.abspath(path)) or "."
+            os.makedirs(parent, exist_ok=True)
+            doc = {
+                "event": "mem_ledger",
+                "reason": reason,
+                "created_utc": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                ),
+                "summary": self.summary(),
+                "rows": list(self.rows),
+            }
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2, default=str)
+                f.write("\n")
+            os.replace(tmp, path)
+            return path
+        except Exception:  # noqa: BLE001 — a dump must never kill the run
+            return None
+
+
+# ----------------------------------------------------- process-wide ledger
+# swap_table (serve/query.py) and the SIGUSR2 handler need the live ledger
+# without a reference threaded through their call chains — the same pattern
+# as obs/flight.activate().
+_ACTIVE: Optional[MemoryLedger] = None
+
+
+def activate(ledger: Optional[MemoryLedger]) -> Optional[MemoryLedger]:
+    """Install the process-wide ledger; returns the previous one."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = ledger
+    return prev
+
+
+def active() -> Optional[MemoryLedger]:
+    return _ACTIVE
+
+
+def sample_active(phase: str, step: Optional[int] = None) -> Optional[Dict]:
+    """Sample the process-wide ledger, if any (the swap/growth call sites'
+    no-op-when-unwired form)."""
+    led = _ACTIVE
+    if led is None:
+        return None
+    return led.sample(phase, step=step)

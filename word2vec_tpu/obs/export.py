@@ -74,6 +74,10 @@ EVENT_COUNTERS = {
     "vocab_growth": "w2v_vocab_growth_total",
     "table_swap": "w2v_table_swaps_total",
     "table_swap_refused": "w2v_table_swap_refused_total",
+    # device-truth observability (obs/profiler.py): completed bounded
+    # profiler windows — a dashboard alerting on breaches can confirm the
+    # evidence capture actually ran (increase() on both counters together).
+    "profiler_capture": "w2v_profiler_captures_total",
 }
 
 #: event kinds whose NUMERIC fields also land as gauges. Mesh topology
@@ -88,7 +92,13 @@ EVENT_COUNTERS = {
 #: continuous-training gauges: w2v_vocab_size / w2v_stream_tokens_total /
 #: w2v_stream_segment / w2v_vocab_generation — emitted once at run start
 #: too, so the gauges are present from zero.
-GAUGE_EVENTS = ("mesh", "signals", "fleet", "stream")
+#: "mem" rows (obs/devmem.MemoryLedger, one per ledger sample) carry the
+#: device-memory watermarks: w2v_mem_bytes_in_use / w2v_mem_peak_bytes /
+#: w2v_mem_bytes_limit / w2v_mem_headroom_frac / w2v_mem_available —
+#: present from zero (a statless CPU backend emits zeroed rows rather
+#: than nothing). "cost_harvest" rows (obs/harvest.CostHarvest) carry the
+#: compiled-program totals: w2v_cost_harvest_flops / _bytes / _programs.
+GAUGE_EVENTS = ("mesh", "signals", "fleet", "stream", "mem", "cost_harvest")
 
 #: seconds one sink call may take before the hub detaches it as wedged —
 #: generous (a prom textfile rewrite is microseconds; a hung NFS mount or

@@ -183,6 +183,18 @@ def merge_rows(rows: List[Dict]) -> List[Dict]:
             merged["cache_hit_mean"] = round(
                 sum(v for _, v in ch) / len(ch), 4
             )
+        mh = vals("mem_headroom_frac")
+        if mh:
+            # device-memory view (obs/devmem.py): the fleet has the
+            # headroom of its WORST host — that host is where the next
+            # vocab growth or table swap OOMs, so it gets the attribution
+            # (the host_overhead straggler discipline, applied to memory)
+            worst_host, worst_v = min(mh, key=lambda kv: kv[1])
+            merged["mem_headroom_frac_min"] = round(worst_v, 6)
+            merged["mem_worst_host"] = worst_host
+        mp = vals("mem_peak_bytes")
+        if mp:
+            merged["mem_peak_bytes_max"] = max(v for _, v in mp)
         out.append(merged)
     return out
 
@@ -328,6 +340,9 @@ class FleetAggregator:
             ("serve_qps", "fleet_serve_qps"),
             ("serve_p99_ms_max", "fleet_serve_p99_ms"),
             ("cache_hit_mean", "fleet_cache_hit"),
+            ("mem_headroom_frac_min", "fleet_mem_headroom_frac"),
+            ("mem_peak_bytes_max", "fleet_mem_peak_bytes"),
+            ("mem_worst_host", "fleet_mem_worst_host"),
         ):
             if src in last:
                 rec[dst] = last[src]

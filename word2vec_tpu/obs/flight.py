@@ -77,6 +77,10 @@ class FlightRecorder:
         #: obs/slo.py): a failure artifact carries the windowed signal
         #: trajectory — and any warn/breach escalation — that led there
         self.signals: collections.deque = collections.deque(maxlen=64)
+        #: last-N memory-ledger rows (obs/devmem.MemoryLedger): every
+        #: flight.json shows the device-memory trajectory that led to the
+        #: failure — an OOM artifact names its own watermark history
+        self.memory: collections.deque = collections.deque(maxlen=64)
         #: the last step boundary observed (None before any)
         self.last_step: Optional[int] = None
 
@@ -127,6 +131,12 @@ class FlightRecorder:
         with self._lock:
             self.signals.append(dict(row))
 
+    def note_mem(self, row: Dict) -> None:
+        """One memory-ledger sample (obs/devmem.MemoryLedger): the bounded
+        memory ring every flight.json dump carries."""
+        with self._lock:
+            self.memory.append(dict(row))
+
     def log_record(self, rec: Dict) -> None:
         """One log record (sink-compatible: the trainers' _log feeds this
         alongside the run's MetricsHub)."""
@@ -144,6 +154,7 @@ class FlightRecorder:
             records = list(self.records)
             quality = list(self.quality)
             signals = list(self.signals)
+            memory = list(self.memory)
         snap: Dict = {
             "event": "flight",
             "reason": reason,
@@ -159,6 +170,7 @@ class FlightRecorder:
             "log_records": records,
             "quality": quality,
             "signals": signals,
+            "memory": memory,
         }
         if extra:
             snap.update(extra)

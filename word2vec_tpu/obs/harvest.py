@@ -1,0 +1,194 @@
+"""Compiled-program cost harvest: what XLA says each executable costs.
+
+The cost model (tune/cost_model.py) predicts step time from ANALYTIC
+FLOP/byte counters (utils/profiling.py) plus three hand-calibrated anchors.
+Those counters are our arithmetic about the program; the compiler has its
+own, attached to every executable it emits: `compiled.cost_analysis()`
+(flops, bytes accessed) and `compiled.memory_analysis()` (argument/output/
+temp/code sizes). This module banks that device truth next to the analytic
+numbers so the model's error — and the anchors' drift — stays observable
+from every run's own artifacts (manifest, bench record), which is what
+feeds `tune/cost_model.cost_calibrate`.
+
+Mechanics: the trainers CAPTURE each jitted program's call signature the
+first time it is dispatched (`CostHarvest.capture` — a tree-map of the
+live arguments to ShapeDtypeStructs, so nothing holds donated buffers and
+the hot loop pays a set lookup on later dispatches), and `finalize()` walks
+the captured programs AFTER the run: `fn.lower(*avals).compile()` reuses
+jax's lowering/compilation caches where the traced call already populated
+them, and any residual compile cost lands outside the measured loop either
+way. Every row degrades structurally — a backend whose cost analysis is
+unavailable banks `{"ok": false, "error": ...}` for that program, never a
+crash (the devmem present-from-zero contract).
+
+jax 0.4.x returns cost_analysis as a list of one dict on some backends and
+a bare dict on others; `_normalize_cost` absorbs both.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def _normalize_cost(cost) -> Dict[str, float]:
+    """cost_analysis() -> {"flops", "bytes_accessed", ...} (missing keys
+    simply absent; utilization breakdown keys dropped)."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    if not isinstance(cost, dict):
+        return {}
+    out: Dict[str, float] = {}
+    for src, dst in (("flops", "flops"), ("bytes accessed", "bytes_accessed"),
+                     ("transcendentals", "transcendentals")):
+        v = cost.get(src)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[dst] = float(v)
+    return out
+
+
+def analyze_compiled(compiled) -> Dict:
+    """One jax.stages.Compiled -> a harvest row (cost + memory analysis)."""
+    row: Dict = {"ok": True}
+    try:
+        row.update(_normalize_cost(compiled.cost_analysis()))
+    except Exception as e:  # noqa: BLE001 — structural degrade per row
+        row["cost_error"] = f"{type(e).__name__}: {e}"
+    try:
+        mem = compiled.memory_analysis()
+        for attr, dst in (
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("argument_size_in_bytes", "argument_bytes"),
+            ("output_size_in_bytes", "output_bytes"),
+            ("alias_size_in_bytes", "alias_bytes"),
+            ("generated_code_size_in_bytes", "generated_code_bytes"),
+        ):
+            v = getattr(mem, attr, None)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                row[dst] = int(v)
+    except Exception as e:  # noqa: BLE001 — structural degrade per row
+        row["memory_error"] = f"{type(e).__name__}: {e}"
+    return row
+
+
+def _avals(args: Tuple, kwargs: Optional[Dict]):
+    """Live call arguments -> ShapeDtypeStructs (scalars pass through).
+    Holding avals instead of arrays means captured signatures survive
+    buffer donation and pin no device memory."""
+    import jax
+    import numpy as np
+
+    def one(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        if isinstance(x, (int, float, bool)):
+            return x
+        return jax.ShapeDtypeStruct((), np.asarray(x).dtype)
+
+    return (
+        jax.tree_util.tree_map(one, tuple(args)),
+        jax.tree_util.tree_map(one, dict(kwargs or {})),
+    )
+
+
+class CostHarvest:
+    """Registry of jitted programs captured at dispatch, analyzed at end."""
+
+    def __init__(self, host: int = 0):
+        self.host = int(host)
+        self._lock = threading.Lock()
+        #: name -> (fn, arg avals, kw avals) pending analysis
+        self._pending: Dict[str, Tuple] = {}
+        #: name -> finished row
+        self.programs: Dict[str, Dict] = {}
+        self._seen: set = set()
+
+    def want(self, name: str) -> bool:
+        """Hot-loop gate: has this program been captured yet? One set
+        lookup — the only cost the dispatch path pays after the first."""
+        return name not in self._seen
+
+    def capture(self, name: str, fn: Callable, args: Tuple,
+                kwargs: Optional[Dict] = None) -> None:
+        """Record one program's call signature (idempotent per name).
+        Cheap by design: a tree-map to avals, no lowering, no compile —
+        the dispatch that triggered it proceeds undisturbed."""
+        with self._lock:
+            if name in self._seen:
+                return
+            self._seen.add(name)
+        try:
+            a, kw = _avals(args, kwargs)
+        except Exception as e:  # noqa: BLE001 — capture must never kill a step
+            with self._lock:
+                self.programs[name] = {
+                    "program": name, "ok": False,
+                    "error": f"capture: {type(e).__name__}: {e}",
+                }
+            return
+        with self._lock:
+            self._pending[name] = (fn, a, kw)
+
+    def finalize(self) -> Dict:
+        """Lower+compile every captured signature and bank its analysis.
+        Runs AFTER training (cli.py / bench.py), so even a backend that
+        re-compiles on the AOT path costs nothing inside the measured
+        loop. Returns report(). Idempotent: finished programs skip."""
+        with self._lock:
+            pending = dict(self._pending)
+            self._pending.clear()
+        for name, (fn, args, kwargs) in pending.items():
+            row: Dict = {"program": name}
+            try:
+                lowered = fn.lower(*args, **kwargs)
+                compiled = lowered.compile()
+                row.update(analyze_compiled(compiled))
+            except Exception as e:  # noqa: BLE001 — structural degrade
+                row["ok"] = False
+                row["error"] = f"{type(e).__name__}: {e}"
+            with self._lock:
+                self.programs[name] = row
+        return self.report()
+
+    # ------------------------------------------------------------- output
+    def report(self) -> Dict:
+        """The manifest / bench-record payload: per-program rows plus
+        cross-program totals (the gauge record's numeric fields)."""
+        with self._lock:
+            rows = [dict(r) for _, r in sorted(self.programs.items())]
+        totals: Dict[str, float] = {}
+        for key in ("flops", "bytes_accessed", "temp_bytes",
+                    "generated_code_bytes"):
+            vals = [r[key] for r in rows if isinstance(r.get(key), (int, float))]
+            if vals:
+                totals[key] = float(sum(vals))
+        return {
+            "host": self.host,
+            "programs": rows,
+            "programs_ok": sum(1 for r in rows if r.get("ok")),
+            "programs_failed": sum(1 for r in rows if not r.get("ok", False)),
+            "totals": totals,
+        }
+
+    def gauge_record(self) -> Optional[Dict]:
+        """One flat "cost_harvest" event record -> `w2v_cost_harvest_*`
+        gauges (obs/export.GAUGE_EVENTS). None before any program banked."""
+        rep = self.report()
+        if not rep["programs"]:
+            return None
+        rec: Dict = {
+            "event": "cost_harvest",
+            "cost_harvest_programs": len(rep["programs"]),
+            "cost_harvest_programs_ok": rep["programs_ok"],
+        }
+        for key, dst in (
+            ("flops", "cost_harvest_flops"),
+            ("bytes_accessed", "cost_harvest_bytes"),
+            ("temp_bytes", "cost_harvest_temp_bytes"),
+            ("generated_code_bytes", "cost_harvest_code_bytes"),
+        ):
+            if key in rep["totals"]:
+                rec[dst] = rep["totals"][key]
+        return rec

@@ -432,6 +432,26 @@ class SignalEngine:
             ev in ("signals", "fleet") or ev.startswith("slo_")
         ):
             return
+        if ev == "mem":
+            # memory-ledger rows (obs/devmem.py): the headroom fraction
+            # becomes a derived signal, which is what makes memory
+            # SLO-able ('mem_headroom_frac<0.1' breaches like any rule)
+            # and fleet-mergeable with worst-host attribution. Statless
+            # backends (mem_available=0) feed nothing — a zero here would
+            # read as a full device and breach every headroom SLO.
+            if record.get("mem_available"):
+                with self._lock:
+                    hf = record.get("mem_headroom_frac")
+                    if isinstance(hf, (int, float)) and not isinstance(
+                        hf, bool
+                    ):
+                        self._latest["mem_headroom_frac"] = float(hf)
+                    pk = record.get("mem_peak_bytes")
+                    if isinstance(pk, (int, float)) and not isinstance(
+                        pk, bool
+                    ):
+                        self._latest["mem_peak_bytes"] = float(pk)
+            return
         planted = None
         for key in ("quality_analogy_accuracy", "quality_spearman"):
             v = record.get(key)

@@ -71,6 +71,10 @@ def render(doc: Dict, slo: Optional[Dict] = None) -> str:
         ("serve_qps", "serve_qps"),
         ("serve_p99_ms_max", "serve_p99_ms"),
         ("cache_hit_mean", "cache_hit"),
+        # device-memory rows (obs/devmem.py via the signal plane): the
+        # fleet's worst-host headroom and peak HBM watermark
+        ("mem_headroom_frac_min", "mem_headroom"),
+        ("mem_peak_bytes_max", "mem_peak_bytes"),
     ):
         series = [w[key] for w in windows if key in w]
         if series:
@@ -78,6 +82,11 @@ def render(doc: Dict, slo: Optional[Dict] = None) -> str:
                 f"  {label:<16} {series[-1]:>12,.3f}  "
                 f"{_sparkline(series[-SHOW_WINDOWS:])}"
             )
+    if last.get("mem_worst_host") is not None:
+        lines.append(
+            f"  mem worst host   host {last['mem_worst_host']} "
+            f"({last.get('mem_headroom_frac_min', '?')} headroom frac)"
+        )
     s = doc.get("straggler")
     if s:
         lines.append(

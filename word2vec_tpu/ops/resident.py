@@ -65,10 +65,17 @@ def resident_budget_bytes() -> int:
     (parallel/trainer.py _build_resident returns None when procs > 1, so
     its budget call never happens with procs > 1); the agreement branch
     makes this function safe for any direct caller and for future
-    multi-host resident wiring, which must keep it."""
+    multi-host resident wiring, which must keep it.
+
+    The stats read routes through obs/devmem.device_memory_stats — the ONE
+    memory_stats funnel, shared with the HBM memory ledger — so the budget
+    gate and the ledger can never disagree on what the device reported (and
+    the statless-backend degrade is defined in exactly one place)."""
+    from ..obs.devmem import device_memory_stats
+
     budget = RESIDENT_MAX_BYTES
     try:
-        stats = jax.local_devices()[0].memory_stats() or {}
+        stats = device_memory_stats(jax.local_devices()[0]) or {}
         limit = stats.get("bytes_limit")
         if limit:
             free = int(limit) - int(stats.get("bytes_in_use", 0))

@@ -565,10 +565,14 @@ class ShardedTrainer(Trainer):
                 # init_state/import_params): base from the current params —
                 # replicas are assumed reconciled at hand-off
                 self._reset_sync_base(params)
+            self._harvest_capture(
+                "replica_sync", self.sync_fn, (params, self._sync_base)
+            )
             params = self.sync_fn(params, self._sync_base)
             # distinct buffer: the step updates params in place (donation)
             self._sync_base = {k: v.copy() for k, v in params.items()}
         else:
+            self._harvest_capture("replica_sync", self.sync_fn, (params,))
             params = self.sync_fn(params)
         self._bound_sync_wait(params)
         return params

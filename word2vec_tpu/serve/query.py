@@ -205,6 +205,13 @@ class QueryEngine:
             )
         dt = jnp.bfloat16 if self.table_dtype == "bfloat16" else jnp.float32
         new_table = jax.device_put(jnp.asarray(Wn, dtype=dt))
+        # the swap's transient double-residency (old table serving + new
+        # table placed) is the serve tier's memory spike — attribute it on
+        # the process-wide HBM ledger when one is wired (obs/devmem.py;
+        # no-op otherwise)
+        from ..obs import devmem as _devmem
+
+        _devmem.sample_active("serve_swap")
         with self._swap_lock:
             # the flip: queries already past their snapshot keep the old
             # device table alive (jax arrays are immutable); new requests

@@ -222,8 +222,12 @@ class StreamRun:
     def _emit_stream_record(self) -> None:
         """One 'stream' gauge record (obs/export.GAUGE_EVENTS):
         w2v_vocab_size / w2v_stream_tokens_total / w2v_stream_segment /
-        w2v_vocab_generation, present from the run's first boundary."""
-        self._log({
+        w2v_vocab_generation, present from the run's first boundary. When
+        the HBM ledger is live (obs/devmem.py) the record also carries the
+        growth-headroom forecast — rows the device could still absorb at
+        the realized bytes/row — so a dashboard sees `--vocab-reserve`
+        running out of budget segments before it happens."""
+        rec = {
             "event": "stream",
             "vocab_size": len(self.trainer.vocab),
             "stream_tokens_total": int(self.cursor.tokens_total),
@@ -231,7 +235,13 @@ class StreamRun:
             "vocab_generation": int(self.cursor.vocab_generation),
             "stream_swaps": self.swaps,
             "stream_growths": self.growths,
-        })
+        }
+        ledger = getattr(self.trainer, "devmem", None)
+        if ledger is not None:
+            fc = ledger.forecast() or {}
+            if fc.get("rows_remaining") is not None:
+                rec["stream_growth_rows_remaining"] = fc["rows_remaining"]
+        self._log(rec)
 
     # ------------------------------------------------------------- reading
     def _raw_segments(self):

@@ -85,6 +85,12 @@ class TrainReport:
     #: consumed, stream cursor, vocab generation, growth/swap counts.
     #: None on resident-corpus runs.
     stream: Optional[Dict] = None
+    #: HBM memory ledger summary (obs/devmem.MemoryLedger.summary):
+    #: availability, overall + per-phase watermarks, growth-headroom
+    #: forecast. None unless a driver wired trainer.devmem (cli.py does
+    #: with the signal plane); available=False with zeroed watermarks on
+    #: backends that report no memory stats (CPU).
+    device_memory: Optional[Dict] = None
 
 
 class Trainer:
@@ -171,6 +177,26 @@ class Trainer:
     #: install_shutdown so the multi-process heartbeat can feed it.
     #: Duck-typed: anything with .on_boundary(step, words)/.finish/.report.
     signals = None
+    #: HBM memory ledger (obs/devmem.MemoryLedger) — None unless a driver
+    #: wires one (cli.py: with the signal plane). Beaten from _check_stop:
+    #: non-sample boundaries are one integer compare, ZERO extra device
+    #: dispatches (the sample itself is a host-side client call on the
+    #: ledger's cadence — pinned by tests/test_devmem.py). Duck-typed:
+    #: anything with .on_boundary(step)/.sample(phase, step)/.summary.
+    devmem = None
+    #: compiled-program cost harvest (obs/harvest.CostHarvest) — None
+    #: unless a driver wires one. The dispatch sites capture each jitted
+    #: program's call signature ONCE (avals only — nothing holds donated
+    #: buffers); the driver calls finalize() after the run, so lowering/
+    #: analysis never sits inside the measured loop. Duck-typed: anything
+    #: with .want(name)/.capture(name, fn, args).
+    harvest = None
+    #: bounded profiler capture (obs/profiler.ProfilerCapture) — None
+    #: unless a driver wires one. Beaten from _check_stop: idle boundaries
+    #: are two None-checks; a requested capture (SLO breach, SIGUSR2,
+    #: --profile-steps) arms HERE, on the training thread, and stops after
+    #: its step budget. Duck-typed: .on_boundary(step)/.finish(step).
+    profiler = None
 
     def __init__(
         self,
@@ -403,6 +429,11 @@ class Trainer:
         self._resident_cache = None
         self._resident_ready = False
         self._build_step()
+        if self.devmem is not None:
+            # the growth boundary's rebuild (new keep/alias tables + one
+            # recompile) is exactly the allocation spike the growth-headroom
+            # forecast exists for — attribute its watermark
+            self.devmem.sample("vocab_growth")
 
     def _init_params(self, key: jax.Array) -> Params:
         return init_params(self.config, len(self.vocab), key)
@@ -508,6 +539,14 @@ class Trainer:
             # derived-signal window accounting (obs/signals.py): host-side
             # ints/clocks only — the boundary stays device-fetch-free
             self.signals.on_boundary(state.step, state.words_done)
+        if self.devmem is not None:
+            # memory-ledger cadence (obs/devmem.py): an integer compare on
+            # non-sample boundaries; the sample is a host-side client call
+            self.devmem.on_boundary(state.step)
+        if self.profiler is not None:
+            # bounded profiler windows (obs/profiler.py) arm/stop at step
+            # boundaries on this thread — idle boundaries are None-checks
+            self.profiler.on_boundary(state.step)
         if self.fault_plan is not None:
             self.fault_plan.on_step(state, self)
         if self.quality_probe is not None and self.quality_probe.due(
@@ -584,6 +623,13 @@ class Trainer:
         finally:
             if self.watchdog is not None:
                 self.watchdog.disarm()
+            if self.profiler is not None:
+                # the bounded-capture contract holds on EVERY exit path: a
+                # window the run died inside still stops and writes its
+                # manifest (obs/profiler.py)
+                self.profiler.finish(
+                    getattr(self.last_state, "step", None)
+                )
             flight_mod.activate(prev_flight)
 
     def _train_impl(
@@ -618,6 +664,11 @@ class Trainer:
         state = state or self.init_state()
         # the abort paths' checkpoint-where-safe source (class attr note)
         self.last_state = state
+        if self.devmem is not None:
+            # the params (and any resident corpus from a prior segment)
+            # are placed by here: attribute this watermark to table
+            # placement, before the first train-phase sample
+            self.devmem.sample("table_place", step=state.step)
         if self.fault_plan is not None:
             # entry boundary: a fault pinned at/before the entry step
             # (nan@0, or nan@s on a resumed run) applies before the first
@@ -696,6 +747,10 @@ class Trainer:
             ):
                 alpha = jnp.float32(self.alpha_at(state.words_done))
                 key = jax.random.fold_in(base_key, state.step)
+                self._harvest_capture(
+                    "train_step", self.step_fn,
+                    (state.params, tokens, key, alpha),
+                )
                 with self.phases.span("dispatch"):
                     state.params, metrics = self.step_fn(
                         state.params, tokens, key, alpha
@@ -791,6 +846,9 @@ class Trainer:
             health=self._health.summary(),
             interrupted=interrupted,
             signals=self._finish_signals(state),
+            device_memory=(
+                self.devmem.summary() if self.devmem is not None else None
+            ),
         )
         return state, report
 
@@ -932,6 +990,9 @@ class Trainer:
             health=self._health.summary() if self._health else None,
             interrupted=interrupted,
             signals=self._finish_signals(state),
+            device_memory=(
+                self.devmem.summary() if self.devmem is not None else None
+            ),
         )
 
     def _build_chunk_fn(self):
@@ -1056,6 +1117,11 @@ class Trainer:
                 words_list = [int(w) for w in step_words[t0:t0 + chunk_len]]
 
                 def dispatch(al, t0=t0):
+                    self._harvest_capture(
+                        "resident_chunk", chunk_fn,
+                        (state.params, corpus_dev, order_dev,
+                         base_key, state.step, t0, al),
+                    )
                     return chunk_fn(
                         state.params, corpus_dev, order_dev,
                         base_key, state.step, t0, al,
@@ -1070,6 +1136,10 @@ class Trainer:
         ):
 
             def dispatch(al, tokens=tokens):
+                self._harvest_capture(
+                    "train_chunk", self.chunk_fn,
+                    (state.params, tokens, base_key, state.step, al),
+                )
                 return self.chunk_fn(
                     state.params, tokens, base_key, state.step, al
                 )
@@ -1100,6 +1170,14 @@ class Trainer:
             return None
         self.signals.finish(state.step, state.words_done)
         return self.signals.report()
+
+    def _harvest_capture(self, name: str, fn, args) -> None:
+        """Record one jitted program's call signature for the compiled-cost
+        harvest (obs/harvest.py) the first time it dispatches. The hot
+        path pays one set lookup after that; capture itself maps the live
+        args to avals and returns — no lowering, no compile, no fetch."""
+        if self.harvest is not None and self.harvest.want(name):
+            self.harvest.capture(name, fn, args)
 
     def _device_get(self, x):
         """Every blocking metrics fetch funnels through here. Single-chip:

@@ -222,6 +222,197 @@ def geometry(config, vocab_size: int) -> Dict:
     return step_geometry(config, vocab_size)
 
 
+# ---------------------------------------------------- anchor calibration
+# The three hand anchors above were each calibrated from ONE measurement
+# (the r2 trace) and cannot detect their own drift: a new jaxlib, a layout
+# change, or a different chip silently invalidates them while the model
+# keeps ranking plans with stale constants. cost_calibrate inverts the
+# prediction against a run's measured device time: assuming the OTHER
+# anchors are right, the residual the measurement leaves for anchor `a`'s
+# term implies a value for `a`; implied/hand outside DRIFT_FACTOR is
+# drift. One scalar measurement cannot separate three anchors — a drifted
+# total flags EVERY active anchor whose term could carry the residual, and
+# the verdict means "re-measure the anchors", not "this one constant
+# moved". Terms contributing less than CALIBRATE_MIN_SHARE of the measured
+# step are 'stale': there is not enough signal at this shape to judge them
+# (the honest CPU-smoke outcome, where compute dwarfs every anchor term).
+
+#: implied/hand ratio beyond which an anchor reads as drifted (a 3x
+#: perturbation lands at ~3 or ~1/3 — well outside; honest measurement
+#: noise on anchor-dominated shapes stays well inside)
+DRIFT_FACTOR = 2.0
+#: minimum fraction of the measured device step an anchor's predicted term
+#: must carry before its implied value is meaningful
+CALIBRATE_MIN_SHARE = 0.02
+
+#: anchor name -> (module constant name, CostEstimate count field,
+#: CostEstimate term-ms field)
+ANCHORS = {
+    "scatter_sec_per_row": (
+        "SCATTER_SEC_PER_ROW", "scatter_rows", "scatter_ms"
+    ),
+    "program_gap_ms": ("PROGRAM_GAP_MS", "programs", "program_gap_ms"),
+    "dma_sec_per_row": ("DMA_SEC_PER_ROW", "dma_rows", "dma_ms"),
+}
+
+
+def measured_device_ms(trace_summary: Dict) -> Optional[float]:
+    """The measured device-side step time cost_calibrate inverts against:
+    the loop-stalling dispatch + device_wait spans per optimizer step
+    (the same mapping attribution_rows' device_step row uses). None when
+    the summary carries neither span."""
+    spans = (trace_summary or {}).get("spans", {})
+    vals = [
+        spans.get(n, {}).get("ms_per_step")
+        for n in ("dispatch", "device_wait")
+    ]
+    vals = [float(v) for v in vals if isinstance(v, (int, float))]
+    if not vals:
+        return None
+    return sum(vals)
+
+
+def _anchor_unit_ms(name: str, value: float) -> float:
+    """An anchor's per-count cost in ms (the sec-per-row anchors convert)."""
+    return value * (1e3 if name.endswith("_sec_per_row") else 1.0)
+
+
+def cost_calibrate(
+    est: CostEstimate,
+    measured_ms: Optional[float],
+    anchors: Optional[Dict[str, float]] = None,
+    drift_factor: float = DRIFT_FACTOR,
+    min_share: float = CALIBRATE_MIN_SHARE,
+) -> Dict:
+    """Per-anchor drift verdict (ok | drift | stale) for one run.
+
+    `est` is the model's prediction at the run's realized shape (its term
+    counts are the inversion's denominators); `measured_ms` is the run's
+    measured device step (measured_device_ms over its trace summary, or a
+    banked record's value). `anchors` overrides the module constants —
+    how tests inject a perturbed anchor and pin the counterfactual flip.
+
+    Verdicts:
+      stale — no measurement, zero count for the term, or the term's
+              predicted share of the measurement is below `min_share`
+              (not enough signal to judge at this shape)
+      ok    — implied/hand within [1/drift_factor, drift_factor]
+      drift — outside; `attribution_trusted` goes False and
+              apply_calibration refuses the affected attribution rows
+    """
+    hand = {
+        name: anchors[name] if anchors and name in anchors else globals()[const]
+        for name, (const, _, _) in ANCHORS.items()
+    }
+    # the predicted total REBUILT on the `hand` anchors: est's own term
+    # fields embed the module constants, and an overridden (perturbed)
+    # anchor must price its term consistently everywhere or the inversion
+    # leaks the true value back in (the counterfactual tests pin this)
+    terms = {
+        name: float(getattr(est, count_field))
+        * _anchor_unit_ms(name, hand[name])
+        for name, (_c, count_field, _t) in ANCHORS.items()
+    }
+    base_ms = (
+        est.step_ms + est.dispatch_ms
+        - est.scatter_ms - est.program_gap_ms - est.dma_ms
+    )
+    total_pred = base_ms + sum(terms.values())
+    rows = []
+    worst = "ok"
+    for name, (_const, count_field, _term_field) in ANCHORS.items():
+        count = float(getattr(est, count_field))
+        unit = _anchor_unit_ms(name, hand[name])
+        term_pred = terms[name]
+        row: Dict = {
+            "anchor": name,
+            "hand_value": hand[name],
+            "count": count,
+            "predicted_term_ms": round(term_pred, 4),
+        }
+        if measured_ms is None or count <= 0:
+            row["verdict"] = "stale"
+            row["why"] = (
+                "no measured device time" if measured_ms is None
+                else "term inactive at this shape (count 0)"
+            )
+        else:
+            share = term_pred / max(measured_ms, 1e-9)
+            row["share_of_measured"] = round(share, 4)
+            if share < min_share:
+                row["verdict"] = "stale"
+                row["why"] = (
+                    f"term is {share:.2%} of the measured step "
+                    f"(< {min_share:.0%}): no signal at this shape"
+                )
+            else:
+                other = total_pred - term_pred
+                implied_ms = measured_ms - other
+                implied_unit = implied_ms / count
+                implied_value = implied_unit / (
+                    1e3 if name.endswith("_sec_per_row") else 1.0
+                )
+                ratio = implied_unit / unit if unit > 0 else float("inf")
+                row["implied_value"] = implied_value
+                row["ratio"] = round(ratio, 4)
+                row["verdict"] = (
+                    "drift"
+                    if ratio > drift_factor or ratio < 1.0 / drift_factor
+                    else "ok"
+                )
+        if row["verdict"] == "drift":
+            worst = "drift"
+        elif row["verdict"] == "stale" and worst == "ok":
+            worst = "stale"
+        rows.append(row)
+    return {
+        "anchors": rows,
+        "measured_device_ms": (
+            round(measured_ms, 4) if measured_ms is not None else None
+        ),
+        "predicted_device_ms": round(total_pred, 4),
+        "drift_factor": drift_factor,
+        "min_share": min_share,
+        "verdict": worst,
+        # the refusal gate: attributions built on a drifted anchor are
+        # silently wrong — apply_calibration marks them refused
+        "attribution_trusted": all(r["verdict"] != "drift" for r in rows),
+    }
+
+
+#: attribution-row term -> the anchor that prices it
+_TERM_ANCHOR = {
+    "table_scatter": "scatter_sec_per_row",
+    "program_gap": "program_gap_ms",
+    "kernel_dma": "dma_sec_per_row",
+}
+
+
+def apply_calibration(rows: list, calib: Dict) -> list:
+    """Stamp attribution_rows with their anchors' calibration verdicts —
+    and REFUSE the prediction of any row whose anchor drifted (the
+    predicted number moves to `predicted_ms_uncalibrated`, the row says
+    why). A silently-wrong attribution is worse than none: the r7/r12
+    counterfactual-flip discipline, now fed by device truth."""
+    verdicts = {a["anchor"]: a["verdict"] for a in calib.get("anchors", ())}
+    out = []
+    for row in rows:
+        row = dict(row)
+        anchor = _TERM_ANCHOR.get(row.get("term"))
+        if anchor is not None and anchor in verdicts:
+            row["calibration"] = verdicts[anchor]
+            if verdicts[anchor] == "drift":
+                row["predicted_ms_uncalibrated"] = row.get("predicted_ms")
+                row["predicted_ms"] = None
+                row["refused"] = (
+                    f"anchor {anchor} drifted (cost_calibrate): this "
+                    "attribution would be silently wrong — re-measure the "
+                    "anchor before trusting the term"
+                )
+        out.append(row)
+    return out
+
+
 def attribution_rows(est: CostEstimate, trace_summary: Dict) -> list:
     """Measured-vs-predicted cost rows from a run's trace summary.
 
